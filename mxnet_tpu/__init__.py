@@ -1,0 +1,56 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+Brand-new design (not a port): jax/XLA is the execution engine, Pallas the
+kernel language, GSPMD mesh sharding the distribution layer. The public
+surface mirrors the reference framework (`python/mxnet/`) so reference users
+find everything where they expect it: `nd`, `autograd`, `gluon`, `optimizer`,
+`metric`, `io`, `kvstore`, `module`, `profiler`.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+
+__all__ = [
+    "nd", "ndarray", "autograd", "random", "context",
+    "Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus",
+    "MXNetError",
+]
+
+# Subpackages filled in over the build; imported lazily to keep import light
+# and to avoid hard failures while the surface is under construction.
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "init": ".initializer",
+    "initializer": ".initializer",
+    "metric": ".metric",
+    "callback": ".callback",
+    "io": ".io",
+    "kv": ".kvstore",
+    "kvstore": ".kvstore",
+    "mod": ".module",
+    "module": ".module",
+    "profiler": ".profiler",
+    "parallel": ".parallel",
+    "test_utils": ".test_utils",
+    "lr_scheduler": ".lr_scheduler",
+    "image": ".image",
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY:
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute '{name}'")

@@ -1,0 +1,194 @@
+"""Autograd recording state and tape.
+
+The reference framework's dependency engine (`src/engine/threaded_engine.cc`)
+does not exist here: jax's async dispatch plus functional purity replaces
+read/write-var scheduling (SURVEY.md §7.1). What remains of the imperative
+runtime (`src/imperative/imperative.cc`) is the *gradient tape*: when
+`autograd.record()` is active, every eager op appends a Node capturing its
+pure function and inputs; `backward()` walks the tape in reverse and chains
+per-op `jax.vjp` calls.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "record_op",
+    "backward",
+    "Node",
+]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    st = _st()
+    prev, st.recording = st.recording, flag
+    return prev
+
+
+def set_training(flag):
+    st = _st()
+    prev, st.training = st.training, flag
+    return prev
+
+
+class Node:
+    """One recorded op application (reference: AGInfo / nnvm::Node in
+    `src/imperative/imperative.cc`)."""
+
+    __slots__ = ("fn", "in_data", "parents", "n_out", "out_avals")
+
+    def __init__(self, fn, in_data, parents, n_out, out_avals):
+        self.fn = fn                # pure: (*in_data) -> tuple of outputs
+        self.in_data = in_data      # jax arrays captured at record time
+        self.parents = parents      # per input: ("node", Node, out_idx) | ("leaf", NDArray) | None
+        self.n_out = n_out
+        self.out_avals = out_avals  # (shape, dtype) per output, for zero cotangents
+
+
+def record_op(fn, in_data, parents, outputs):
+    """Append an op to the tape; tag each output NDArray with its node."""
+    out_avals = tuple((o.shape, o.dtype) for o in outputs)
+    node = Node(fn, tuple(in_data), tuple(parents), len(outputs), out_avals)
+    for i, out in enumerate(outputs):
+        out._node = (node, i)
+    return node
+
+
+def _topo_order(roots):
+    """Reverse-topological DFS over Nodes (iterative; graphs can be deep)."""
+    order, seen = [], set()
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p is not None and p[0] == "node":
+                stack.append((p[1], False))
+    return order  # children before parents; iterate reversed for backward? no:
+    # post-order DFS appends a node only after all its ancestors(inputs) are
+    # appended, so iterating *reversed* visits consumers before producers.
+
+
+def backward(arrays, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse-mode accumulation from `arrays` into leaf `.grad` buffers.
+
+    Reference semantics: `MXAutogradBackwardEx` → `Imperative::Backward`
+    (`src/imperative/imperative.cc`): seeds ones for scalar-ish heads,
+    accumulates into arrays that called `attach_grad()`, honouring
+    grad_req 'write'|'add'.
+    """
+    roots, seeds = [], {}
+    for i, arr in enumerate(arrays):
+        node_ref = getattr(arr, "_node", None)
+        if node_ref is None:
+            raise ValueError(
+                "cannot differentiate: array is not part of a recorded graph"
+            )
+        node, idx = node_ref
+        roots.append(node)
+        if head_grads is not None and head_grads[i] is not None:
+            seed = head_grads[i]
+            seed = seed._data if hasattr(seed, "_data") else jnp.asarray(seed)
+        else:
+            seed = jnp.ones(arr.shape, dtype=arr.dtype)
+        key = (id(node), idx)
+        seeds[key] = seeds.get(key, 0) + seed
+
+    # cotangent store: (id(node), out_idx) -> jax array
+    cots = dict(seeds)
+    nodes_by_id = {}
+
+    order = _topo_order(roots)
+    for n in order:
+        nodes_by_id[id(n)] = n
+
+    leaf_accum = {}  # id(ndarray) -> (ndarray, grad)
+    for node in reversed(order):
+        outs = []
+        any_cot = False
+        for i in range(node.n_out):
+            c = cots.pop((id(node), i), None)
+            if c is None:
+                shape, dtype = node.out_avals[i]
+                c = jnp.zeros(shape, dtype)
+            else:
+                any_cot = True
+            outs.append(c)
+        if not any_cot:
+            continue
+        # Chain rule for this op: vjp of its pure function.
+        diff_pos = [
+            i for i, p in enumerate(node.parents)
+            if p is not None and jnp.issubdtype(jnp.asarray(node.in_data[i]).dtype, jnp.inexact)
+        ]
+        if not diff_pos:
+            continue
+
+        def partial_fn(*diff_args, _node=node, _pos=tuple(diff_pos)):
+            full = list(_node.in_data)
+            for p, a in zip(_pos, diff_args):
+                full[p] = a
+            out = _node.fn(*full)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals = tuple(node.in_data[i] for i in diff_pos)
+        _, vjp_fn = jax.vjp(partial_fn, *primals)
+        in_grads = vjp_fn(tuple(outs))
+        for pos, g in zip(diff_pos, in_grads):
+            parent = node.parents[pos]
+            if parent is None:
+                continue
+            kind = parent[0]
+            if kind == "node":
+                _, pnode, pidx = parent
+                key = (id(pnode), pidx)
+                cots[key] = (cots[key] + g) if key in cots else g
+            elif kind == "leaf":
+                leaf = parent[1]
+                k = id(leaf)
+                if k in leaf_accum:
+                    leaf_accum[k] = (leaf, leaf_accum[k][1] + g)
+                else:
+                    leaf_accum[k] = (leaf, g)
+
+    for leaf, g in leaf_accum.values():
+        if leaf.grad_req == "null" or leaf._grad is None:
+            continue
+        if leaf.grad_req == "add":
+            leaf._grad._data = leaf._grad._data + g.astype(leaf._grad.dtype)
+        else:  # 'write'
+            leaf._grad._data = g.astype(leaf._grad.dtype)
+
+    if not retain_graph:
+        for arr in arrays:
+            arr._node = None

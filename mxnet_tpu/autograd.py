@@ -1,0 +1,100 @@
+"""Autograd: record/pause scopes and backward.
+
+Reference: `python/mxnet/autograd.py` over `src/imperative/imperative.cc`
+(`MXAutogradSetIsRecording`, `MXAutogradBackwardEx`). The tape lives in
+`mxnet_tpu._engine`; gradients chain through per-op `jax.vjp`.
+"""
+from __future__ import annotations
+
+from . import _engine
+from .ndarray import NDArray
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "backward",
+           "is_recording", "is_training", "set_recording", "set_training",
+           "mark_variables", "grad"]
+
+is_recording = _engine.is_recording
+is_training = _engine.is_training
+set_recording = _engine.set_recording
+set_training = _engine.set_training
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        prev_r = _engine.set_recording(self._enter_record) \
+            if self._enter_record is not None else None
+        prev_t = _engine.set_training(self._enter_train) \
+            if self._enter_train is not None else None
+        self._prev = (prev_r, prev_t)
+        return self
+
+    def __exit__(self, *exc):
+        prev_r, prev_t = self._prev
+        if self._enter_record is not None:
+            _engine.set_recording(prev_r)
+        if self._enter_train is not None:
+            _engine.set_training(prev_t)
+        return False
+
+
+def record(train_mode=True):
+    """`with autograd.record():` — enable tape recording (+train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(variables, NDArray):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad_req = req
+        v._grad = g
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None:
+            head_grads = [head_grads]
+    _engine.backward(heads, head_grads, retain_graph=retain_graph,
+                     train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Compute gradients w.r.t. `variables` and return them (does not touch
+    `.grad` buffers). Reference: `mx.autograd.grad`."""
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v._grad, v.grad_req) for v in variables]
+    import jax.numpy as jnp
+    for v in variables:
+        v._grad = NDArray(jnp.zeros_like(v._data))
+        v.grad_req = "write"
+    try:
+        _engine.backward(heads, head_grads, retain_graph=bool(retain_graph),
+                         train_mode=train_mode)
+        return [v._grad for v in variables]
+    finally:
+        for v, (g, req) in zip(variables, saved):
+            v._grad, v.grad_req = g, req

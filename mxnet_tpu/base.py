@@ -1,0 +1,78 @@
+"""Base utilities for mxnet_tpu.
+
+TPU-native re-imagination of the roles played by dmlc-core in the reference
+(upstream mxnet `3rdparty/dmlc-core/`): logging, registries, and small shared
+helpers. There is no C ABI here — the "C API" layer of the reference
+(`src/c_api/`) is subsumed by Python calling jax directly.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+__all__ = ["MXNetError", "get_env", "registry_get", "logger", "numeric_types", "string_types"]
+
+logger = logging.getLogger("mxnet_tpu")
+
+numeric_types = (float, int, bool)
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: `include/mxnet/base.h` dmlc::Error)."""
+
+
+def get_env(name, default, typ=None):
+    """Read a runtime knob from the environment (reference: dmlc::GetEnv)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is None:
+        typ = type(default) if default is not None else str
+    if typ is bool:
+        return val.lower() in ("1", "true", "yes", "on")
+    return typ(val)
+
+
+class Registry:
+    """Generic name → object registry (reference: dmlc registry template,
+    `3rdparty/dmlc-core/include/dmlc/registry.h`)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._map = {}
+
+    def register(self, name=None, obj=None, *, allow_override=False):
+        def do_register(o, key):
+            key = (key or getattr(o, "__name__", None) or str(o)).lower()
+            with self._lock:
+                if key in self._map and not allow_override:
+                    raise ValueError(f"{self.kind} '{key}' already registered")
+                self._map[key] = o
+            return o
+
+        if obj is not None:
+            return do_register(obj, name)
+        if callable(name) and not isinstance(name, str):
+            return do_register(name, None)
+        return lambda o: do_register(o, name)
+
+    def get(self, name):
+        try:
+            return self._map[name.lower()]
+        except KeyError:
+            raise KeyError(
+                f"Unknown {self.kind} '{name}'. Registered: {sorted(self._map)}"
+            ) from None
+
+    def __contains__(self, name):
+        return name.lower() in self._map
+
+    def keys(self):
+        return sorted(self._map)
+
+
+def registry_get(reg, name):
+    return reg.get(name)

@@ -1,0 +1,4 @@
+"""`mx.nd` namespace (reference: `python/mxnet/ndarray/`)."""
+from .ndarray import *  # noqa: F401,F403
+from .ndarray import NDArray, _MODULE_OPS, imperative_invoke  # noqa: F401
+from . import random  # noqa: F401

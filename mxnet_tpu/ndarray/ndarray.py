@@ -1,0 +1,471 @@
+"""NDArray: mutable, imperative tensor facade over immutable jax arrays.
+
+TPU-native re-design of the reference NDArray (`include/mxnet/ndarray.h`,
+`src/ndarray/ndarray.cc`): the reference pairs a mutable buffer with engine
+var-versioning; here the "mutation" is rebinding `_data` to a new functional
+value — jax's async dispatch plays the role of the dependency engine
+(SURVEY.md §7.1), and `wait_to_read()` maps to `block_until_ready`.
+
+Every registered op (mxnet_tpu.ops) is exposed three ways:
+  * module function `nd.<op>(...)`
+  * NDArray method `x.<op>(...)` (via `__getattr__` registry dispatch)
+  * python operators (`+`, `*`, `@`, slicing, ...)
+All three unwrap to raw jax arrays, run the pure op, wrap the result, and
+append to the autograd tape when `autograd.record()` is active.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import _engine
+from .. import ops as _ops
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "eye", "linspace", "concatenate", "save", "load", "waitall",
+           "from_jax", "imperative_invoke"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _ctx_device(ctx):
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(ctx, Context):
+        return ctx.jax_device
+    return ctx
+
+
+class NDArray:
+    __slots__ = ("_data", "_node", "_grad", "grad_req")
+
+    __array_priority__ = 1000.0  # beat numpy in mixed operator dispatch
+
+    def __init__(self, data):
+        self._data = data
+        self._node = None
+        self._grad = None
+        self.grad_req = "null"
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(_np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        try:
+            dev = next(iter(self._data.devices()))
+            return Context(dev.platform, dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:
+            body = f"<traced {self.shape} {self.dtype}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # ------------------------------------------------------------------
+    # host interop / sync points
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        """Copy to host (reference: `MXNDArraySyncCopyToCPU` — a sync point)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd surface (reference: `MXNDArrayAttachGrad`, `autograd.py`)
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write"):
+        self.grad_req = grad_req
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        return self
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _engine.backward([self], [out_grad] if out_grad is not None else None,
+                         retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # copies / context moves
+    # ------------------------------------------------------------------
+    def copy(self):
+        return NDArray(self._data)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = self._data
+            return other
+        return NDArray(jax.device_put(self._data, _ctx_device(other)))
+
+    def as_in_context(self, ctx):
+        return NDArray(jax.device_put(self._data, _ctx_device(ctx)))
+
+    as_in_ctx = as_in_context
+
+    def astype(self, dtype, copy=True):
+        return imperative_invoke("cast", (self,), {"dtype": _np.dtype(dtype).name})
+
+    def asjax(self):
+        """The underlying jax.Array (zero-copy escape hatch; dlpack analog)."""
+        return self._data
+
+    # ------------------------------------------------------------------
+    # mutation (the reference's defining NDArray feature)
+    # ------------------------------------------------------------------
+    def _check_mutable(self):
+        if self._node is not None:
+            raise RuntimeError(
+                "in-place mutation of an array that is part of a recorded "
+                "graph is not allowed (matches reference autograd restriction)")
+
+    def __setitem__(self, key, value):
+        self._check_mutable()
+        key = _convert_index(key)
+        v = _unwrap(value)
+        if not isinstance(v, (jax.Array, jnp.ndarray)) and not _np.isscalar(v):
+            v = jnp.asarray(v)
+        self._data = self._data.at[key].set(v)
+
+    def __getitem__(self, key):
+        ckey = _convert_index(key)
+        return imperative_invoke("_getitem", (self,), {"key": ckey})
+
+    # in-place arithmetic rebinds the buffer (reference: engine write-var)
+    def __iadd__(self, other):
+        self._check_mutable()
+        self._data = self._data + _unwrap(other)
+        return self
+
+    def __isub__(self, other):
+        self._check_mutable()
+        self._data = self._data - _unwrap(other)
+        return self
+
+    def __imul__(self, other):
+        self._check_mutable()
+        self._data = self._data * _unwrap(other)
+        return self
+
+    def __itruediv__(self, other):
+        self._check_mutable()
+        self._data = self._data / _unwrap(other)
+        return self
+
+    # ------------------------------------------------------------------
+    # operators
+    # ------------------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return imperative_invoke(op, (a, b), {})
+        if _np.isscalar(other):
+            return imperative_invoke(scalar_op, (self,), {"scalar": other})
+        other = array(other)
+        a, b = (other, self) if reverse else (self, other)
+        return imperative_invoke(op, (a, b), {})
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", "_rdiv_scalar", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod", "_mod_scalar")
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", "_rpower_scalar", reverse=True)
+
+    def __neg__(self):
+        return imperative_invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return imperative_invoke("abs", (self,), {})
+
+    def __matmul__(self, o):
+        return imperative_invoke("dot", (self, o), {})
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # ------------------------------------------------------------------
+    # registry dispatch: every op is also a method
+    # ------------------------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in _ops.OPS:
+            def method(*args, **kwargs):
+                return imperative_invoke(name, (self,) + args, kwargs)
+            method.__name__ = name
+            return method
+        raise AttributeError(f"NDArray has no attribute/op '{name}'")
+
+
+# --------------------------------------------------------------------------
+# indexing helpers
+# --------------------------------------------------------------------------
+
+def _convert_index(key):
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32) if jnp.issubdtype(key._data.dtype, jnp.floating) else key._data
+    if isinstance(key, tuple):
+        return tuple(_convert_index(k) for k in key)
+    return key
+
+
+@_ops.register("_getitem")
+def _getitem_op(data, key=None):
+    return data[key]
+
+
+# --------------------------------------------------------------------------
+# the imperative invoke path (reference: `MXImperativeInvokeEx` →
+# `Imperative::Invoke`, `src/imperative/imperative.cc`)
+# --------------------------------------------------------------------------
+
+def imperative_invoke(op_name, args, kwargs):
+    fn = _ops.OPS[op_name]
+    in_data = tuple(_unwrap(a) for a in args)
+    out = fn(*in_data, **kwargs)
+    multi = isinstance(out, tuple)
+    outs = tuple(NDArray(o) for o in (out if multi else (out,)))
+
+    if _engine.is_recording():
+        needs_record = any(
+            isinstance(a, NDArray) and (a._node is not None or a._grad is not None)
+            for a in args)
+        if needs_record:
+            parents = []
+            for a in args:
+                if isinstance(a, NDArray):
+                    if a._node is not None:
+                        parents.append(("node",) + a._node)
+                    else:
+                        parents.append(("leaf", a))
+                else:
+                    parents.append(None)
+            pure = (lambda *xs: fn(*xs, **kwargs))
+            _engine.record_op(pure, in_data, parents, outs)
+    return outs if multi else outs[0]
+
+
+# --------------------------------------------------------------------------
+# module-level op namespace: nd.<op>(...)
+# --------------------------------------------------------------------------
+
+def _make_module_op(name):
+    def op(*args, **kwargs):
+        # allow out= for MXNet compat: write result into given array
+        out_arr = kwargs.pop("out", None)
+        res = imperative_invoke(name, args, kwargs)
+        if out_arr is not None:
+            out_arr._check_mutable()
+            out_arr._data = res._data
+            return out_arr
+        return res
+    op.__name__ = name
+    return op
+
+
+_MODULE_OPS = {name: _make_module_op(name) for name in _ops.OPS}
+globals().update(_MODULE_OPS)
+__all__ += list(_MODULE_OPS)
+
+
+# --------------------------------------------------------------------------
+# creation / io (reference: `src/operator/tensor/init_op.cc`,
+# `NDArray::Save/Load` in `src/ndarray/ndarray.cc`)
+# --------------------------------------------------------------------------
+
+def from_jax(data):
+    return NDArray(data)
+
+
+def array(source, ctx=None, dtype=None):
+    if isinstance(source, NDArray):
+        data = source._data
+    else:
+        data = jnp.asarray(source, dtype=jnp.dtype(dtype) if dtype else None)
+    if dtype is not None:
+        data = data.astype(jnp.dtype(dtype))
+    elif not isinstance(source, (NDArray, jax.Array)) and data.dtype == jnp.float64:
+        data = data.astype(jnp.float32)  # MXNet default dtype
+    if ctx is not None:
+        data = jax.device_put(data, _ctx_device(ctx))
+    return NDArray(data)
+
+
+def zeros(shape, ctx=None, dtype="float32"):
+    return array(jnp.zeros(shape, jnp.dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32"):
+    return array(jnp.ones(shape, jnp.dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    return array(jnp.full(shape, val, jnp.dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return array(out, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return array(jnp.eye(N, M or N, k, jnp.dtype(dtype)), ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return array(jnp.linspace(start, stop, num, endpoint=endpoint,
+                              dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def concatenate(arrays, axis=0):
+    return imperative_invoke("concat", tuple(arrays), {"dim": axis})
+
+
+def waitall():
+    """Block until all launched work completes (reference: `MXNDArrayWaitAll`)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def save(fname, data):
+    """Save NDArray / list / dict of NDArrays (reference `.params` role; the
+    container here is numpy .npz rather than dmlc::Stream binary)."""
+    if isinstance(data, NDArray):
+        payload, meta = {"arr_0": data.asnumpy()}, "single"
+    elif isinstance(data, (list, tuple)):
+        payload = {f"arr_{i}": a.asnumpy() for i, a in enumerate(data)}
+        meta = "list"
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+        meta = "dict"
+    else:
+        raise TypeError(type(data))
+    _np.savez(fname if fname.endswith(".npz") else fname + ".npz",
+              __mx_meta__=meta, **payload)
+
+
+def load(fname):
+    import os
+    if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
+        fname = fname + ".npz"
+    with _np.load(fname, allow_pickle=False) as z:
+        meta = str(z["__mx_meta__"])
+        items = {k: array(z[k]) for k in z.files if k != "__mx_meta__"}
+    if meta == "single":
+        return items["arr_0"]
+    if meta == "list":
+        return [items[f"arr_{i}"] for i in range(len(items))]
+    return items

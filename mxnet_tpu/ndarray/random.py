@@ -1,0 +1,72 @@
+"""`mx.nd.random` namespace (reference: `python/mxnet/ndarray/random.py`)."""
+from __future__ import annotations
+
+from .ndarray import imperative_invoke, NDArray
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "randint", "multinomial", "shuffle"]
+
+
+def _shape_of(shape, *arrs):
+    if shape is not None:
+        return shape
+    for a in arrs:
+        if isinstance(a, NDArray):
+            return a.shape
+    return (1,)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    res = imperative_invoke("_random_uniform", (),
+                            dict(low=low, high=high, shape=_shape_of(shape), dtype=dtype))
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    res = imperative_invoke("_random_normal", (),
+                            dict(loc=loc, scale=scale, shape=_shape_of(shape), dtype=dtype))
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    return imperative_invoke("_random_gamma", (),
+                             dict(alpha=alpha, beta=beta, shape=_shape_of(shape), dtype=dtype))
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
+    return imperative_invoke("_random_exponential", (),
+                             dict(lam=1.0 / scale, shape=_shape_of(shape), dtype=dtype))
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    return imperative_invoke("_random_poisson", (),
+                             dict(lam=lam, shape=_shape_of(shape), dtype=dtype))
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None):
+    return imperative_invoke("_random_negative_binomial", (),
+                             dict(k=k, p=p, shape=_shape_of(shape), dtype=dtype))
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None):
+    return imperative_invoke("_random_randint", (),
+                             dict(low=low, high=high, shape=_shape_of(shape), dtype=dtype))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    return imperative_invoke("_sample_multinomial", (data,),
+                             dict(shape=shape, get_prob=get_prob, dtype=dtype))
+
+
+def shuffle(data):
+    return imperative_invoke("shuffle", (data,), {})

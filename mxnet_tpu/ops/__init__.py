@@ -1,0 +1,45 @@
+"""Functional operator library.
+
+TPU-native equivalent of the reference's `src/operator/` (~150k LoC of
+C++/CUDA/cuDNN kernels, SURVEY.md §2.1): every op here is a *pure jax
+function* on raw `jax.Array`s, registered by its MXNet op name. XLA replaces
+mshadow + hand-written kernels; Pallas (see `mxnet_tpu.pallas_ops`) covers the
+few kernels XLA won't fuse well.
+
+Registered signature convention: `fn(*arrays, **params) -> array | tuple`.
+The NDArray front-end (`mxnet_tpu.ndarray`) wraps each op with
+unwrap/record/wrap; the symbolic/hybridize path calls these functions directly
+on tracers.
+"""
+from __future__ import annotations
+
+OPS = {}
+
+
+def register(name):
+    """Register a pure op under its MXNet name (reference: NNVM_REGISTER_OP)."""
+
+    def deco(fn):
+        if name in OPS:
+            raise ValueError(f"op '{name}' already registered")
+        OPS[name] = fn
+        fn.op_name = name
+        return fn
+
+    return deco
+
+
+def alias(new, existing):
+    OPS[new] = OPS[existing]
+
+
+def get(name):
+    return OPS[name]
+
+
+from . import math_ops      # noqa: E402,F401  (elemwise, reduce, linalg)
+from . import shape_ops     # noqa: E402,F401
+from . import nn_ops        # noqa: E402,F401
+from . import random_ops    # noqa: E402,F401
+from . import optimizer_ops  # noqa: E402,F401
+from . import rnn_ops       # noqa: E402,F401

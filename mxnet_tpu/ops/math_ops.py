@@ -1,0 +1,274 @@
+"""Elementwise, broadcast, reduction and linear-algebra ops.
+
+Reference coverage: `src/operator/tensor/elemwise_binary_op_basic.cc`,
+`elemwise_unary_op_basic.cc`, `broadcast_reduce_op_value.cc`, `dot-inl.h`,
+`la_op.cc`, `ordering_op.cc`. All lower to jnp/lax so XLA fuses elementwise
+chains into surrounding matmuls (HBM-bandwidth friendly, SURVEY.md §7.1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register, alias
+
+# --------------------------------------------------------------------------
+# elementwise binary (dense, same-shape or numpy-broadcast; MXNet's separate
+# `elemwise_*` vs `broadcast_*` families collapse to one jnp implementation)
+# --------------------------------------------------------------------------
+
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}
+for _name, _fn in _BINARY.items():
+    register(_name)(lambda lhs, rhs, _fn=_fn: _fn(lhs, rhs))
+
+_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+for _name, _fn in _CMP.items():
+    # MXNet comparison ops return the lhs dtype (0.0/1.0), not bool.
+    register(_name)(lambda lhs, rhs, _fn=_fn: _fn(lhs, rhs).astype(jnp.result_type(lhs)))
+
+for _scalar_name, _base in [
+    ("_plus_scalar", jnp.add), ("_minus_scalar", jnp.subtract),
+    ("_rminus_scalar", lambda a, s: s - a),
+    ("_mul_scalar", jnp.multiply), ("_div_scalar", jnp.divide),
+    ("_rdiv_scalar", lambda a, s: s / a),
+    ("_power_scalar", jnp.power), ("_rpower_scalar", lambda a, s: s ** a),
+    ("_mod_scalar", jnp.mod),
+    ("_maximum_scalar", jnp.maximum), ("_minimum_scalar", jnp.minimum),
+    ("_equal_scalar", lambda a, s: (a == s).astype(a.dtype)),
+    ("_not_equal_scalar", lambda a, s: (a != s).astype(a.dtype)),
+    ("_greater_scalar", lambda a, s: (a > s).astype(a.dtype)),
+    ("_greater_equal_scalar", lambda a, s: (a >= s).astype(a.dtype)),
+    ("_lesser_scalar", lambda a, s: (a < s).astype(a.dtype)),
+    ("_lesser_equal_scalar", lambda a, s: (a <= s).astype(a.dtype)),
+]:
+    register(_scalar_name)(lambda data, scalar, _b=_base: _b(data, scalar))
+
+# --------------------------------------------------------------------------
+# elementwise unary
+# --------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "round": jnp.round,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "hard_sigmoid": lambda x, alpha=0.2, beta=0.5: jnp.clip(alpha * x + beta, 0, 1),
+}
+for _name, _fn in _UNARY.items():
+    register(_name)(lambda data, _fn=_fn, **kw: _fn(data, **kw))
+
+
+@register("clip")
+def clip(data, a_min, a_max):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("cast")
+def cast(data, dtype):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("copy")
+def copy(data):
+    return data + jnp.zeros((), data.dtype) if jnp.issubdtype(data.dtype, jnp.inexact) else data
+
+
+# --------------------------------------------------------------------------
+# reductions (reference: `src/operator/tensor/broadcast_reduce_op_value.cc`)
+# --------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or isinstance(axis, int):
+        return axis
+    return tuple(axis)
+
+
+def _reduce(jfn):
+    def op(data, axis=None, keepdims=False, exclude=False):
+        axis = _norm_axis(axis)
+        if exclude and axis is not None:
+            ax = (axis,) if isinstance(axis, int) else axis
+            axis = tuple(i for i in range(data.ndim) if i not in ax)
+        return jfn(data, axis=axis, keepdims=keepdims)
+    return op
+
+
+register("sum")(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+register("max")(_reduce(jnp.max))
+register("min")(_reduce(jnp.min))
+alias("sum_axis", "sum")
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # MXNet returns float indices
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# linalg (reference: `src/operator/tensor/dot-inl.h`, `la_op.cc`)
+# On TPU these are the MXU ops — keep them as single large dots.
+# --------------------------------------------------------------------------
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b (tensordot).
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = lower != transpose
+    if rightside:
+        x = jnp.swapaxes(
+            jax.scipy.linalg.solve_triangular(
+                jnp.swapaxes(a, -1, -2), jnp.swapaxes(B, -1, -2), lower=not low
+            ), -1, -2)
+    else:
+        x = jax.scipy.linalg.solve_triangular(a, B, lower=low)
+    return alpha * x
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+# --------------------------------------------------------------------------
+# ordering (reference: `src/operator/tensor/ordering_op.cc`)
+# --------------------------------------------------------------------------
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    moved = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-moved, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(moved, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise NotImplementedError("topk ret_typ='mask'")
+    raise ValueError(ret_typ)
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))

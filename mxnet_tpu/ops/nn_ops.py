@@ -1,0 +1,367 @@
+"""Neural-network ops.
+
+Reference coverage: `src/operator/nn/` — fully_connected.cc, convolution.cc
+(+ cudnn specializations we replace with XLA's MXU conv lowering), pooling.cc,
+batch_norm.cc, layer_norm.cc, activation.cc, dropout.cc, softmax.cc,
+softmax_output.cc, embedding (`indexing_op.cc` Embedding), and
+`src/operator/contrib/transformer.cc` attention helpers.
+
+Layout: MXNet default NCHW / OIHW is kept at the API surface; XLA's layout
+assignment re-tiles for the MXU internally, so no NHWC rewrite is forced on
+users. Convs/matmuls stay un-fused here — XLA fuses the elementwise
+neighbourhood (SURVEY.md §7.1).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register, alias
+from .. import random as _random
+
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = jnp.matmul(x, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n if n else v
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register("Convolution")
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False, layout=None):
+    n = data.ndim - 2
+    stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
+    pad = _pair(pad or 0, n)
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if n == 2 else ("NCW", "OIW", "NCW") if n == 1
+        else ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1, no_bias=False,
+                  target_shape=None, layout=None):
+    n = data.ndim - 2
+    stride = _pair(stride or 1, n)
+    pad = _pair(pad or 0, n)
+    adj = _pair(adj or 0, n)
+    kernel = _pair(kernel, n) if kernel is not None else weight.shape[2:]
+    # Transposed conv = gradient of conv w.r.t. input: lhs-dilated conv with
+    # flipped kernel. weight layout: (in, out/group, *kernel) in MXNet.
+    pads = [(k - 1 - p, k - 1 - p + a) for k, p, a in zip(kernel, pad, adj)]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    # reshape to (out, in/group, ...) for the forward conv
+    cin = data.shape[1]
+    w = w.reshape(num_group, cin // num_group, -1, *kernel)
+    w = jnp.swapaxes(w, 1, 2).reshape(-1, cin // num_group, *kernel)
+    dn = lax.conv_dimension_numbers(
+        data.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if n == 2 else ("NCW", "OIW", "NCW") if n == 1
+        else ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=num_group)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Pooling")
+def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True, layout=None):
+    n = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * n
+        pad = (0,) * n
+    else:
+        kernel = _pair(kernel, n)
+        stride = _pair(stride or kernel, n)
+        pad = _pair(pad or 0, n)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: extend the upper pad so the last partial window counts
+        extra = []
+        for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+            size = data.shape[2 + i]
+            out_full = int(np.ceil((size + 2 * p - k) / s)) + 1
+            needed = (out_full - 1) * s + k - size - p
+            extra.append(max(int(needed), p))
+        padding = ((0, 0), (0, 0)) + tuple((p, e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = np.prod(kernel)
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        raise NotImplementedError("lp pooling")
+    raise ValueError(pool_type)
+
+
+@register("Activation")
+def activation(data, act_type="relu"):
+    return {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softrelu": jax.nn.softplus,
+        "softsign": jax.nn.soft_sign,
+        "gelu": jax.nn.gelu,
+        "silu": jax.nn.silu,
+    }[act_type](data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jax.nn.leaky_relu(data, slope)
+    if act_type == "prelu":
+        return jnp.where(data >= 0, data, gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) * data)
+    if act_type == "elu":
+        return jax.nn.elu(data, slope)
+    if act_type == "selu":
+        return jax.nn.selu(data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=True)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jax.nn.leaky_relu(data, mid)
+    raise ValueError(act_type)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    x = data / temperature if temperature else data
+    if length is not None:
+        mask = jnp.arange(x.shape[axis]) < jnp.expand_dims(length.astype(jnp.int32), -1)
+        mask = jnp.reshape(mask, mask.shape + (1,) * (x.ndim - mask.ndim))
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxOutput")
+def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
+                   multi_output=False, use_ignore=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, preserve_shape=False):
+    # Forward is plain softmax; the fused backward of the reference
+    # (`src/operator/softmax_output.cc`) is unnecessary — jax.vjp of
+    # cross-entropy produces the same fused gradient under XLA.
+    return jax.nn.softmax(data, axis=-1)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[..., None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("Dropout")
+def dropout(data, p=0.5, mode="training", axes=(), _training=None):
+    from .. import _engine
+    training = _engine.is_training() if _training is None else _training
+    if not training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    shape = list(data.shape)
+    for ax in axes or ():
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_random.next_key(), keep, tuple(shape))
+    return jnp.where(mask, data / keep, jnp.zeros((), data.dtype)).astype(data.dtype)
+
+
+@register("BatchNorm")
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, _training=None):
+    """Returns (out, new_moving_mean, new_moving_var).
+
+    The reference mutates moving stats in-place inside the op
+    (`src/operator/nn/batch_norm.cc`); functionally we return the updated
+    stats and let the Block layer write them back (aux-state discipline that
+    also works under jit tracing).
+    """
+    from .. import _engine
+    training = _engine.is_training() if _training is None else _training
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    reduce_axes = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = -1
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=reduce_axes)
+        var = jnp.var(data, axis=reduce_axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
+    out = (data - mean.reshape(bshape).astype(data.dtype)) * inv.reshape(bshape)
+    out = out * gamma.reshape(bshape).astype(data.dtype) + beta.reshape(bshape).astype(data.dtype)
+    return out, new_mean, new_var
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
+    out = out.astype(data.dtype) * gamma + beta
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    N, C = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape(N, num_groups, C // num_groups, *rest).astype(jnp.float32)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape).astype(data.dtype)
+    bshape = (1, C) + (1,) * len(rest)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    x = (data - mean) * lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("BilinearResize2D")
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None, scale_width=None):
+    N, C, H, W = data.shape
+    out_h = height or int(H * scale_height)
+    out_w = width or int(W * scale_width)
+    return jax.image.resize(data, (N, C, out_h, out_w), method="linear")
+
+
+@register("UpSampling")
+def upsampling(data, scale=2, sample_type="nearest", num_args=1):
+    N, C, H, W = data.shape
+    method = "nearest" if sample_type == "nearest" else "linear"
+    return jax.image.resize(data, (N, C, H * scale, W * scale), method=method)
+
+
+# --------------------------------------------------------------------------
+# attention (reference: `src/operator/contrib/transformer.cc` interleaved
+# matmul self-attention helpers used by GluonNLP BERT). Exposed with the
+# reference names; internally one fused jnp path (XLA) with a Pallas flash
+# kernel override on TPU (see mxnet_tpu.pallas_ops.flash_attention).
+# --------------------------------------------------------------------------
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    # input: (seq, batch, 3*embed) interleaved per head
+    L, B, E3 = queries_keys_values.shape
+    proj = E3 // 3 // heads
+    x = queries_keys_values.reshape(L, B, heads, 3, proj)
+    q = x[:, :, :, 0]  # (L, B, H, P)
+    k = x[:, :, :, 1]
+    q = q.transpose(1, 2, 0, 3).reshape(B * heads, L, proj)
+    k = k.transpose(1, 2, 0, 3).reshape(B * heads, L, proj)
+    return jnp.matmul(q, k.swapaxes(-1, -2)) / jnp.sqrt(proj).astype(q.dtype)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    L, B, E3 = queries_keys_values.shape
+    proj = E3 // 3 // heads
+    x = queries_keys_values.reshape(L, B, heads, 3, proj)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3).reshape(B * heads, L, proj)
+    out = jnp.matmul(attention, v)  # (B*H, L, P)
+    out = out.reshape(B, heads, L, proj).transpose(2, 0, 1, 3).reshape(L, B, heads * proj)
+    return out
+
+
+@register("multi_head_attention")
+def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0, _training=None):
+    """Batched multi-head attention on (B, L, H, D) tensors — the fused path
+    models use. Dispatches to the Pallas flash kernel on TPU."""
+    from ..pallas_ops import flash_attention
+    return flash_attention(q, k, v, mask=mask)

@@ -1,0 +1,194 @@
+"""Optimizer update ops.
+
+Reference: `src/operator/optimizer_op.cc` (SGDUpdate, SGDMomUpdate,
+AdamUpdate, FtrlUpdate, RMSPropUpdate, SignumUpdate, LambUpdate*, and the
+fused `multi_*` variants). Here each is a pure function returning the new
+weight (and new state tensors); the Optimizer frontend owns state plumbing.
+XLA fuses these into single elementwise kernels, and on a sharded mesh the
+weight-update runs sharded over the data axis (weight-update sharding, see
+PAPERS.md: Automatic Cross-Replica Sharding of Weight Update).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update")
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom - lr * g
+    return (weight.astype(jnp.float32) + new_mom).astype(weight.dtype), new_mom
+
+
+@register("nag_mom_update")
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom + g
+    return (weight.astype(jnp.float32) - lr * (g + momentum * new_mom)).astype(weight.dtype), new_mom
+
+
+@register("adam_update")
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    step = lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return (weight.astype(jnp.float32) - step).astype(weight.dtype), new_mean, new_var
+
+
+@register("adamw_update")
+def adamw_update(weight, grad, mean, var, lr, eta=1.0, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight.astype(jnp.float32)
+    step = eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * w32)
+    return (w32 - step).astype(weight.dtype), new_mean, new_var
+
+
+@register("rmsprop_update")
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(new_n) + epsilon)
+    if clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), new_n
+
+
+@register("rmspropalex_update")
+def rmspropalex_update(weight, grad, n, g_avg, delta, lr, gamma1=0.95, gamma2=0.9,
+                       epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_gavg = gamma1 * g_avg + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_gavg) + epsilon)
+    return (weight.astype(jnp.float32) + new_delta).astype(weight.dtype), new_n, new_gavg, new_delta
+
+
+@register("ftrl_update")
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * w32
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(w32),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    return (weight.astype(jnp.float32) - lr * jnp.sign(g)).astype(weight.dtype)
+
+
+@register("signum_update")
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, wd_lh=0.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w32 = weight.astype(jnp.float32)
+    w = (1 - lr * wd_lh) * w32 + lr * jnp.sign(new_mom)
+    return w.astype(weight.dtype), new_mom
+
+
+@register("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_hat, v_hat = new_mean, new_var
+    if bias_correction:
+        m_hat = new_mean / (1 - beta1 ** t)
+        v_hat = new_var / (1 - beta2 ** t)
+    update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight.astype(jnp.float32)
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2")
+def lamb_update_phase2(weight, g_update, r1, r2, lr, lower_bound=-1.0, upper_bound=-1.0):
+    r1 = jnp.where(r1 > 0, r1, jnp.ones_like(r1))
+    r2 = jnp.where(r2 > 0, r2, jnp.ones_like(r2))
+    trust = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, jnp.ones_like(r1))
+    if lower_bound > 0:
+        trust = jnp.maximum(trust, lower_bound)
+    if upper_bound > 0:
+        trust = jnp.minimum(trust, upper_bound)
+    return (weight.astype(jnp.float32) - lr * trust * g_update).astype(weight.dtype)
+
+
+@register("lamb_update")
+def lamb_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                t=1, bias_correction=True, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lower_bound=-1.0, upper_bound=-1.0):
+    """Fused full LAMB step (phase1+phase2 in one XLA computation)."""
+    update, new_mean, new_var = lamb_update_phase1(
+        weight, grad, mean, var, beta1, beta2, epsilon, t, bias_correction,
+        wd, rescale_grad, clip_gradient)
+    r1 = jnp.sqrt(jnp.sum(jnp.square(weight.astype(jnp.float32))))
+    r2 = jnp.sqrt(jnp.sum(jnp.square(update)))
+    w = lamb_update_phase2(weight, update, r1, r2, lr, lower_bound, upper_bound)
+    return w, new_mean, new_var
+
+
+@register("adagrad_update")
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    new_hist = history + jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * g * lax.rsqrt(new_hist + epsilon)
+    return w.astype(weight.dtype), new_hist
+
+
+@register("mp_sgd_update")
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update")
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32

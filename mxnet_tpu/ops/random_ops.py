@@ -1,0 +1,93 @@
+"""Sampling ops (reference: `src/operator/random/sample_op.cc`,
+`multisample_op.cc`). Keys come from mxnet_tpu.random — global state eagerly,
+fold-in scoped keys under tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+from .. import random as _random
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register("_random_uniform")
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32"):
+    return jax.random.uniform(
+        _random.next_key(), _shape(shape), jnp.dtype(dtype), low, high)
+
+
+@register("_random_normal")
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return loc + scale * jax.random.normal(_random.next_key(), _shape(shape), jnp.dtype(dtype))
+
+
+@register("_random_gamma")
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return beta * jax.random.gamma(_random.next_key(), alpha, _shape(shape), jnp.dtype(dtype))
+
+
+@register("_random_exponential")
+def random_exponential(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.exponential(_random.next_key(), _shape(shape), jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson")
+def random_poisson(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.poisson(_random.next_key(), lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial")
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
+    key1, key2 = jax.random.split(_random.next_key())
+    rate = jax.random.gamma(key1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(key2, rate, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@register("_random_randint")
+def random_randint(low=0, high=1, shape=None, dtype="int32"):
+    return jax.random.randint(_random.next_key(), _shape(shape), low, high, jnp.dtype(dtype))
+
+
+@register("_sample_multinomial")
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    # data: (..., k) probabilities; draws `shape` samples per distribution.
+    n = 1
+    out_shape = _shape(shape)
+    for s in out_shape:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    samples = jax.random.categorical(
+        _random.next_key(), logits, axis=-1,
+        shape=(max(n, 1),) + data.shape[:-1])
+    samples = jnp.moveaxis(samples, 0, -1)
+    samples = samples.reshape(data.shape[:-1] + out_shape) if out_shape else samples[..., 0]
+    samples = samples.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, -1),
+            samples.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32), -1
+        ).reshape(samples.shape)
+        return samples, logp
+    return samples
+
+
+@register("shuffle")
+def shuffle(data):
+    return jax.random.permutation(_random.next_key(), data, axis=0)
+
+
+@register("_sample_unique_zipfian")
+def sample_unique_zipfian(range_max, shape=None):
+    # Approximation: Zipfian via exponentiated uniform (used by sampled softmax).
+    u = jax.random.uniform(_random.next_key(), _shape(shape))
+    out = jnp.exp(u * jnp.log(float(range_max) + 1.0)).astype(jnp.int64) - 1
+    return jnp.clip(out, 0, range_max - 1)

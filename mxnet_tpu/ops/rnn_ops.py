@@ -1,0 +1,148 @@
+"""Fused RNN op.
+
+Reference: `src/operator/rnn.cc` + `src/operator/nn/cudnn/cudnn_rnn-inl.h`
+(cuDNN fused multi-layer LSTM/GRU/vanilla RNN). TPU-native: `lax.scan` over
+time with the per-step cell as one fused XLA computation; weights are packed
+in cuDNN order to keep `mx.nd.RNN` argument compatibility.
+
+Layout matches MXNet: data (seq_len, batch, input_size) when layout='TNC'.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def unpack_rnn_params(params, mode, num_layers, input_size, state_size,
+                      bidirectional=False):
+    """Split the flat cuDNN-ordered parameter vector into per-layer weights.
+
+    cuDNN order (reference `cudnn_rnn-inl.h`): for each layer, all input
+    weights (gate-major), then all recurrent weights; all biases follow all
+    weights, in the same order (two bias vectors per gate: b_i, b_h).
+    """
+    ngates = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    layers = []
+    off = 0
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            isz = input_size if layer == 0 else state_size * dirs
+            wi = lax.dynamic_slice(params, (off,), (ngates * state_size * isz,)).reshape(ngates * state_size, isz)
+            off += ngates * state_size * isz
+            wh = lax.dynamic_slice(params, (off,), (ngates * state_size * state_size,)).reshape(ngates * state_size, state_size)
+            off += ngates * state_size * state_size
+            layers.append({"wi": wi, "wh": wh})
+    for layer in range(num_layers):
+        for d in range(dirs):
+            ent = layers[layer * dirs + d]
+            ent["bi"] = lax.dynamic_slice(params, (off,), (ngates * state_size,))
+            off += ngates * state_size
+            ent["bh"] = lax.dynamic_slice(params, (off,), (ngates * state_size,))
+            off += ngates * state_size
+    return layers
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False):
+    ngates = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        size += dirs * ngates * state_size * (isz + state_size + 2)
+    return size
+
+
+def _lstm_cell(x, h, c, wi, wh, bi, bh):
+    z = x @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_cell(x, h, wi, wh, bi, bh):
+    zi = x @ wi.T + bi
+    zh = h @ wh.T + bh
+    ri, ui, ni = jnp.split(zi, 3, axis=-1)
+    rh, uh, nh = jnp.split(zh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    u = jax.nn.sigmoid(ui + uh)
+    n = jnp.tanh(ni + r * nh)
+    return (1 - u) * n + u * h
+
+
+def _vanilla_cell(x, h, wi, wh, bi, bh, act):
+    return act(x @ wi.T + h @ wh.T + bi + bh)
+
+
+def _run_layer(x, layer, mode, h0, c0, reverse=False):
+    """x: (T, N, I) → (T, N, state_size)."""
+    wi, wh, bi, bh = layer["wi"], layer["wh"], layer["bi"], layer["bh"]
+    if reverse:
+        x = jnp.flip(x, axis=0)
+
+    if mode == "lstm":
+        def step(carry, xt):
+            h, c = carry
+            h, c = _lstm_cell(xt, h, c, wi, wh, bi, bh)
+            return (h, c), h
+        (hT, cT), ys = lax.scan(step, (h0, c0), x)
+        extra = (hT, cT)
+    elif mode == "gru":
+        def step(h, xt):
+            h = _gru_cell(xt, h, wi, wh, bi, bh)
+            return h, h
+        hT, ys = lax.scan(step, h0, x)
+        extra = (hT, None)
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        def step(h, xt):
+            h = _vanilla_cell(xt, h, wi, wh, bi, bh, act)
+            return h, h
+        hT, ys = lax.scan(step, h0, x)
+        extra = (hT, None)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, extra
+
+
+@register("RNN")
+def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, layout="TNC", _training=None):
+    """Fused multi-layer (bi)RNN. Returns output or (output, h_n[, c_n])."""
+    if layout == "NTC":
+        data = jnp.swapaxes(data, 0, 1)
+    T, N, I = data.shape
+    dirs = 2 if bidirectional else 1
+    layers = unpack_rnn_params(parameters, mode, num_layers, I, state_size, bidirectional)
+
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            ent = layers[layer * dirs + d]
+            h0 = state[layer * dirs + d]
+            c0 = state_cell[layer * dirs + d] if mode == "lstm" else None
+            ys, (hT, cT) = _run_layer(x, ent, mode, h0, c0, reverse=(d == 1))
+            outs.append(ys)
+            h_finals.append(hT)
+            if mode == "lstm":
+                c_finals.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+    out = x if layout == "TNC" else jnp.swapaxes(x, 0, 1)
+    if not state_outputs:
+        return out
+    h_n = jnp.stack(h_finals, axis=0)
+    if mode == "lstm":
+        return out, h_n, jnp.stack(c_finals, axis=0)
+    return out, h_n

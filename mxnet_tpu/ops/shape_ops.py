@@ -1,0 +1,290 @@
+"""Shape-manipulation and indexing ops.
+
+Reference coverage: `src/operator/tensor/matrix_op.cc` (reshape/transpose/
+slice/concat/...), `indexing_op.cc` (take/gather_nd/scatter_nd/one_hot),
+`src/operator/sequence_*.cc`, `src/operator/tensor/init_op.cc`. All static
+shape, XLA-friendly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import register, alias
+
+
+@register("reshape")
+def reshape(data, shape=None):
+    # Support MXNet's special codes 0 (copy dim) and -1 (infer). The exotic
+    # -2/-3/-4 codes are handled at the NDArray layer if ever needed.
+    if shape is None:
+        return data
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(data.shape[i])
+        else:
+            out.append(s)
+    return jnp.reshape(data, tuple(out))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is None or len(axes) == 0:
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes")
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("flatten")
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape):
+    shape = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis")
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("tile")
+def tile(data, reps):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad")
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    # MXNet pad_width is a flat tuple (before0, after0, before1, after1, ...)
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("concat")
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+alias("Concat", "concat")
+
+
+@register("split")
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+alias("SliceChannel", "split")
+
+
+@register("slice")
+def slice_op(data, begin, end, step=None):
+    slices = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return data[tuple(slices)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis, begin, end):
+    if end is None:
+        end = data.shape[axis]
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("reverse")
+def reverse(data, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(data, axis=tuple(axis))
+
+
+alias("flip", "reverse")
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis, mode=mode)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    # indices: (M, ...) leading dim indexes into first M axes of data.
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    ind = indices.astype(jnp.int32)
+    oh = jnp.equal(ind[..., None], jnp.arange(depth)).astype(jnp.dtype(dtype))
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like")
+def full_like(data, fill_value):
+    return jnp.full_like(data, fill_value)
+
+
+# --------------------------------------------------------------------------
+# sequence ops (reference: `src/operator/sequence_mask.cc` et al.). MXNet
+# layout: (seq_len, batch, ...) unless use_sequence_length tensors say else.
+# --------------------------------------------------------------------------
+
+def _seq_mask(max_len, lengths):
+    return jnp.arange(max_len)[:, None] < lengths[None, :]
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis, batch_axis = (axis, 1 - axis) if axis in (0, 1) else (0, 1)
+    mask = _seq_mask(data.shape[seq_axis], sequence_length.astype(jnp.int32))
+    if seq_axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)  # (batch,)
+    moved = jnp.moveaxis(data, axis, 0)             # (seq, batch, ...)
+    batch = moved.shape[1]
+    return moved[last, jnp.arange(batch)]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    L = moved.shape[0]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    pos = jnp.arange(L)[:, None]
+    src = jnp.where(pos < lens, lens - 1 - pos, pos)  # (L, batch)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0
+    )
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # Dynamic-shape op in the reference (`src/operator/contrib/boolean_mask.cc`).
+    # XLA needs static shapes: we keep full length, moving selected rows to the
+    # front and zero-padding the tail; callers needing true compaction should
+    # run outside jit.
+    mask = index.astype(bool)
+    order = jnp.argsort(~mask, stable=True)
+    gathered = jnp.take(data, order, axis=axis)
+    keep = jnp.sort(mask)[::-1]
+    shape = [1] * data.ndim
+    shape[axis] = -1
+    return gathered * keep.reshape(shape).astype(data.dtype)

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels.
+
+The reference's hand-written CUDA/cuDNN kernels (SURVEY.md §2.1) map to XLA
+codegen for almost everything; the exceptions — attention (the reference's
+`src/operator/contrib/transformer.cc` fused ops) — live here as Pallas
+kernels, with a pure-jnp fallback for CPU test meshes.
+"""
+from .flash_attention import flash_attention, mha_reference
+
+__all__ = ["flash_attention", "mha_reference"]

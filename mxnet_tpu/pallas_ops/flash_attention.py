@@ -1,0 +1,205 @@
+"""Flash attention for TPU (Pallas).
+
+Replaces the reference's fused attention ops
+(`src/operator/contrib/transformer.cc` `_contrib_interleaved_matmul_selfatt_*`)
+with a blockwise online-softmax kernel: O(L) memory instead of the L×L score
+matrix, MXU-sized tiles, f32 accumulation over bf16 inputs.
+
+Layout convention here: (batch, heads, seq, head_dim).
+
+Forward is a Pallas kernel on TPU; backward is the standard flash residual
+formulation (recompute P from saved LSE) expressed in jnp — XLA fuses it well
+at BERT-scale sequence lengths. CPU test meshes use the pure-jnp reference so
+the whole framework tests under `--xla_force_host_platform_device_count`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+    """Pure-XLA multi-head attention. q,k,v: (B, H, L, D); bias: (B, 1|H, 1|Lq, Lk)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        Lq, Lk = s.shape[-2], s.shape[-1]
+        row = jnp.arange(Lq)[:, None] + (Lk - Lq)
+        col = jnp.arange(Lk)[None, :]
+        s = jnp.where(col <= row, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# --------------------------------------------------------------------------
+# pallas forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_q, block_k, kv_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, D)
+    num_kb = kv_len // block_k
+    if causal:
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_kb)
+    else:
+        hi = num_kb
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (block_q, block_k)
+        s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]  # (1, block_k) bcast
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:, :] = (m + jnp.log(l)).T
+
+
+try:  # pallas import is deferred so CPU-only environments still import us
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    qr = q.reshape(B * H, Lq, D)
+    kr = k.reshape(B * H, Lk, D)
+    vr = v.reshape(B * H, Lk, D)
+    biasr = jnp.broadcast_to(bias[:, None, :], (B, H, Lk)).reshape(B * H, Lk)
+    grid = (B * H, Lq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, kv_len=Lk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Lk), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Lq), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(qr, kr, vr, biasr)
+    return out.reshape(B, H, Lq, D), lse.reshape(B, H, Lq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    out, _ = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v, bias, out, lse = res
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
+    s = s + bias[:, None, None, :]
+    if causal:
+        row = jnp.arange(Lq)[:, None]
+        col = jnp.arange(Lk)[None, :]
+        s = jnp.where(col <= row, s, _NEG)
+    p = jnp.exp(s - lse[..., None])                       # (B,H,Lq,Lk) f32
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(bias))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
+                    block_q=256, block_k=256):
+    """Multi-head attention, flash-style.
+
+    Args:
+      q, k, v: (batch, heads, seq, head_dim). bf16 or f32.
+      mask: optional (batch, kv_seq) — True/1 where attendable (padding mask).
+      causal: apply causal masking.
+    Returns (batch, heads, q_seq, head_dim), q.dtype.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+
+    use_pallas = _HAS_PALLAS and jax.default_backend() == "tpu"
+    if not use_pallas:
+        bias = None
+        if mask is not None:
+            bias = jnp.where(mask.astype(bool), 0.0, _NEG)[:, None, None, :]
+        return mha_reference(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale)
+
+    block_q = min(block_q, _round_up(Lq, 128))
+    block_k = min(block_k, _round_up(Lk, 128))
+    Lq_p, Lk_p = _round_up(Lq, block_q), _round_up(Lk, block_k)
+    if mask is not None:
+        bias = jnp.where(mask.astype(bool), 0.0, _NEG).astype(jnp.float32)
+    else:
+        bias = jnp.zeros((B, Lk), jnp.float32)
+    if Lk_p != Lk:
+        bias = jnp.pad(bias, ((0, 0), (0, Lk_p - Lk)), constant_values=_NEG)
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, Lk_p - Lk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, Lk_p - Lk), (0, 0)))
+    if Lq_p != Lq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Lq_p - Lq), (0, 0)))
+    out = _flash(q, k, v, bias, causal, sm_scale, block_q, block_k)
+    if Lq_p != Lq:
+        out = out[:, :, :Lq]
+    return out

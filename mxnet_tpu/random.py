@@ -1,0 +1,62 @@
+"""Random state management.
+
+The reference keeps per-device RNG resources handed to ops by the
+ResourceManager (`src/resource.cc`, `include/mxnet/resource.h`); frontend
+seeding is `mx.random.seed` (`python/mxnet/random.py`). Here the equivalent is
+a process-global jax PRNG key that ops split from.
+
+Traced code (hybridized blocks, jitted train steps) must NOT capture a
+concrete key — that would bake one dropout mask into the compiled program. A
+`key_scope(key)` context makes `next_key()` derive deterministically from a
+*traced* key via `fold_in` of a call counter, so compiled programs get fresh
+randomness through an ordinary argument.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "key_scope", "get_state"]
+
+_local = threading.local()
+_global = {"key": jax.random.key(0), "lock": threading.Lock()}
+
+
+def seed(seed_state):
+    """Seed the global RNG (reference: `mx.random.seed`)."""
+    _global["key"] = jax.random.key(int(seed_state))
+
+
+def get_state():
+    return _global["key"]
+
+
+class key_scope:
+    """Within this scope, `next_key()` folds a counter into `key` instead of
+    consuming global state — safe under jax tracing."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        stack = getattr(_local, "scopes", None)
+        if stack is None:
+            stack = _local.scopes = []
+        stack.append([self.key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _local.scopes.pop()
+        return False
+
+
+def next_key():
+    stack = getattr(_local, "scopes", None)
+    if stack:
+        entry = stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    with _global["lock"]:
+        _global["key"], sub = jax.random.split(_global["key"])
+        return sub

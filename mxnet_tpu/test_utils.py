@@ -1,0 +1,104 @@
+"""Test oracles (reference: `python/mxnet/test_utils.py`).
+
+The two universal oracles of the reference test suite (SURVEY.md §4):
+`check_numeric_gradient` (finite differences vs autograd backward) and
+`check_consistency` (same op, different execution paths cross-compared —
+here: eager vs jit vs f64 numpy where applicable).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import autograd
+from .ndarray import NDArray
+
+__all__ = ["assert_almost_equal", "check_numeric_gradient", "check_consistency",
+           "default_rtol_atol", "rand_ndarray"]
+
+
+def default_rtol_atol(dtype):
+    dt = np.dtype(dtype)
+    if dt.itemsize == 2:  # float16 / bfloat16
+        return 1e-2, 1e-2
+    if dt == np.float32:
+        return 1e-4, 1e-5
+    return 1e-6, 1e-8
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a, b = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        r, t = default_rtol_atol(a.dtype)
+        rtol = rtol if rtol is not None else r
+        atol = atol if atol is not None else t
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} != {names[1]}")
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0):
+    return nd.array(np.random.normal(0, scale, size=shape).astype(dtype))
+
+
+def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference check of `f`'s backward.
+
+    f: callable taking NDArrays, returning a single NDArray output.
+    inputs: list of numpy arrays (float32 recommended; computed in f64 FD).
+    """
+    arrs = [nd.array(x.astype(np.float32)) for x in inputs]
+    for a in arrs:
+        a.attach_grad()
+    with autograd.record():
+        out = f(*arrs)
+        loss = out.sum() if out.shape != () else out
+    loss.backward()
+    sym_grads = [a.grad.asnumpy() for a in arrs]
+
+    def fval(xs):
+        with autograd.pause():
+            return float(f(*[nd.array(x.astype(np.float32)) for x in xs]).sum().asscalar())
+
+    for i, x in enumerate(inputs):
+        num = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        nflat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            xs = [v.copy() for v in inputs]
+            xs[i].reshape(-1)[j] = orig + eps
+            fp = fval(xs)
+            xs[i].reshape(-1)[j] = orig - eps
+            fm = fval(xs)
+            nflat[j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(
+            sym_grads[i], num, rtol=rtol, atol=atol,
+            err_msg=f"numeric vs autograd gradient mismatch for input {i}")
+
+
+def check_consistency(f, inputs, rtol=1e-5, atol=1e-6):
+    """Run `f` eagerly and under jax.jit and compare outputs (the TPU-native
+    analog of the reference's cpu-vs-gpu-vs-cudnn `check_consistency`)."""
+    import jax
+
+    arrs = [nd.array(x) for x in inputs]
+    eager = f(*arrs)
+    eager_np = [_to_np(o) for o in (eager if isinstance(eager, (list, tuple)) else [eager])]
+
+    def pure(*datas):
+        outs = f(*[NDArray(d) for d in datas])
+        if isinstance(outs, (list, tuple)):
+            return tuple(o._data for o in outs)
+        return outs._data
+
+    jitted = jax.jit(pure)(*[a._data for a in arrs])
+    jit_np = [np.asarray(o) for o in (jitted if isinstance(jitted, tuple) else [jitted])]
+    for e, j in zip(eager_np, jit_np):
+        np.testing.assert_allclose(e, j, rtol=rtol, atol=atol,
+                                   err_msg="eager vs jit inconsistency")

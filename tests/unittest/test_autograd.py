@@ -1,0 +1,147 @@
+"""Autograd tests (reference: `tests/python/unittest/test_autograd.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * y + x
+        w = z.sum()
+    w.backward()
+    # dz/dx = 8x + 1
+    assert_almost_equal(x.grad, 8 * x.asnumpy() + 1)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy())
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_reused_input():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()  # x used twice through two muls
+    y.backward()
+    assert_almost_equal(x.grad, 3 * x.asnumpy() ** 2)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [4.0, 4.0])
+
+
+def test_pause_and_detach():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 5  # not recorded
+        w = (y + z.detach()).sum()
+    w.backward()
+    assert_almost_equal(x.grad, [2.0, 2.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100,))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert_almost_equal(y, np.ones(100))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert not np.allclose(y.asnumpy(), np.ones(100))
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 2).sum()
+    g = autograd.grad(y, x)[0]
+    assert_almost_equal(g, 2 * x.asnumpy())
+    assert_almost_equal(x.grad, np.zeros(2))  # untouched by grad()
+
+
+def test_numeric_gradient_ops():
+    check_numeric_gradient(lambda a: nd.tanh(a), [np.random.normal(size=(3, 2))])
+    check_numeric_gradient(lambda a: nd.sigmoid(a) * a, [np.random.normal(size=(4,))])
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b),
+        [np.random.normal(size=(3, 4)), np.random.normal(size=(4, 2))])
+    check_numeric_gradient(
+        lambda a: nd.softmax(a, axis=-1).log().sum(),
+        [np.random.normal(size=(2, 5))])
+
+
+def test_multi_output_op_grad():
+    x = np.random.normal(size=(6, 4)).astype(np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        p1, p2 = nd.split(a, num_outputs=2, axis=0)
+        loss = (p1 * 2).sum() + (p2 * 3).sum()
+    loss.backward()
+    expect = np.concatenate([np.full((3, 4), 2.0), np.full((3, 4), 3.0)])
+    assert_almost_equal(a.grad, expect)
+
+
+def test_mutation_guard():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    try:
+        y += 1
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass

@@ -1,0 +1,166 @@
+"""NDArray facade tests (reference: `tests/python/unittest/test_ndarray.py`)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32
+    assert_almost_equal(a, np.zeros((2, 3)))
+    assert_almost_equal(nd.ones((2,)), np.ones((2,)))
+    assert_almost_equal(nd.full((2, 2), 3.5), np.full((2, 2), 3.5))
+    assert_almost_equal(nd.arange(0, 10, 2), np.arange(0, 10, 2, dtype=np.float32))
+    assert nd.array([1, 2, 3]).dtype == np.int32 or nd.array([1, 2, 3]).dtype == np.int64
+    assert nd.array([1.0, 2.0]).dtype == np.float32
+
+
+def test_elementwise_arith():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, [[6, 8], [10, 12]])
+    assert_almost_equal(a - b, [[-4, -4], [-4, -4]])
+    assert_almost_equal(a * b, [[5, 12], [21, 32]])
+    assert_almost_equal(b / a, [[5, 3], [7 / 3, 2]])
+    assert_almost_equal(a + 1, [[2, 3], [4, 5]])
+    assert_almost_equal(2 - a, [[1, 0], [-1, -2]])
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(2 ** a, [[2, 4], [8, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+    assert_almost_equal(abs(nd.array([-1.0, 2.0])), [1, 2])
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a == b, [0, 1, 0])
+    assert_almost_equal(a != b, [1, 0, 1])
+    assert_almost_equal(a > b, [0, 0, 1])
+    assert_almost_equal(a >= 2, [0, 1, 1])
+    assert_almost_equal(a < b, [1, 0, 0])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    orig = a
+    a += 2
+    assert orig is a
+    assert_almost_equal(a, np.full((2, 2), 3.0))
+    a *= 2
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+    a /= 3
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert_almost_equal(a[0], np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], [20, 21, 22, 23])
+    assert_almost_equal(a[:, 1:3], np.arange(24).reshape(2, 3, 4)[:, 1:3])
+    a[0, 0, 0] = 100.0
+    assert a[0, 0, 0].asscalar() == 100.0
+    a[1] = 0.0
+    assert_almost_equal(a[1], np.zeros((3, 4)))
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(6).astype(np.float32))
+    assert a.reshape(shape=(2, 3)).shape == (2, 3)
+    assert a.reshape(shape=(3, -1)).shape == (3, 2)
+    b = a.reshape(shape=(2, 3))
+    assert_almost_equal(b.T, b.asnumpy().T)
+    assert b.transpose().shape == (3, 2)
+    c = nd.zeros((2, 3, 4))
+    assert nd.transpose(c, axes=(2, 0, 1)).shape == (4, 2, 3)
+    assert nd.swapaxes(c, 0, 2).shape == (4, 3, 2)
+    assert nd.expand_dims(c, axis=1).shape == (2, 1, 3, 4)
+    assert c.flatten().shape == (2, 12)
+
+
+def test_reduce():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=1), x.sum(1))
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean((0, 2)))
+    assert_almost_equal(a.max(axis=1, keepdims=True), x.max(1, keepdims=True))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.argmax(a, axis=2), np.argmax(x, 2).astype(np.float32))
+    assert_almost_equal(nd.norm(a), np.sqrt((x ** 2).sum()), rtol=1e-4)
+
+
+def test_dot():
+    x = np.random.normal(size=(4, 5)).astype(np.float32)
+    y = np.random.normal(size=(5, 3)).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True), x @ y, rtol=1e-4, atol=1e-4)
+    bx = np.random.normal(size=(2, 4, 5)).astype(np.float32)
+    by = np.random.normal(size=(2, 5, 3)).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)), bx @ by,
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=1).shape == (2, 6)
+    parts = nd.split(nd.array(np.arange(12).reshape(4, 3).astype(np.float32)),
+                     num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+
+
+def test_take_one_hot_where():
+    w = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = nd.array([0, 2])
+    assert_almost_equal(nd.take(w, idx), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    assert_almost_equal(oh, [[1, 0, 0], [0, 0, 1]])
+    cond = nd.array([1.0, 0.0])
+    assert_almost_equal(nd.where(cond, nd.array([1.0, 2.0]), nd.array([3.0, 4.0])), [1, 4])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+    a = nd.array(x)
+    idx = nd.topk(a, k=2)
+    assert_almost_equal(idx, [[0, 2], [1, 2]])
+    vals, idx2 = nd.topk(a, k=2, ret_typ="both")
+    assert_almost_equal(vals, [[3, 2], [5, 4]])
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(x, 1))
+    assert_almost_equal(nd.argsort(a, axis=1), np.argsort(x, 1).astype(np.float32))
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], np.ones((2, 2)))
+    nd.save(f, [nd.ones((1,)), nd.zeros((2,))])
+    ls = nd.load(f)
+    assert isinstance(ls, list) and len(ls) == 2
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, np.ones((2, 2)))
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == "cpu"
+
+
+def test_wait_and_repr():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    assert "NDArray 2x2" in repr(a)
+    nd.waitall()
