@@ -1,0 +1,13 @@
+"""Gluon API (reference: `python/mxnet/gluon/`)."""
+from .parameter import Parameter, ParameterDict, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, Sequential, HybridSequential
+from . import nn
+from . import loss
+from . import data
+from . import utils
+from .trainer import Trainer
+from . import rnn
+
+__all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
+           "Sequential", "HybridSequential", "nn", "loss", "data", "utils",
+           "Trainer", "rnn", "DeferredInitializationError"]

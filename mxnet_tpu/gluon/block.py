@@ -1,0 +1,342 @@
+"""Block / HybridBlock.
+
+Reference: `python/mxnet/gluon/block.py`. The reference's `hybridize()` traces
+Python forward into an NNVM graph executed by `CachedOp`
+(`src/imperative/cached_op.cc`); here `hybridize()` builds a **shape-keyed
+`jax.jit` cache**: one fused XLA computation per (input shapes/dtypes,
+train-flag) key — the whole block becomes a single device program, which is
+the TPU-idiomatic replacement for both GraphExecutor and CachedOp
+(SURVEY.md §7.1).
+
+Functionalization: under trace, each Parameter's buffer is temporarily
+rebound to a tracer, the user's `hybrid_forward` runs unchanged, and aux
+state (e.g. BatchNorm running stats, grad_req='null') is harvested as extra
+outputs then written back eagerly after the compiled call — so mutable-state
+semantics survive jit.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from .. import _engine
+from .. import ndarray as nd_mod
+from .. import random as _random
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "Sequential", "HybridSequential", "nn"]
+
+
+class Block:
+    """Base neural-network building block (imperative)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+        self.prefix = prefix or ""
+
+    # -- attribute registration ----------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", {})[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+        return block
+
+    @property
+    def params(self):
+        d = ParameterDict()
+        for name, p in self._reg_params.items():
+            d[name] = p
+        return d
+
+    def collect_params(self, select=None):
+        """All parameters in this subtree, keyed by dotted path."""
+        import re
+        out = ParameterDict()
+        for path, p in self._iter_params():
+            if select is None or re.search(select, path):
+                out[path] = p
+        return out
+
+    def _iter_params(self, prefix=""):
+        for name, p in self._reg_params.items():
+            yield prefix + name, p
+        for cname, child in self._children.items():
+            yield from child._iter_params(prefix + cname + ".")
+
+    @contextlib.contextmanager
+    def name_scope(self):
+        """Kept for reference API compatibility; naming is attribute-path based."""
+        yield self
+
+    # -- lifecycle ------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for _, p in self._iter_params():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for _, p in self._iter_params():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already covered by _iter_params
+        self._clear_cache()
+        return self
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def _clear_cache(self):
+        pass
+
+    def save_parameters(self, filename, deduplicate=False):
+        self.collect_params().save(filename)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        self.collect_params().load(filename, ctx=ctx, allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra)
+
+    # -- hooks ----------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    # -- call path ------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self._children.items():
+            body = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {body}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class HybridBlock(Block):
+    """Block that can be compiled to one XLA computation per input signature."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cache = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False, **kwargs):
+        self._active = active
+        self._cache = {}
+        super().hybridize(active, **kwargs)
+
+    def _clear_cache(self):
+        self._cache = {}
+        for child in self._children.values():
+            child._clear_cache()
+
+    def infer_shape(self, *args):
+        """Run deferred-shape resolution without compiling (eager pass)."""
+        self.forward(*args)
+
+    # -- eager path: hybrid_forward with params as kwargs ----------------
+    def forward(self, *args, **kwargs):
+        pkwargs = {}
+        for name, p in self._reg_params.items():
+            try:
+                pkwargs[name] = p.data()
+            except DeferredInitializationError:
+                self._deferred_infer_shape(name, p, args)
+                pkwargs[name] = p.data()
+        return self.hybrid_forward(nd_mod, *args, **pkwargs, **kwargs)
+
+    def _deferred_infer_shape(self, name, param, args):
+        """Layers override `infer_param_shapes` to complete deferred dims."""
+        shapes = self.infer_param_shapes(
+            *[a.shape if isinstance(a, NDArray) else None for a in args])
+        if name not in shapes:
+            raise DeferredInitializationError(
+                f"cannot infer shape of parameter '{name}'")
+        param._finish_deferred_init(shapes[name])
+
+    def infer_param_shapes(self, *in_shapes):
+        raise DeferredInitializationError(
+            f"{type(self).__name__} does not support deferred init")
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- compiled path ---------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not self._active or kwargs or not all(isinstance(a, NDArray) for a in args):
+            return super().__call__(*args, **kwargs)
+        try:
+            return self._call_cached(args)
+        except DeferredInitializationError:
+            # first call resolves deferred shapes eagerly (reference behavior)
+            return super().__call__(*args)
+
+    def _param_lists(self):
+        grad_params, aux_params = [], []
+        for path, p in self._iter_params():
+            d = p.data()  # raises DeferredInitializationError if not ready
+            if p.grad_req == "null":
+                aux_params.append((path, p))
+            else:
+                grad_params.append((path, p))
+        return grad_params, aux_params
+
+    def _call_cached(self, args):
+        grad_params, aux_params = self._param_lists()
+        train = _engine.is_training()
+        key = (tuple((a.shape, str(a.dtype)) for a in args), train,
+               len(grad_params), len(aux_params))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build_cached(args, grad_params, aux_params, train)
+            self._cache[key] = entry
+        jitted, out_treedef = entry
+
+        gp_data = [p.data()._data for _, p in grad_params]
+        aux_data = [p.data()._data for _, p in aux_params]
+        in_data = [a._data for a in args]
+        rng = _random.next_key()
+
+        out_flat, new_aux = jitted(gp_data, aux_data, rng, *in_data)
+        for (_, p), v in zip(aux_params, new_aux):
+            p.data()._data = v
+
+        outs = [NDArray(o) for o in out_flat]
+        if _engine.is_recording():
+            def record_fn(*arrs, _n=len(gp_data)):
+                o, _ = jitted(list(arrs[:_n]), aux_data, rng, *arrs[_n:])
+                return tuple(o)
+            parents = [("leaf", p.data()) for _, p in grad_params]
+            for a in args:
+                if a._node is not None:
+                    parents.append(("node",) + a._node)
+                else:
+                    parents.append(("leaf", a))
+            _engine.record_op(record_fn, tuple(gp_data) + tuple(in_data),
+                              parents, outs)
+        return jax.tree.unflatten(out_treedef, outs)
+
+    def _build_cached(self, args, grad_params, aux_params, train):
+        """Trace self.forward into one jitted function (the CachedOp build)."""
+        treedef_box = {}
+
+        def pure(gp_data, aux_data, rng, *in_data):
+            saved = []
+            for (_, p), d in list(zip(grad_params, gp_data)) + list(zip(aux_params, aux_data)):
+                saved.append((p, p._data._data))
+                p._data._data = d
+            prev_rec = _engine.set_recording(False)
+            prev_train = _engine.set_training(train)
+            try:
+                with _random.key_scope(rng):
+                    out = self.forward(*[NDArray(d) for d in in_data])
+                new_aux = [p._data._data for _, p in aux_params]
+            finally:
+                _engine.set_recording(prev_rec)
+                _engine.set_training(prev_train)
+                for p, orig in saved:
+                    p._data._data = orig
+            out_flat, treedef = jax.tree.flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            treedef_box["td"] = treedef
+            out_data = [o._data if isinstance(o, NDArray) else jnp.asarray(o)
+                        for o in out_flat]
+            return out_data, new_aux
+
+        # abstract probe run: fills treedef_box, validates shapes, no compile
+        jax.eval_shape(pure,
+                       [p.data()._data for _, p in grad_params],
+                       [p.data()._data for _, p in aux_params],
+                       jax.random.key(0),
+                       *[a._data for a in args])
+        return jax.jit(pure), treedef_box["td"]
+
+    def export(self, path, epoch=0):
+        """Serialize params (graph export is subsumed by jit re-trace on load;
+        reference: `HybridBlock.export` symbol-json + params)."""
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+
+
+class Sequential(Block):
+    """Imperative container (reference: gluon.nn.Sequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, idx):
+        return list(self._children.values())[idx]
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable container (reference: gluon.nn.HybridSequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        # containers don't have own params; route through children directly
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, idx):
+        return list(self._children.values())[idx]

@@ -1,0 +1,9 @@
+"""`gluon.data` (reference: `python/mxnet/gluon/data/`)."""
+from .dataset import Dataset, ArrayDataset, SimpleDataset
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .dataloader import DataLoader
+from . import vision
+
+__all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "Sampler",
+           "SequentialSampler", "RandomSampler", "BatchSampler", "DataLoader",
+           "vision"]

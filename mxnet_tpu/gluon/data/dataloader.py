@@ -1,0 +1,82 @@
+"""DataLoader (reference: `python/mxnet/gluon/data/dataloader.py`).
+
+The reference forks `num_workers` Python processes with shared-memory NDArray
+return. TPU-native: decode/augment is host CPU work feeding one device queue,
+so we use a thread pool (numpy releases the GIL for the heavy parts) plus a
+double-buffered prefetcher — the same structure as the reference's
+`PrefetcherIter` (`src/io/iter_prefetcher.h`) without the process boundary.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...ndarray import ndarray as _nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    if isinstance(data[0], NDArray):
+        return _nd.array(np.stack([d.asnumpy() for d in data]))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return _nd.array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded prefetch pipeline
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = queue.Queue()
+            batches = iter(self._batch_sampler)
+            stop = object()
+
+            def submitter():
+                for indices in batches:
+                    futures.put(pool.submit(self._load_batch, indices))
+                futures.put(stop)
+
+            t = threading.Thread(target=submitter, daemon=True)
+            t.start()
+            while True:
+                fut = futures.get()
+                if fut is stop:
+                    break
+                yield fut.result()
+            t.join()

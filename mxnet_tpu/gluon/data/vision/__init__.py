@@ -1,0 +1,6 @@
+"""Vision datasets + transforms (reference: `gluon/data/vision/`)."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset
+from . import transforms
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "transforms"]

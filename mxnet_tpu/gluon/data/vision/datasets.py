@@ -1,0 +1,151 @@
+"""Vision datasets (reference: `python/mxnet/gluon/data/vision/datasets.py`).
+
+This build runs in zero-egress environments: datasets read standard files
+from `root` when present (idx-gzip for MNIST, pickle batches for CIFAR) and
+otherwise fall back to a deterministic synthetic sample so examples/tests run
+anywhere. The synthetic fallback is clearly logged.
+"""
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import struct
+
+import numpy as np
+
+from ..dataset import Dataset
+from ....ndarray import ndarray as _nd
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset"]
+
+logger = logging.getLogger("mxnet_tpu")
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __len__(self):
+        return len(self._label)
+
+    def __getitem__(self, idx):
+        data = _nd.array(self._data[idx])
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+def _synthetic(shape, num_classes, n, seed):
+    rng = np.random.RandomState(seed)
+    data = (rng.rand(n, *shape) * 255).astype(np.uint8)
+    label = rng.randint(0, num_classes, size=n).astype(np.int32)
+    return data, label
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files in `root`, or synthetic fallback."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        False: ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        img_f, lbl_f = self._files[self._train]
+        img_path = os.path.join(self._root, img_f)
+        lbl_path = os.path.join(self._root, lbl_f)
+        if os.path.exists(img_path) and os.path.exists(lbl_path):
+            with gzip.open(lbl_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self._label = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
+            with gzip.open(img_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self._data = np.frombuffer(f.read(), dtype=np.uint8) \
+                    .reshape(n, rows, cols, 1)
+        else:
+            logger.warning("%s: files not found under %s — using synthetic data",
+                           type(self).__name__, self._root)
+            n = 1024 if self._train else 256
+            self._data, self._label = _synthetic(self._shape, self._classes, n,
+                                                 seed=42 if self._train else 43)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    _shape = (32, 32, 3)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        import pickle
+        batches = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        if all(os.path.exists(os.path.join(base, b)) for b in batches):
+            data, labels = [], []
+            for b in batches:
+                with open(os.path.join(base, b), "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                data.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                labels += list(d[b"labels"])
+            self._data = np.concatenate(data)
+            self._label = np.asarray(labels, dtype=np.int32)
+        else:
+            logger.warning("%s: files not found under %s — using synthetic data",
+                           type(self).__name__, self._root)
+            n = 1024 if self._train else 256
+            self._data, self._label = _synthetic(self._shape, self._classes, n,
+                                                 seed=44 if self._train else 45)
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", train=True,
+                 fine_label=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over a RecordIO pack (reference: ImageRecordDataset over
+    `tools/im2rec.py` output). Uses mxnet_tpu.io.recordio."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ....io import recordio
+        self._record = recordio.IndexedRecordIO(
+            os.path.splitext(filename)[0] + ".idx", filename, "r")
+        self._transform = transform
+        self._flag = flag
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        from ....io import recordio
+        raw = self._record.read_idx(self._record.keys[idx])
+        header, img_bytes = recordio.unpack(raw)
+        img = recordio.imdecode(img_bytes, self._flag)
+        label = header.label
+        data = _nd.array(img)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label

@@ -1,0 +1,117 @@
+"""Vision transforms (reference: `gluon/data/vision/transforms.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ...nn import HybridSequential
+from ....ndarray import NDArray
+from ....ndarray import ndarray as _nd
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom"]
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, axes=(2, 0, 1))
+        return F.transpose(x, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        return (x - _nd.array(self._mean)) / _nd.array(self._std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax.image
+        h, w = self._size[1], self._size[0]
+        data = x._data
+        if data.ndim == 3:
+            out = jax.image.resize(data.astype("float32"), (h, w, data.shape[2]),
+                                   method="linear")
+        else:
+            out = jax.image.resize(data.astype("float32"),
+                                   (data.shape[0], h, w, data.shape[3]),
+                                   method="linear")
+        return NDArray(out.astype(data.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0, x0 = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return x[..., y0:y0 + h, x0:x0 + w, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self._scale)
+            ratio = np.random.uniform(*self._ratio)
+            w = int(round(np.sqrt(target * ratio)))
+            h = int(round(np.sqrt(target / ratio)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w, :]
+                return Resize(self._size).forward(crop)
+        return Resize(self._size).forward(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=x.ndim - 2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            return x.flip(axis=x.ndim - 3)
+        return x

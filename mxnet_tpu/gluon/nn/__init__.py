@@ -1,0 +1,417 @@
+"""Gluon neural-network layers.
+
+Reference: `python/mxnet/gluon/nn/basic_layers.py`, `conv_layers.py`,
+`activations.py`. Each layer's `hybrid_forward` receives its parameters as
+kwargs (reference convention) and lowers to the pure op library — XLA fuses
+the op chain when the enclosing block is hybridized.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import Block, HybridBlock, Sequential, HybridSequential
+from ..parameter import Parameter
+from ...ndarray import NDArray
+
+__all__ = [
+    "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm", "Embedding", "Flatten",
+    "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
+    "Lambda", "HybridLambda", "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+    "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+    "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalAvgPool1D",
+    "GlobalAvgPool2D", "Block", "HybridBlock",
+]
+
+
+class Dense(HybridBlock):
+    """Fully connected layer (reference: gluon.nn.Dense → FullyConnected op)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self.act = activation
+        self.weight = Parameter("weight", shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer, allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(units,), dtype=dtype,
+                               init=bias_initializer) if use_bias else None)
+        self._use_bias = use_bias
+
+    def infer_param_shapes(self, x_shape, *rest):
+        in_units = int(np.prod(x_shape[1:])) if self._flatten else x_shape[-1]
+        return {"weight": (self._units, in_units)}
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=not self._use_bias, flatten=self._flatten)
+        if self.act:
+            out = F.Activation(out, act_type=self.act)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+
+class BatchNorm(HybridBlock):
+    """Batch norm with running stats as aux state (reference:
+    gluon.nn.BatchNorm over `src/operator/nn/batch_norm.cc`; in-place running
+    stat mutation becomes harvested aux outputs under jit — see block.py)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        shape = (in_channels,)
+        self.gamma = Parameter("gamma", shape=shape, init=gamma_initializer,
+                               grad_req="write" if scale else "null",
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=shape, init=beta_initializer,
+                              grad_req="write" if center else "null",
+                              allow_deferred_init=True)
+        self.running_mean = Parameter("running_mean", shape=shape,
+                                      init=running_mean_initializer,
+                                      grad_req="null", allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=shape,
+                                     init=running_variance_initializer,
+                                     grad_req="null", allow_deferred_init=True)
+
+    def infer_param_shapes(self, x_shape, *rest):
+        c = x_shape[self._axis]
+        return {"gamma": (c,), "beta": (c,), "running_mean": (c,),
+                "running_var": (c,)}
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        out, new_mean, new_var = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale, use_global_stats=self._use_global_stats,
+            axis=self._axis)
+        # write back aux state (raw-data rebind: not an autograd mutation)
+        self.running_mean.data()._data = new_mean._data
+        self.running_var.data()._data = new_var._data
+        return out
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, allow_deferred_init=True)
+
+    def infer_param_shapes(self, x_shape, *rest):
+        c = x_shape[self._axis]
+        return {"gamma": (c,), "beta": (c,)}
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, allow_deferred_init=True)
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"gamma": (x_shape[1],), "beta": (x_shape[1],)}
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, allow_deferred_init=True)
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"gamma": (x_shape[1],), "beta": (x_shape[1],)}
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act = activation
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer="zeros", in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer)
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="gelu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(self._beta * x)
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._fn = function
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._fn = function
+
+    def hybrid_forward(self, F, *args):
+        if isinstance(self._fn, str):
+            return getattr(F, self._fn)(*args)
+        return self._fn(F, *args)
+
+
+# --------------------------------------------------------------------------
+# convolution / pooling (reference: gluon/nn/conv_layers.py)
+# --------------------------------------------------------------------------
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, ndim, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._ndim = ndim
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._use_bias = use_bias
+        self._op_name = op_name
+        self._adj = adj
+        self.act = activation
+        if op_name == "Convolution":
+            wshape = (channels, in_channels // groups if in_channels else 0) + self._kernel
+        else:  # Deconvolution: (in, out/groups, *k)
+            wshape = (in_channels, channels // groups) + self._kernel
+        self.weight = Parameter("weight", shape=wshape,
+                                init=weight_initializer, allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(channels,), init=bias_initializer)
+                     if use_bias else None)
+
+    def infer_param_shapes(self, x_shape, *rest):
+        cin = x_shape[1]
+        if self._op_name == "Convolution":
+            return {"weight": (self._channels, cin // self._groups) + self._kernel}
+        return {"weight": (cin, self._channels // self._groups) + self._kernel}
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        kw = dict(kernel=self._kernel, stride=self._strides, dilate=self._dilation,
+                  pad=self._padding, num_filter=self._channels,
+                  num_group=self._groups, no_bias=not self._use_bias)
+        if self._op_name == "Deconvolution":
+            kw["adj"] = self._adj
+        out = getattr(F, self._op_name)(x, weight, bias, **kw)
+        if self.act:
+            out = F.Activation(out, act_type=self.act)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, 1, layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, 2, layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, 3, layout, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, 2, layout, op_name="Deconvolution",
+                         adj=_tuple(output_padding, 2), **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ndim, ceil_mode, pool_type,
+                 global_pool=False, count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = dict(
+            kernel=_tuple(pool_size, ndim) if pool_size else None,
+            stride=_tuple(strides if strides is not None else pool_size, ndim)
+            if not global_pool else None,
+            pad=_tuple(padding, ndim), pool_type=pool_type,
+            global_pool=global_pool,
+            pooling_convention="full" if ceil_mode else "valid",
+            count_include_pad=count_include_pad)
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False, **kw):
+        super().__init__(pool_size, strides, padding, 1, ceil_mode, "max", **kw)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False, **kw):
+        super().__init__(pool_size, strides, padding, 2, ceil_mode, "max", **kw)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False, **kw):
+        super().__init__(pool_size, strides, padding, 3, ceil_mode, "max", **kw)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 count_include_pad=True, **kw):
+        super().__init__(pool_size, strides, padding, 1, ceil_mode, "avg",
+                         count_include_pad=count_include_pad, **kw)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 count_include_pad=True, **kw):
+        super().__init__(pool_size, strides, padding, 2, ceil_mode, "avg",
+                         count_include_pad=count_include_pad, **kw)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 count_include_pad=True, **kw):
+        super().__init__(pool_size, strides, padding, 3, ceil_mode, "avg",
+                         count_include_pad=count_include_pad, **kw)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, **kw):
+        super().__init__(None, None, 0, 1, False, "max", global_pool=True, **kw)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, **kw):
+        super().__init__(None, None, 0, 2, False, "max", global_pool=True, **kw)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, **kw):
+        super().__init__(None, None, 0, 1, False, "avg", global_pool=True, **kw)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, **kw):
+        super().__init__(None, None, 0, 2, False, "avg", global_pool=True, **kw)

@@ -1,0 +1,245 @@
+"""Parameter and ParameterDict.
+
+Reference: `python/mxnet/gluon/parameter.py` — deferred shape init, grad_req,
+per-context replication. TPU-native deltas: a Parameter holds ONE logical
+NDArray (replication/sharding is expressed with `jax.sharding.NamedSharding`
+via `.set_sharding()`, not per-GPU copies), and `grad_req='null'` marks aux
+state (BatchNorm running stats) that flows through hybridized graphs as
+non-differentiable outputs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import initializer as init_mod
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+
+__all__ = ["Parameter", "ParameterDict", "DeferredInitializationError", "Constant"]
+
+
+class DeferredInitializationError(RuntimeError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, shape=None, dtype="float32", init=None,
+                 grad_req="write", differentiable=True, allow_deferred_init=False):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.init = init
+        self.grad_req = grad_req if differentiable else "null"
+        self.allow_deferred_init = allow_deferred_init
+        self._data = None            # NDArray once initialized
+        self._init_requested = None  # (initializer,) once initialize() called
+        self._sharding = None        # optional jax NamedSharding / PartitionSpec
+        self.wd_mult = 1.0
+        self.lr_mult = 1.0
+
+    # -- shape handling -------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new):
+        if self._shape is not None and 0 not in self._shape and None not in self._shape:
+            if tuple(new) != self._shape:
+                raise ValueError(f"shape already set to {self._shape}, got {new}")
+        self._shape = tuple(new)
+
+    @property
+    def _deferred(self):
+        return self._shape is None or 0 in self._shape or None in self._shape
+
+    def _finish_deferred_init(self, shape):
+        """Complete unknown dims from an observed input (reference: deferred
+        init resolved on first forward)."""
+        if self._shape is None:
+            self._shape = tuple(shape)
+        else:
+            self._shape = tuple(s if s not in (0, None) else n
+                                for s, n in zip(self._shape, shape))
+        if self._init_requested is not None and self._data is None:
+            self._materialize()
+
+    # -- init / data ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        initializer = init_mod.create(init or self.init or default_init or "uniform")
+        self._init_requested = (initializer,)
+        if not self._deferred:
+            self._materialize()
+        elif not self.allow_deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has unknown shape {self._shape} and "
+                "allow_deferred_init=False")
+
+    def _materialize(self):
+        (initializer,) = self._init_requested
+        data = initializer.init_array(self.name, self._shape, self.dtype)
+        self._data = NDArray(data)
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred and self._init_requested is not None:
+                raise DeferredInitializationError(
+                    f"Parameter '{self.name}' deferred; run a forward pass first")
+            raise RuntimeError(
+                f"Parameter '{self.name}' not initialized; call .initialize()")
+        return self._data
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = _nd.array(data)
+        if self._data is None:
+            self._shape = data.shape
+            self._data = NDArray(data._data.astype(jnp.dtype(self.dtype)))
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+        else:
+            grad = self._data._grad
+            self._data._data = data._data.astype(jnp.dtype(self.dtype))
+            self._data._grad = grad
+
+    def grad(self, ctx=None):
+        d = self.data()
+        if d._grad is None:
+            raise RuntimeError(f"Parameter '{self.name}' has grad_req='null'")
+        return d._grad
+
+    def zero_grad(self):
+        d = self.data()
+        if d._grad is not None:
+            d._grad._data = jnp.zeros_like(d._grad._data)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            grad = self._data._grad
+            self._data._data = self._data._data.astype(jnp.dtype(dtype))
+            if grad is not None:
+                grad._data = grad._data.astype(jnp.dtype(dtype))
+
+    def list_ctx(self):
+        return [self.data().context] if self._data is not None else []
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_grad(self):
+        return [self.grad()]
+
+    # -- sharding (TPU-native extension) --------------------------------
+    def set_sharding(self, sharding):
+        """Attach a `jax.sharding` spec; `mxnet_tpu.parallel` uses it when
+        building sharded train steps."""
+        self._sharding = sharding
+
+    @property
+    def sharding(self):
+        return self._sharding
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        value = value if isinstance(value, NDArray) else _nd.array(value)
+        super().__init__(name, shape=value.shape,
+                         dtype=str(value.dtype), grad_req="null")
+        self._value = value
+
+    def initialize(self, *a, **k):
+        if self._data is None:
+            self._data = NDArray(self._value._data)
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping (reference: gluon.ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self.prefix = prefix
+        self._params = {}
+
+    def __getitem__(self, name):
+        return self._params[name]
+
+    def __setitem__(self, name, param):
+        self._params[name] = param
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def update(self, other):
+        for k, v in other.items():
+            self._params[k] = v
+
+    def get(self, name, **kwargs):
+        if name in self._params:
+            return self._params[name]
+        p = Parameter(name, **kwargs)
+        self._params[name] = p
+        return p
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            if p.grad_req != "null" and p._data is not None:
+                p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        data = {}
+        for name, p in self._params.items():
+            if p._data is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
+            data[key] = p.data()
+        _nd.save(filename, data)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = _nd.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f"parameter '{name}' missing from {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise KeyError(f"extra parameters in file: {sorted(extra)}")
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p!r}" for p in self._params.values())
+        return f"ParameterDict(\n{lines}\n)"

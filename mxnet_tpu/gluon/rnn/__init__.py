@@ -1,0 +1,8 @@
+"""Recurrent layers and cells (reference: `python/mxnet/gluon/rnn/`)."""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                       DropoutCell, ResidualCell, ZoneoutCell, BidirectionalCell)
+
+__all__ = ["RNN", "LSTM", "GRU", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "BidirectionalCell"]

@@ -1,0 +1,226 @@
+"""RNN cells (reference: `python/mxnet/gluon/rnn/rnn_cell.py`).
+
+Single-step cells for custom unrolling; `unroll()` runs the python loop
+(which XLA fuses under hybridize for short lengths) — long sequences should
+use the fused layers in rnn_layer.py (lax.scan).
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ndarray import ndarray as _nd
+from ...ndarray import NDArray
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or _nd.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            x = _nd.slice_axis(inputs, axis=axis, begin=t, end=t + 1).squeeze(axis=axis)
+            out, states = self(x, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = _nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self.i2h_weight = Parameter("i2h_weight", shape=(hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,), init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"i2h_weight": (self._hidden_size, x_shape[-1])}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(4 * hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(4 * hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,), init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"i2h_weight": (4 * self._hidden_size, x_shape[-1])}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * h) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=4 * h)
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        c = F.sigmoid(f) * states[1] + F.sigmoid(i) * F.tanh(g)
+        out = F.sigmoid(o) * F.tanh(c)
+        return out, [out, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(3 * hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(3 * hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,), init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"i2h_weight": (3 * self._hidden_size, x_shape[-1])}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=3 * h)
+        i_r, i_z, i_n = F.split(i2h, num_outputs=3, axis=-1)
+        h_r, h_z, h_n = F.split(h2h, num_outputs=3, axis=-1)
+        r = F.sigmoid(i_r + h_r)
+        z = F.sigmoid(i_z + h_z)
+        n = F.tanh(i_n + r * h_n)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return sum((c.state_info(batch_size) for c in self._cells), [])
+
+    def __call__(self, inputs, states):
+        return self.forward(inputs, states)
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            inputs, s = cell(inputs, states[p:p + n])
+            next_states += s
+            p += n
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        return F.Dropout(inputs, p=self._rate), states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        from ... import _engine
+        out, next_states = self.base_cell(inputs, states)
+        if _engine.is_training():
+            if self._zo > 0:
+                mask = _nd.random.uniform(shape=out.shape) < self._zo
+                out = _nd.where(mask, inputs * 0 + out, out)
+            if self._zs > 0:
+                next_states = [
+                    _nd.where(_nd.random.uniform(shape=ns.shape) < self._zs, s, ns)
+                    for s, ns in zip(states, next_states)]
+        return out, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + self.r_cell.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(length, inputs, states[:nl], layout, True)
+        rev = inputs.flip(axis=axis)
+        r_out, r_states = self.r_cell.unroll(length, rev, states[nl:], layout, True)
+        r_out = r_out.flip(axis=axis)
+        out = _nd.concat(l_out, r_out, dim=2 if layout == "NTC" else 2)
+        return out, l_states + r_states

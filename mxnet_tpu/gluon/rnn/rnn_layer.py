@@ -1,0 +1,128 @@
+"""Fused multi-layer RNN layers.
+
+Reference: `python/mxnet/gluon/rnn/rnn_layer.py` (_RNNLayer) over the fused
+`RNN` op (`src/operator/rnn.cc` / cuDNN). Here the fused op is a
+`lax.scan`-based kernel (mxnet_tpu.ops.rnn_ops) — per-layer weights are kept
+as separate Parameters (reference naming) and packed in cuDNN order at
+forward; XLA folds the packing into the compiled step under hybridize.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ndarray import ndarray as _nd
+from ...ndarray import NDArray
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        ng = _GATES[mode]
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                pfx = f"{'lr'[d]}{layer}_"
+                isz = input_size if layer == 0 else hidden_size * self._dir
+                setattr(self, pfx + "i2h_weight", Parameter(
+                    pfx + "i2h_weight", shape=(ng * hidden_size, isz),
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, pfx + "h2h_weight", Parameter(
+                    pfx + "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                    init=h2h_weight_initializer))
+                setattr(self, pfx + "i2h_bias", Parameter(
+                    pfx + "i2h_bias", shape=(ng * hidden_size,),
+                    init=i2h_bias_initializer))
+                setattr(self, pfx + "h2h_bias", Parameter(
+                    pfx + "h2h_bias", shape=(ng * hidden_size,),
+                    init=h2h_bias_initializer))
+
+    def infer_param_shapes(self, x_shape, *rest):
+        isz = x_shape[2] if self._layout == "TNC" else x_shape[-1]
+        ng = _GATES[self._mode]
+        shapes = {}
+        for d in range(self._dir):
+            shapes[f"{'lr'[d]}0_i2h_weight"] = (ng * self._hidden_size, isz)
+        return shapes
+
+    def state_info(self, batch_size=0):
+        ns = self._num_layers * self._dir
+        info = [{"shape": (ns, batch_size, self._hidden_size)}]
+        if self._mode == "lstm":
+            info.append({"shape": (ns, batch_size, self._hidden_size)})
+        return info
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or _nd.zeros
+        return [func(shape=info["shape"], **kwargs) for info in self.state_info(batch_size)]
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        batch_axis = 0 if self._layout == "NTC" else 1
+        batch = inputs.shape[batch_axis]
+        ret_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        if isinstance(states, NDArray):
+            states = [states]
+        flat = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                pfx = f"{'lr'[d]}{layer}_"
+                flat.append(params[pfx + "i2h_weight"].reshape(shape=(-1,)))
+                flat.append(params[pfx + "h2h_weight"].reshape(shape=(-1,)))
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                pfx = f"{'lr'[d]}{layer}_"
+                flat.append(params[pfx + "i2h_bias"])
+                flat.append(params[pfx + "h2h_bias"])
+        packed = F.concat(*flat, dim=0)
+        out = F.RNN(inputs, packed, states[0],
+                    states[1] if self._mode == "lstm" else None,
+                    state_size=self._hidden_size, num_layers=self._num_layers,
+                    mode=self._mode, bidirectional=self._dir == 2,
+                    p=self._dropout, state_outputs=True, layout=self._layout)
+        if self._mode == "lstm":
+            output, h, c = out
+            new_states = [h, c]
+        else:
+            output, h = out
+            new_states = [h]
+        return (output, new_states) if ret_states else output
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(mode, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

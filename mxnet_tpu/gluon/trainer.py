@@ -1,0 +1,100 @@
+"""Trainer: applies an Optimizer to a set of Parameters.
+
+Reference: `python/mxnet/gluon/trainer.py` — there, `step()` pushes/pulls
+every gradient through a KVStore (per-tensor allreduce) then runs the update
+op per parameter. TPU-native: gradients living on a sharded mesh are already
+reduced by XLA collectives inside the jitted backward (psum on the data
+axis), so `step()` is just the update kernels; the kvstore argument is
+accepted for API compatibility and validated against the mesh story
+(`mxnet_tpu.kvstore`).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .parameter import ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, param_dict={
+            i: p for i, p in enumerate(self._params)}, **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._states_created = False
+        self._kvstore_type = kvstore
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _create_states(self):
+        for i, p in enumerate(self._params):
+            self._states[i] = self._optimizer.create_state(i, p.data())
+        self._states_created = True
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Scale gradients by 1/batch_size and apply updates."""
+        self._optimizer.rescale_grad = 1.0 / batch_size
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self.step(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """No-op: on a sharded mesh XLA's psum already reduced gradients
+        (reference: kvstore push/pull per parameter)."""
+
+    def _update(self, ignore_stale_grad=False):
+        if not self._states_created:
+            self._create_states()
+        for i, p in enumerate(self._params):
+            self._optimizer.update(i, p.data(), p.grad(), self._states[i])
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # -- optimizer state checkpointing (reference: trainer.save_states) --
+    def save_states(self, fname):
+        from ..ndarray import ndarray as _nd
+        flat = {}
+        if not self._states_created:
+            self._create_states()
+        for i, s in enumerate(self._states):
+            if s is None:
+                continue
+            if isinstance(s, tuple):
+                for j, t in enumerate(s):
+                    if t is not None:
+                        flat[f"{i}.{j}"] = t
+            else:
+                flat[f"{i}"] = s
+        _nd.save(fname, flat)
+
+    def load_states(self, fname):
+        from ..ndarray import ndarray as _nd
+        if not self._states_created:
+            self._create_states()
+        flat = _nd.load(fname)
+        for key, arr in flat.items():
+            if "." in key:
+                i, j = map(int, key.split("."))
+                self._states[i][j]._data = arr._data
+            else:
+                self._states[int(key)]._data = arr._data

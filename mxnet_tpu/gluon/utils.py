@@ -1,0 +1,68 @@
+"""Gluon utilities (reference: `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"batch size {size} not divisible by number of slices {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(_nd.slice_axis(data, axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (reference: gluon.utils.split_and_load).
+
+    TPU-native note: on a sharded mesh the idiomatic path is a single
+    device-sharded array (`mxnet_tpu.parallel.shard_batch`); this function
+    keeps the reference's per-context-list semantics for compatibility."""
+    if not isinstance(data, NDArray):
+        data = _nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so total L2 norm ≤ max_norm (reference: clip_global_norm)."""
+    import jax.numpy as jnp
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    for a in arrays:
+        a._data = (a._data.astype(jnp.float32) * scale).astype(a.dtype)
+    return float(total)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise RuntimeError(
+        "mxnet_tpu builds run zero-egress; place files locally and pass paths "
+        "(reference gluon.utils.download is unavailable by design)")

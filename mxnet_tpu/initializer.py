@@ -1,0 +1,155 @@
+"""Weight initializers (reference: `python/mxnet/initializer.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import Registry
+from . import random as _random
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "create", "register"]
+
+_registry = Registry("initializer")
+register = _registry.register
+
+
+class Initializer:
+    """Base initializer: produces a jax array for (shape, dtype)."""
+
+    def __call__(self, shape, dtype="float32"):
+        name_l = type(self).__name__.lower()
+        return self._init(_random.next_key(), tuple(shape), jnp.dtype(dtype))
+
+    def _init(self, key, shape, dtype):
+        raise NotImplementedError
+
+    def init_array(self, name, shape, dtype="float32"):
+        """Name-aware dispatch like the reference: *_bias→zero, *_gamma→one,
+        running stats→zero/one."""
+        lname = name.lower()
+        if lname.endswith("bias") or lname.endswith("beta") or lname.endswith("running_mean"):
+            return Zero()._init(_random.next_key(), tuple(shape), jnp.dtype(dtype))
+        if lname.endswith("gamma") or lname.endswith("running_var"):
+            return One()._init(_random.next_key(), tuple(shape), jnp.dtype(dtype))
+        return self(shape, dtype)
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+class One(Initializer):
+    def _init(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def _init(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, jnp.float32, -self.scale, self.scale).astype(dtype)
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def _init(self, key, shape, dtype):
+        return (self.sigma * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+
+    def _init(self, key, shape, dtype):
+        rows = shape[0]
+        cols = int(jnp.prod(jnp.asarray(shape[1:]))) if len(shape) > 1 else 1
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.scale * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+def _fan(shape, factor_type):
+    hw = 1
+    for d in shape[2:]:
+        hw *= d
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return fan_in
+    return fan_out
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """Reference: `mx.init.Xavier(rnd_type, factor_type, magnitude)`."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init(self, key, shape, dtype):
+        factor = _fan(shape, self.factor_type)
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            out = scale * jax.random.normal(key, shape, jnp.float32)
+        return out.astype(dtype)
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        super().__init__("gaussian", factor_type, 2.0 / (1 + slope ** 2))
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    def _init(self, key, shape, dtype):
+        import numpy as np
+        weight = np.zeros(shape, dtype="float32")
+        f = shape[3] // 2 if len(shape) == 4 else 1
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        flat = weight.reshape(-1)
+        size = flat.size
+        for i in range(size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(flat.reshape(shape), dtype)
+
+
+def create(init, **kwargs):
+    if init is None:
+        return Uniform()
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return _registry.get(init)(**kwargs)
+    raise TypeError(f"cannot create initializer from {init!r}")

@@ -1,0 +1,379 @@
+"""Optimizer zoo.
+
+Reference: `python/mxnet/optimizer/optimizer.py` — registry, per-param state,
+lr/wd multipliers, multi-precision — over the update kernels in
+`src/operator/optimizer_op.cc`. Here the kernels are the pure jax fns in
+`mxnet_tpu.ops.optimizer_ops`; XLA fuses each update into one elementwise
+kernel, and the sharded train path (mxnet_tpu.parallel) runs them sharded
+over the data axis (weight-update sharding).
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import Registry
+from ..ndarray import NDArray, zeros
+from ..ndarray import ndarray as _nd
+from .. import ops as _ops
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "RMSProp",
+           "Ftrl", "Signum", "SignSGD", "LAMB", "LARS", "create", "register"]
+
+_registry = Registry("optimizer")
+register = _registry.register
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _registry.get(name)(**kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- bookkeeping ----------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- per-optimizer --------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def _clip(self):
+        return self.clip_gradient if self.clip_gradient else -1.0
+
+
+def _assign(weight, new_data):
+    weight._data = new_data._data if isinstance(new_data, NDArray) else new_data
+
+
+@register("sgd")
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.multi_precision and weight.dtype != "float32":
+            w32 = NDArray(weight._data.astype("float32"))
+            mom = zeros(weight.shape) if self.momentum else None
+            return (mom, w32)
+        if self.momentum:
+            return zeros(weight.shape, dtype="float32")
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.multi_precision and isinstance(state, tuple):
+            mom, w32 = state
+            if mom is not None:
+                new_w, new_mom, new_w32 = _ops.OPS["mp_sgd_mom_update"](
+                    weight._data, grad._data, mom._data, w32._data, lr,
+                    momentum=self.momentum, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+                mom._data = new_mom
+            else:
+                new_w, new_w32 = _ops.OPS["mp_sgd_update"](
+                    weight._data, grad._data, w32._data, lr, wd=wd,
+                    rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+            w32._data = new_w32
+            weight._data = new_w
+        elif self.momentum:
+            new_w, new_mom = _ops.OPS["sgd_mom_update"](
+                weight._data, grad._data, state._data, lr,
+                momentum=self.momentum, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+            state._data = new_mom
+            weight._data = new_w
+        else:
+            weight._data = _ops.OPS["sgd_update"](
+                weight._data, grad._data, lr, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+
+
+@register("nag")
+class NAG(SGD):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_mom = _ops.OPS["nag_mom_update"](
+            weight._data, grad._data, state._data, lr, momentum=self.momentum,
+            wd=wd, rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        state._data = new_mom
+        weight._data = new_w
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32")
+
+
+@register("adam")
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"),
+                zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_mean, new_var = _ops.OPS["adam_update"](
+            weight._data, grad._data, mean._data, var._data, lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        mean._data, var._data = new_mean, new_var
+        weight._data = new_w
+
+
+@register("adamw")
+class AdamW(Adam):
+    """Decoupled weight decay (reference: contrib adamw_update)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        new_w, new_mean, new_var = _ops.OPS["adamw_update"](
+            weight._data, grad._data, mean._data, var._data, lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        mean._data, var._data = new_mean, new_var
+        weight._data = new_w
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_hist = _ops.OPS["adagrad_update"](
+            weight._data, grad._data, state._data, lr,
+            epsilon=self.float_stable_eps, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        state._data = new_hist
+        weight._data = new_w
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights or -1.0
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype="float32"), zeros(weight.shape, dtype="float32"),
+                    zeros(weight.shape, dtype="float32"))
+        return zeros(weight.shape, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, g_avg, delta = state
+            new_w, nn, ng, nd_ = _ops.OPS["rmspropalex_update"](
+                weight._data, grad._data, n._data, g_avg._data, delta._data, lr,
+                gamma1=self.gamma1, gamma2=self.gamma2, epsilon=self.epsilon,
+                wd=wd, rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+            n._data, g_avg._data, delta._data = nn, ng, nd_
+        else:
+            new_w, nn = _ops.OPS["rmsprop_update"](
+                weight._data, grad._data, state._data, lr, gamma1=self.gamma1,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip(), clip_weights=self.clip_weights)
+            state._data = nn
+        weight._data = new_w
+
+
+@register("ftrl")
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"), zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        new_w, nz, nn = _ops.OPS["ftrl_update"](
+            weight._data, grad._data, z._data, n._data, lr, lamda1=self.lamda1,
+            beta=self.beta, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=self._clip())
+        z._data, n._data = nz, nn
+        weight._data = new_w
+
+
+@register("signum")
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32") if self.momentum else None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is not None:
+            new_w, new_mom = _ops.OPS["signum_update"](
+                weight._data, grad._data, state._data, lr, momentum=self.momentum,
+                wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self._clip(), wd_lh=self.wd_lh)
+            state._data = new_mom
+        else:
+            new_w = _ops.OPS["signsgd_update"](
+                weight._data, grad._data, lr, wd=wd,
+                rescale_grad=self.rescale_grad, clip_gradient=self._clip())
+        weight._data = new_w
+
+
+@register("signsgd")
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        super().__init__(momentum=0.0, **kwargs)
+
+
+@register("lamb")
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (reference:
+    `lamb_update_phase1/2` in `src/operator/optimizer_op.cc`, mxnet 1.6)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound or -1.0
+        self.upper_bound = upper_bound or -1.0
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"), zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mean, var = state
+        new_w, new_mean, new_var = _ops.OPS["lamb_update"](
+            weight._data, grad._data, mean._data, var._data, lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=wd,
+            rescale_grad=self.rescale_grad, clip_gradient=self._clip(),
+            lower_bound=self.lower_bound, upper_bound=self.upper_bound)
+        mean._data, var._data = new_mean, new_var
+        weight._data = new_w
+
+
+@register("lars")
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: 1.6 LARS)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype="float32")
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w32 = weight._data.astype("float32")
+        g = grad._data.astype("float32") * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(w32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                          self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+                          jnp.ones_like(w_norm))
+        new_mom = self.momentum * state._data - lr * trust * (g + wd * w32)
+        state._data = new_mom
+        weight._data = (w32 + new_mom).astype(weight.dtype)

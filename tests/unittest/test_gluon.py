@@ -1,0 +1,221 @@
+"""Gluon tests (reference: `tests/python/unittest/test_gluon.py`)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init="xavier")
+    assert p.data().shape == (4, 3)
+    assert p.data().grad is not None
+    p.set_data(nd.ones((4, 3)))
+    assert_almost_equal(p.data(), np.ones((4, 3)))
+    p.zero_grad()
+
+
+def test_dense_shapes_and_flatten():
+    d = nn.Dense(8, in_units=4)
+    d.initialize()
+    out = d(nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+    # deferred init
+    d2 = nn.Dense(8)
+    d2.initialize()
+    out = d2(nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+    assert d2.weight.shape == (8, 5)
+    # no flatten
+    d3 = nn.Dense(8, flatten=False)
+    d3.initialize()
+    assert d3(nd.ones((2, 3, 5))).shape == (2, 3, 8)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    params = net.collect_params()
+    assert len(params) == 4
+    assert any(k.endswith("weight") for k in params.keys())
+    out = net(nd.ones((5, 3)))
+    assert out.shape == (5, 2)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = nd.array(np.random.normal(size=(3, 8)).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=2e-5, atol=2e-5)
+    assert len(net._cache) == 1
+    net(x)
+    assert len(net._cache) == 1  # same shape → cache hit
+    net(nd.ones((5, 8)))
+    assert len(net._cache) == 2  # new shape → retrace
+
+
+def test_hybridize_grad_matches_eager():
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh", in_units=4), nn.Dense(1, in_units=8))
+        return net
+    mx.random.seed(7)
+    net1 = build(); net1.initialize()
+    # copy params to second net
+    net2 = build(); net2.initialize()
+    for (k1, p1), (k2, p2) in zip(net1.collect_params().items(),
+                                  net2.collect_params().items()):
+        p2.set_data(p1.data())
+    net2.hybridize()
+    x = nd.array(np.random.normal(size=(6, 4)).astype(np.float32))
+    grads = []
+    for net in (net1, net2):
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        grads.append({k: p.grad().asnumpy() for k, p in net.collect_params().items()})
+    for k in grads[0]:
+        np.testing.assert_allclose(grads[0][k], grads[1][k], rtol=2e-5, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.normal(2.0, 3.0, size=(8, 3, 4, 4)).astype(np.float32))
+    with autograd.record():
+        y = bn(x)
+    # running stats moved toward batch stats
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    # inference mode uses running stats, no update
+    rm_before = bn.running_mean.data().asnumpy().copy()
+    _ = bn(x)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm_before)
+
+
+def test_batchnorm_hybridized_aux_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.array(np.random.normal(1.0, 2.0, size=(8, 3, 2, 2)).astype(np.float32))
+    with autograd.record():
+        bn(x)
+    assert np.abs(bn.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_conv_pool():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 4)
+    net.hybridize()
+    assert net(nd.ones((2, 3, 8, 8))).shape == (2, 4)
+
+
+def test_conv1d_3d_transpose():
+    c1 = nn.Conv1D(4, 3, padding=1); c1.initialize()
+    assert c1(nd.ones((2, 3, 10))).shape == (2, 4, 10)
+    c3 = nn.Conv3D(4, 3, padding=1); c3.initialize()
+    assert c3(nd.ones((2, 3, 4, 4, 4))).shape == (2, 4, 4, 4, 4)
+    ct = nn.Conv2DTranspose(4, 2, strides=2, in_channels=3); ct.initialize()
+    assert ct(nd.ones((2, 3, 4, 4))).shape == (2, 4, 8, 8)
+
+
+def test_embedding_layernorm_dropout():
+    emb = nn.Embedding(10, 6); emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 6)
+    ln = nn.LayerNorm(); ln.initialize()
+    y = ln(nd.array(np.random.normal(size=(2, 5)).astype(np.float32)))
+    np.testing.assert_allclose(y.asnumpy().mean(-1), 0, atol=1e-5)
+    do = nn.Dropout(0.5)
+    x = nd.ones((100,))
+    assert_almost_equal(do(x), np.ones(100))  # not training → identity
+
+
+def test_losses():
+    pred = nd.array(np.random.normal(size=(4, 5)).astype(np.float32))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    expect = -np.log(
+        np.exp(pred.asnumpy()) / np.exp(pred.asnumpy()).sum(-1, keepdims=True)
+    )[np.arange(4), [0, 1, 2, 3]]
+    assert_almost_equal(l, expect, rtol=1e-4, atol=1e-5)
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 5)))
+    assert_almost_equal(l2, 0.5 * (pred.asnumpy() ** 2).mean(-1), rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, nd.zeros((4, 5)))
+    assert_almost_equal(l1, np.abs(pred.asnumpy()).mean(-1), rtol=1e-5)
+    bce = gluon.loss.SigmoidBCELoss()(pred, nd.ones((4, 5)))
+    assert np.isfinite(bce.asnumpy()).all()
+    h = gluon.loss.HuberLoss()(pred, nd.zeros((4, 5)))
+    assert np.isfinite(h.asnumpy()).all()
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize(init="zeros")
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    trainer.step(1)
+    # w -= lr * x  (grad of sum(wx) wrt w is x)
+    assert_almost_equal(net.weight.data(), -np.array([[1.0, 2.0]]))
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = nd.ones((1, 2))
+    with autograd.record():
+        net(x).sum().backward()
+    tr.step(1)
+    f = str(tmp_path / "states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    tr2.load_states(f)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net2.load_parameters(f)
+    for (k, p), (_, p2) in zip(net.collect_params().items(),
+                               net2.collect_params().items()):
+        assert_almost_equal(p.data(), p2.data().asnumpy(), names=(k, k))
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2).astype(np.float32))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-5

@@ -1,0 +1,66 @@
+"""Data pipeline tests (reference: `tests/python/unittest/test_gluon_data.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon.data import ArrayDataset, SimpleDataset, DataLoader
+from mxnet_tpu.gluon.data.vision import MNIST, transforms
+
+
+def test_array_dataset():
+    X = np.random.normal(size=(10, 3)).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    ds = ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(x0, X[3])
+    assert y0 == 3
+
+
+def test_transform_and_filter():
+    ds = SimpleDataset(list(range(10)))
+    doubled = ds.transform(lambda x: x * 2)
+    assert doubled[4] == 8
+    evens = ds.filter(lambda x: x % 2 == 0)
+    assert len(evens) == 5
+    taken = ds.take(3)
+    assert len(taken) == 3
+
+
+def test_dataloader_batching():
+    X = np.random.normal(size=(10, 3)).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=4, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=4, last_batch="discard")
+    assert len(list(loader)) == 2
+
+
+def test_dataloader_shuffle_and_workers():
+    X = np.arange(32).reshape(32, 1).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, X[:, 0]), batch_size=8, shuffle=True,
+                        num_workers=2)
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(32))
+
+
+def test_mnist_synthetic_fallback():
+    ds = MNIST(root="/nonexistent/path", train=True)
+    assert len(ds) > 0
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= int(label) < 10
+
+
+def test_totensor_normalize():
+    t = transforms.ToTensor()
+    x = nd.array(np.full((4, 4, 3), 255, np.uint8))
+    out = t(x)
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
+    out2 = norm(out)
+    np.testing.assert_allclose(out2.asnumpy(), 1.0)

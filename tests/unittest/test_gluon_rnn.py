@@ -1,0 +1,93 @@
+"""RNN layer/cell tests (reference: `tests/python/unittest/test_gluon_rnn.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_lstm_layer_shapes():
+    lstm = gluon.rnn.LSTM(16, num_layers=2)
+    lstm.initialize()
+    x = nd.array(np.random.normal(size=(5, 3, 8)).astype(np.float32))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 3, 16)
+    states = lstm.begin_state(3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_gru_rnn_layers():
+    for layer, hidden in [(gluon.rnn.GRU(12), 12), (gluon.rnn.RNN(10), 10)]:
+        layer.initialize()
+        x = nd.array(np.random.normal(size=(4, 2, 6)).astype(np.float32))
+        assert layer(x).shape == (4, 2, hidden)
+
+
+def test_bidirectional_lstm():
+    lstm = gluon.rnn.LSTM(8, num_layers=1, bidirectional=True)
+    lstm.initialize()
+    x = nd.array(np.random.normal(size=(4, 2, 5)).astype(np.float32))
+    assert lstm(x).shape == (4, 2, 16)
+
+
+def test_ntc_layout():
+    lstm = gluon.rnn.LSTM(8, layout="NTC")
+    lstm.initialize()
+    x = nd.array(np.random.normal(size=(2, 4, 5)).astype(np.float32))
+    assert lstm(x).shape == (2, 4, 8)
+
+
+def test_lstm_gradient_flows():
+    lstm = gluon.rnn.LSTM(4)
+    lstm.initialize()
+    x = nd.array(np.random.normal(size=(3, 2, 5)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = lstm(x).sum()
+    y.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for k, p in lstm.collect_params().items():
+        assert np.isfinite(p.grad().asnumpy()).all(), k
+
+
+def test_lstm_cell_unroll_matches_layer():
+    # cell-based unroll and fused layer compute the same function when
+    # weights are shared
+    hidden, insz, T, N = 6, 4, 5, 2
+    cell = gluon.rnn.LSTMCell(hidden, input_size=insz)
+    cell.initialize()
+    x = nd.array(np.random.normal(size=(N, T, insz)).astype(np.float32))
+    out_cell, _ = cell.unroll(T, x, layout="NTC")
+
+    layer = gluon.rnn.LSTM(hidden, input_size=insz, layout="NTC")
+    layer.initialize()
+    layer.l0_i2h_weight.set_data(cell.i2h_weight.data())
+    layer.l0_h2h_weight.set_data(cell.h2h_weight.data())
+    layer.l0_i2h_bias.set_data(cell.i2h_bias.data())
+    layer.l0_h2h_bias.set_data(cell.h2h_bias.data())
+    out_layer = layer(x)
+    assert_almost_equal(out_cell, out_layer.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_cells():
+    for cell, nstates in [(gluon.rnn.RNNCell(8, input_size=4), 1),
+                          (gluon.rnn.LSTMCell(8, input_size=4), 2),
+                          (gluon.rnn.GRUCell(8, input_size=4), 1)]:
+        cell.initialize()
+        x = nd.ones((2, 4))
+        out, states = cell(x, cell.begin_state(2))
+        assert out.shape == (2, 8)
+        assert len(states) == nstates
+
+
+def test_sequential_rnn_cell():
+    seq = gluon.rnn.SequentialRNNCell()
+    seq.add(gluon.rnn.LSTMCell(8, input_size=4))
+    seq.add(gluon.rnn.GRUCell(6, input_size=8))
+    seq.initialize()
+    out, states = seq(nd.ones((2, 4)), seq.begin_state(2))
+    assert out.shape == (2, 6)
+    assert len(states) == 3

@@ -1,0 +1,134 @@
+"""Optimizer tests (reference: `tests/python/unittest/test_optimizer.py`).
+
+Oracle: each optimizer's update versus a plain numpy re-implementation.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _setup(shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.normal(size=shape).astype(np.float32)
+    g = rng.normal(size=shape).astype(np.float32)
+    weight, grad = nd.array(w), nd.array(g)
+    return w, g, weight, grad
+
+
+def test_sgd_matches_numpy():
+    w, g, weight, grad = _setup()
+    o = opt.create("sgd", learning_rate=0.1, wd=0.01)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    expect = w - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(weight, expect, rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w, g, weight, grad = _setup()
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    o.update(0, weight, grad, state)
+    mom = -0.1 * g
+    w1 = w + mom
+    mom = 0.9 * mom - 0.1 * g
+    w2 = w1 + mom
+    assert_almost_equal(weight, w2, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w, g, weight, grad = _setup()
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.create("adam", learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2) / (1 - b1)
+    expect = w - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(weight, expect, rtol=1e-5)
+
+
+def test_lamb_update_runs_and_trust_ratio():
+    w, g, weight, grad = _setup()
+    o = opt.create("lamb", learning_rate=0.01)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    assert np.isfinite(weight.asnumpy()).all()
+    assert not np.allclose(weight.asnumpy(), w)
+
+
+def test_rescale_and_clip():
+    w, g, weight, grad = _setup()
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    o.update(0, weight, grad, o.create_state(0, weight))
+    expect = w - np.clip(0.5 * g, -0.1, 0.1)
+    assert_almost_equal(weight, expect, rtol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "adagrad",
+                                  "rmsprop", "ftrl", "signum", "lamb", "lars"])
+def test_all_optimizers_finite(name):
+    w, g, weight, grad = _setup(seed=3)
+    o = opt.create(name)
+    state = o.create_state(0, weight)
+    for _ in range(3):
+        o.update(0, weight, grad, state)
+    assert np.isfinite(weight.asnumpy()).all()
+    assert not np.allclose(weight.asnumpy(), w)
+
+
+def test_multi_precision_sgd():
+    rng = np.random.RandomState(0)
+    w = rng.normal(size=(4,)).astype(np.float16)
+    weight = nd.array(w, dtype="float16")
+    grad = nd.array(rng.normal(size=(4,)).astype(np.float16), dtype="float16")
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9, multi_precision=True)
+    state = o.create_state(0, weight)
+    assert isinstance(state, tuple)
+    o.update(0, weight, grad, state)
+    assert weight.dtype == np.float16
+    assert state[1].dtype == np.float32
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, CosineScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(20) == 0.25
+    c = CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0, warmup_steps=10)
+    assert c(5) < 1.0  # warming up
+    assert abs(c(10) - 1.0) < 1e-6
+    assert c(100) == 0.0
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=FactorScheduler(step=1, factor=0.1, base_lr=1.0))
+    w, g, weight, grad = _setup()
+    o.update(0, weight, grad, o.create_state(0, weight))
+    assert o.learning_rate < 1.0
+
+
+def test_metrics():
+    m = mx.metric.Accuracy()
+    m.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]]))
+    assert abs(m.get()[1] - 2 / 3) < 1e-6
+    m = mx.metric.TopKAccuracy(top_k=2)
+    m.update(nd.array([0, 2]), nd.array([[0.3, 0.1, 0.25, 0.35],
+                                         [0.3, 0.1, 0.25, 0.35]]))
+    assert m.get()[1] == 0.5  # 0 is in top-2, 2 is not
+    m = mx.metric.MSE()
+    m.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.0]))
+    assert abs(m.get()[1] - 0.125) < 1e-6
+    m = mx.metric.Perplexity()
+    m.update(nd.array([0]), nd.array([[0.5, 0.5]]))
+    assert abs(m.get()[1] - 2.0) < 1e-4
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.TopKAccuracy(top_k=2))
+    comp.update(nd.array([0]), nd.array([[0.9, 0.1]]))
+    names, values = comp.get()
+    assert len(names) == 2
