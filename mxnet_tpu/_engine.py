@@ -94,9 +94,9 @@ def _topo_order(roots):
         for p in node.parents:
             if p is not None and p[0] == "node":
                 stack.append((p[1], False))
-    return order  # children before parents; iterate reversed for backward? no:
-    # post-order DFS appends a node only after all its ancestors(inputs) are
-    # appended, so iterating *reversed* visits consumers before producers.
+    # Post-order DFS appends producers before consumers; backward iterates
+    # reversed(order) so each node's cotangents are complete when visited.
+    return order
 
 
 def backward(arrays, head_grads=None, retain_graph=False, train_mode=True):
@@ -107,6 +107,17 @@ def backward(arrays, head_grads=None, retain_graph=False, train_mode=True):
     accumulates into arrays that called `attach_grad()`, honouring
     grad_req 'write'|'add'.
     """
+    # Replay recorded fns under the requested mode so mode-sensitive ops
+    # (Dropout, BatchNorm) differentiate the same computation they ran
+    # forward (reference: MXAutogradBackwardEx train_mode flag).
+    prev_train = set_training(train_mode)
+    try:
+        _backward_impl(arrays, head_grads, retain_graph)
+    finally:
+        set_training(prev_train)
+
+
+def _backward_impl(arrays, head_grads, retain_graph):
     roots, seeds = [], {}
     for i, arr in enumerate(arrays):
         node_ref = getattr(arr, "_node", None)

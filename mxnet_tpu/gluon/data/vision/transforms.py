@@ -41,11 +41,11 @@ class ToTensor(HybridBlock):
 class Normalize(HybridBlock):
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean = _nd.array(np.asarray(mean, np.float32).reshape(-1, 1, 1))
+        self._std = _nd.array(np.asarray(std, np.float32).reshape(-1, 1, 1))
 
     def hybrid_forward(self, F, x):
-        return (x - _nd.array(self._mean)) / _nd.array(self._std)
+        return (x - self._mean) / self._std
 
 
 class Resize(Block):

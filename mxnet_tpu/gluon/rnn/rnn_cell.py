@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from ..block import HybridBlock
 from ..parameter import Parameter
-from ...ndarray import ndarray as _nd
+from ... import ndarray as _nd
 from ...ndarray import NDArray
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
@@ -180,26 +180,37 @@ class ResidualCell(RecurrentCell):
 
 
 class ZoneoutCell(RecurrentCell):
+    """Zoneout (reference: gluon.rnn.ZoneoutCell): with probability p, keep
+    the *previous* output/state instead of the new one (training only)."""
+
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0, **kwargs):
         super().__init__(**kwargs)
         self.base_cell = base_cell
         self._zo = zoneout_outputs
         self._zs = zoneout_states
+        self._prev_output = None
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        self._prev_output = None
 
     def forward(self, inputs, states):
         from ... import _engine
         out, next_states = self.base_cell(inputs, states)
         if _engine.is_training():
             if self._zo > 0:
-                mask = _nd.random.uniform(shape=out.shape) < self._zo
-                out = _nd.where(mask, inputs * 0 + out, out)
+                prev = self._prev_output
+                if prev is None:
+                    prev = _nd.zeros_like(out)
+                keep_prev = _nd.random.uniform(shape=out.shape) < self._zo
+                out = _nd.where(keep_prev, prev, out)
             if self._zs > 0:
                 next_states = [
                     _nd.where(_nd.random.uniform(shape=ns.shape) < self._zs, s, ns)
                     for s, ns in zip(states, next_states)]
+        self._prev_output = out
         return out, next_states
 
 

@@ -1,0 +1,325 @@
+"""`mx.io` data iterators (reference: `python/mxnet/io.py` over `src/io/`).
+
+The reference's C++ iterator stack (RecordIO parse → threaded decode/augment
+→ batch → prefetch) maps to: recordio.py (format), ImageRecordIter (threaded
+decode pool + double-buffer prefetch — host CPU work feeding the TPU), and
+NDArrayIter for in-memory data.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from . import recordio
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter",
+           "recordio"]
+
+DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, (list, tuple)) else [data]
+        self.label = label if label is None or isinstance(label, (list, tuple)) else [label]
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol of the reference (`next/reset/provide_data`)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        raise StopIteration
+
+    @property
+    def provide_data(self):
+        return None
+
+    @property
+    def provide_label(self):
+        return None
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference: mx.io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = self._init(data, data_name)
+        self._label = self._init(label, label_name) if label is not None else []
+        self._num = len(self._data[0][1]) if self._data else 0
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self.reset()
+
+    @staticmethod
+    def _init(src, default_name):
+        if src is None:
+            return []
+        if isinstance(src, (np.ndarray, NDArray)):
+            src = {default_name: src}
+        elif isinstance(src, (list, tuple)):
+            src = {f"{default_name}_{i}" if i else default_name: d
+                   for i, d in enumerate(src)}
+        out = []
+        for name, arr in src.items():
+            if isinstance(arr, NDArray):
+                arr = arr.asnumpy()
+            out.append((name, np.asarray(arr)))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:]) for n, a in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:]) for n, a in self._label]
+
+    def reset(self):
+        self._cursor = 0
+        self._order = np.random.permutation(self._num) if self._shuffle \
+            else np.arange(self._num)
+
+    def next(self):
+        if self._cursor >= self._num:
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = 0
+        if len(idx) < self.batch_size:
+            if self._last == "discard":
+                raise StopIteration
+            pad = self.batch_size - len(idx)
+            idx = np.concatenate([idx, self._order[:pad]])
+        self._cursor += self.batch_size
+        data = [_nd.array(a[idx]) for _, a in self._data]
+        label = [_nd.array(a[idx]) for _, a in self._label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Fix an iterator to `size` batches per epoch (reference: ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._iter = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+        if self._reset_internal:
+            self._iter.reset()
+
+    def next(self):
+        if self._cur >= self._size:
+            raise StopIteration
+        self._cur += 1
+        try:
+            return self._iter.next()
+        except StopIteration:
+            self._iter.reset()
+            return self._iter.next()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffered prefetcher (reference: `src/io/iter_prefetcher.h`)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        it = iters[0] if isinstance(iters, (list, tuple)) else iters
+        super().__init__(it.batch_size)
+        self._iter = it
+        self._queue = queue.Queue(maxsize=2)
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        stop = object()
+        self._stop = stop
+
+        def worker():
+            while True:
+                try:
+                    self._queue.put(self._iter.next())
+                except StopIteration:
+                    self._queue.put(stop)
+                    return
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._iter.reset()
+        self._queue = queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is self._stop:
+            raise StopIteration
+        return item
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with threaded decode + augmentation.
+
+    Reference: `src/io/iter_image_recordio_2.cc` (ImageRecordIOParser2):
+    N decoder threads → augment (crop/flip) → batch → prefetch. Layout NCHW
+    float32 output, optional mean/std normalization.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, preprocess_threads=4, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)  # (C, H, W)
+        idx_path = path_imgidx or path_imgrec.rsplit(".", 1)[0] + ".idx"
+        self._record = recordio.IndexedRecordIO(idx_path, path_imgrec, "r")
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
+        self._std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
+        self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
+        self.reset()
+
+    def _decode_one(self, key):
+        header, payload = recordio.unpack(self._record.read_idx(key))
+        img = recordio.imdecode(payload, 1).astype(np.float32)  # HWC
+        C, H, W = self._data_shape
+        ih, iw = img.shape[:2]
+        if self._rand_crop and ih > H and iw > W:
+            y0 = np.random.randint(0, ih - H + 1)
+            x0 = np.random.randint(0, iw - W + 1)
+        else:
+            y0, x0 = max((ih - H) // 2, 0), max((iw - W) // 2, 0)
+        img = img[y0:y0 + H, x0:x0 + W]
+        if img.shape[0] != H or img.shape[1] != W:  # small image: pad
+            canvas = np.zeros((H, W, img.shape[2]), np.float32)
+            canvas[:img.shape[0], :img.shape[1]] = img
+            img = canvas
+        if self._rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = np.transpose(img, (2, 0, 1))
+        chw = (chw - self._mean[:chw.shape[0]]) / self._std[:chw.shape[0]]
+        label = header.label
+        if isinstance(label, np.ndarray):
+            label = label[0]
+        return chw, np.float32(label)
+
+    def reset(self):
+        keys = list(self._record.keys)
+        if self._shuffle:
+            np.random.shuffle(keys)
+        self._keys = keys
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def next(self):
+        if self._cursor >= len(self._keys):
+            raise StopIteration
+        keys = self._keys[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        pad = self.batch_size - len(keys)
+        if pad:
+            keys = keys + self._keys[:pad]
+        results = list(self._pool.map(self._decode_one, keys))
+        data = np.stack([r[0] for r in results])
+        label = np.asarray([r[1] for r in results], np.float32)
+        return DataBatch([_nd.array(data)], [_nd.array(label)], pad=pad)
+
+
+class MNISTIter(NDArrayIter):
+    """Reference: `src/io/iter_mnist.cc`; reads idx files via gluon MNIST."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=False,
+                 flat=False, **kwargs):
+        import os
+        from ..gluon.data.vision.datasets import MNIST
+        root = os.path.dirname(image) if image else "~/.mxnet/datasets/mnist"
+        train = image is None or "train" in os.path.basename(image)
+        ds = MNIST(root=root, train=train)
+        data = ds._data.astype(np.float32) / 255.0
+        data = data.reshape(len(data), -1) if flat else \
+            np.transpose(data, (0, 3, 1, 2))
+        super().__init__(data, ds._label.astype(np.float32),
+                         batch_size=batch_size, shuffle=shuffle)
+
+
+class CSVIter(DataIter):
+    """Reference: `src/io/iter_csv.cc`."""
+
+    def __init__(self, data_csv, data_shape, batch_size, label_csv=None,
+                 label_shape=(1,), round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32) \
+            if label_csv else np.zeros(len(data), np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label

@@ -1,0 +1,199 @@
+"""RecordIO: the reference's packed-dataset container format.
+
+Reference: `3rdparty/dmlc-core/include/dmlc/recordio.h` (magic-framed records)
+and `python/mxnet/recordio.py` (MXRecordIO / IndexedRecordIO / IRHeader pack
+format used by `tools/im2rec.py`). The binary layout is kept bit-compatible
+so .rec packs made for the reference load here unchanged:
+
+    [kMagic:u32][cflag<<29|len:u32][payload...][pad to 4B]
+
+IRHeader: <IfQQ> = (flag, label, id, id2); flag>0 means `flag` float32 labels
+follow the header.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "IndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img", "imdecode"]
+
+_K_MAGIC = 0xCED7230A
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class IRHeader:
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag=0, label=0.0, id=0, id2=0):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def pack(header, s):
+    """Serialize IRHeader + payload bytes (reference: mx.recordio.pack)."""
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        label = np.asarray(label, dtype=np.float32)
+        hdr = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+        return hdr + label.tobytes() + s
+    hdr = struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2)
+    return hdr + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(payload[:flag * 4], dtype=np.float32)
+        payload = payload[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, payload
+
+
+def imdecode(img_bytes, flag=1):
+    """Decode an encoded image to an HWC uint8 numpy array.
+
+    The reference uses OpenCV (`src/io/image_io.cc`); this build decodes via
+    Pillow when available, and also accepts raw .npy payloads (our im2rec
+    fallback encoding for zero-dependency environments)."""
+    if img_bytes[:6] == b"\x93NUMPY":
+        return np.load(_pyio.BytesIO(img_bytes), allow_pickle=False)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "JPEG/PNG decode needs Pillow; pack with .npy payloads instead") from e
+    img = Image.open(_pyio.BytesIO(img_bytes))
+    if flag == 1:
+        img = img.convert("RGB")
+    elif flag == 0:
+        img = img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def pack_img(header, img, quality=95, img_fmt=".npy"):
+    """Encode an image array and pack it (reference: mx.recordio.pack_img)."""
+    if img_fmt == ".npy":
+        buf = _pyio.BytesIO()
+        np.save(buf, np.asarray(img), allow_pickle=False)
+        return pack(header, buf.getvalue())
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("JPEG encode needs Pillow; use img_fmt='.npy'") from e
+    buf = _pyio.BytesIO()
+    arr = np.asarray(img)
+    Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr).save(
+        buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+        quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    header, payload = unpack(s)
+    return header, imdecode(payload, iscolor)
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: mx.recordio.MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fp = open(self.uri, "wb")
+        elif self.flag == "r":
+            self._fp = open(self.uri, "rb")
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self.writable = self.flag == "w"
+
+    def close(self):
+        self._fp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reset(self):
+        self._fp.seek(0)
+
+    def tell(self):
+        return self._fp.tell()
+
+    def seek(self, pos):
+        self._fp.seek(pos)
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self._fp.write(struct.pack("<II", _K_MAGIC, length & ((1 << 29) - 1)))
+        self._fp.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self._fp.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _K_MAGIC:
+            raise IOError(f"invalid RecordIO magic {magic:#x} in {self.uri}")
+        length = lrec & ((1 << 29) - 1)
+        buf = self._fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self._fp.read(pad)
+        return buf
+
+
+class IndexedRecordIO(MXRecordIO):
+    """Random-access .rec via .idx sidecar (reference: IndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, pos = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)

@@ -330,7 +330,18 @@ def _getitem_op(data, key=None):
 def imperative_invoke(op_name, args, kwargs):
     fn = _ops.OPS[op_name]
     in_data = tuple(_unwrap(a) for a in args)
-    out = fn(*in_data, **kwargs)
+    if op_name in _ops.RNG_OPS:
+        # Pin this invocation's randomness to one key so the autograd vjp
+        # replay reproduces the forward sample (same dropout mask etc.).
+        from .. import random as _random
+        key = _random.next_key()
+
+        def pure(*xs, _key=key):
+            with _random.key_scope(_key):
+                return fn(*xs, **kwargs)
+    else:
+        pure = (lambda *xs: fn(*xs, **kwargs))
+    out = pure(*in_data)
     multi = isinstance(out, tuple)
     outs = tuple(NDArray(o) for o in (out if multi else (out,)))
 
@@ -348,7 +359,6 @@ def imperative_invoke(op_name, args, kwargs):
                         parents.append(("leaf", a))
                 else:
                     parents.append(None)
-            pure = (lambda *xs: fn(*xs, **kwargs))
             _engine.record_op(pure, in_data, parents, outs)
     return outs if multi else outs[0]
 

@@ -15,6 +15,12 @@ from __future__ import annotations
 
 OPS = {}
 
+# Ops that draw PRNG keys at execution time. The NDArray front-end captures a
+# key per invocation and runs these inside `random.key_scope(key)` so the
+# autograd vjp replay reproduces the exact forward randomness (e.g. the same
+# dropout mask).
+RNG_OPS = set()
+
 
 def register(name):
     """Register a pure op under its MXNet name (reference: NNVM_REGISTER_OP)."""
@@ -43,3 +49,7 @@ from . import nn_ops        # noqa: E402,F401
 from . import random_ops    # noqa: E402,F401
 from . import optimizer_ops  # noqa: E402,F401
 from . import rnn_ops       # noqa: E402,F401
+
+RNG_OPS.update(name for name in OPS
+               if name.startswith("_random_") or name.startswith("_sample_"))
+RNG_OPS.update({"Dropout", "shuffle", "RNN"})

@@ -75,12 +75,15 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
                   target_shape=None, layout=None):
     n = data.ndim - 2
     stride = _pair(stride or 1, n)
+    dilate = _pair(dilate or 1, n)
     pad = _pair(pad or 0, n)
     adj = _pair(adj or 0, n)
     kernel = _pair(kernel, n) if kernel is not None else weight.shape[2:]
     # Transposed conv = gradient of conv w.r.t. input: lhs-dilated conv with
     # flipped kernel. weight layout: (in, out/group, *kernel) in MXNet.
-    pads = [(k - 1 - p, k - 1 - p + a) for k, p, a in zip(kernel, pad, adj)]
+    # Effective kernel extent accounts for rhs dilation.
+    keff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    pads = [(ke - 1 - p, ke - 1 - p + a) for ke, p, a in zip(keff, pad, adj)]
     w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
     # reshape to (out, in/group, ...) for the forward conv
     cin = data.shape[1]
@@ -92,7 +95,8 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
         else ("NCDHW", "OIDHW", "NCDHW"))
     out = lax.conv_general_dilated(
         data, w, window_strides=(1,) * n, padding=pads,
-        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=num_group)
+        lhs_dilation=stride, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out

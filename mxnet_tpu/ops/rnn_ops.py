@@ -125,6 +125,10 @@ def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
     dirs = 2 if bidirectional else 1
     layers = unpack_rnn_params(parameters, mode, num_layers, I, state_size, bidirectional)
 
+    from .. import _engine
+    from .. import random as _random
+    training = _engine.is_training() if _training is None else _training
+
     x = data
     h_finals, c_finals = [], []
     for layer in range(num_layers):
@@ -139,6 +143,12 @@ def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
             if mode == "lstm":
                 c_finals.append(cT)
         x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        # inter-layer dropout (reference: cudnn RNN dropout between stacked
+        # layers, not after the last one)
+        if training and p > 0.0 and layer < num_layers - 1:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(_random.next_key(), keep, x.shape)
+            x = jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
     out = x if layout == "TNC" else jnp.swapaxes(x, 0, 1)
     if not state_outputs:
         return out

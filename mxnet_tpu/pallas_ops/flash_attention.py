@@ -47,9 +47,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, D)
     num_kb = kv_len // block_k
+    q_len = pl.num_programs(1) * block_q
+    causal_off = kv_len - q_len  # align last query with last key (as reference)
     if causal:
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_kb)
+        hi = jax.lax.div((qi + 1) * block_q + causal_off + block_k - 1, block_k)
+        hi = jnp.clip(hi, 1, num_kb)
     else:
         hi = num_kb
 
@@ -66,7 +68,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             preferred_element_type=jnp.float32)            # (block_q, block_k)
         s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]  # (1, block_k) bcast
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            row = qi * block_q + causal_off + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             col = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -142,7 +145,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
     s = s + bias[:, None, None, :]
     if causal:
-        row = jnp.arange(Lq)[:, None]
+        row = jnp.arange(Lq)[:, None] + (Lk - Lq)
         col = jnp.arange(Lk)[None, :]
         s = jnp.where(col <= row, s, _NEG)
     p = jnp.exp(s - lse[..., None])                       # (B,H,Lq,Lk) f32

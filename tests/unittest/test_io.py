@@ -1,0 +1,91 @@
+"""IO tests (reference: `tests/python/unittest/test_io.py`)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import (NDArrayIter, ResizeIter, PrefetchingIter,
+                          ImageRecordIter, recordio)
+
+
+def test_ndarray_iter():
+    X = np.random.normal(size=(10, 3)).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+    it2 = NDArrayIter(X, y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_resize_and_prefetch():
+    X = np.random.normal(size=(8, 2)).astype(np.float32)
+    base = NDArrayIter(X, np.zeros(8, np.float32), batch_size=4)
+    resized = ResizeIter(NDArrayIter(X, np.zeros(8, np.float32), batch_size=4), 5)
+    assert len(list(resized)) == 5
+    pf = PrefetchingIter(NDArrayIter(X, np.zeros(8, np.float32), batch_size=4))
+    assert len(list(pf)) == 2
+    pf.reset()
+    assert len(list(pf)) == 2
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    out = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        out.append(rec.decode())
+    assert out == [f"record-{i}" for i in range(5)]
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    w = recordio.IndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        header = recordio.IRHeader(label=float(i), id=i)
+        img = (np.ones((8, 8, 3)) * i).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(header, img))
+    w.close()
+    r = recordio.IndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == [0, 1, 2, 3]
+    header, img = recordio.unpack_img(r.read_idx(2))
+    assert header.label == 2.0
+    np.testing.assert_array_equal(img, np.full((8, 8, 3), 2, np.uint8))
+
+
+def test_image_record_iter(tmp_path):
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    w = recordio.IndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        header = recordio.IRHeader(label=float(i % 3), id=i)
+        img = np.random.randint(0, 255, (12, 12, 3)).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(header, img))
+    w.close()
+    it = ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
+                         batch_size=4, rand_crop=True, rand_mirror=True,
+                         preprocess_threads=2)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 8, 8)
+    assert batch.label[0].shape == (4,)
+    n = 1 + len(list(it))
+    assert n == 3
+
+
+def test_multi_label_pack():
+    header = recordio.IRHeader(label=[1.0, 2.0, 3.0])
+    buf = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(buf)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"payload"
