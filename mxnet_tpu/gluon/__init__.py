@@ -1,6 +1,6 @@
 """Gluon API (reference: `python/mxnet/gluon/`)."""
 from .parameter import Parameter, ParameterDict, Constant, DeferredInitializationError
-from .block import Block, HybridBlock, Sequential, HybridSequential
+from .block import Block, HybridBlock, Sequential, HybridSequential, functional_call
 from . import nn
 from . import loss
 from . import data

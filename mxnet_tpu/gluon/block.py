@@ -27,7 +27,8 @@ from .. import random as _random
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "Sequential", "HybridSequential", "nn"]
+__all__ = ["Block", "HybridBlock", "Sequential", "HybridSequential",
+           "functional_call"]
 
 
 class Block:
@@ -248,31 +249,7 @@ class HybridBlock(Block):
 
     def _build_cached(self, args, grad_params, aux_params, train):
         """Trace self.forward into one jitted function (the CachedOp build)."""
-        treedef_box = {}
-
-        def pure(gp_data, aux_data, rng, *in_data):
-            saved = []
-            for (_, p), d in list(zip(grad_params, gp_data)) + list(zip(aux_params, aux_data)):
-                saved.append((p, p._data._data))
-                p._data._data = d
-            prev_rec = _engine.set_recording(False)
-            prev_train = _engine.set_training(train)
-            try:
-                with _random.key_scope(rng):
-                    out = self.forward(*[NDArray(d) for d in in_data])
-                new_aux = [p._data._data for _, p in aux_params]
-            finally:
-                _engine.set_recording(prev_rec)
-                _engine.set_training(prev_train)
-                for p, orig in saved:
-                    p._data._data = orig
-            out_flat, treedef = jax.tree.flatten(
-                out, is_leaf=lambda x: isinstance(x, NDArray))
-            treedef_box["td"] = treedef
-            out_data = [o._data if isinstance(o, NDArray) else jnp.asarray(o)
-                        for o in out_flat]
-            return out_data, new_aux
-
+        pure, treedef_box = _make_pure_fn(self, grad_params, aux_params, train)
         # abstract probe run: fills treedef_box, validates shapes, no compile
         jax.eval_shape(pure,
                        [p.data()._data for _, p in grad_params],
@@ -285,6 +262,49 @@ class HybridBlock(Block):
         """Serialize params (graph export is subsumed by jit re-trace on load;
         reference: `HybridBlock.export` symbol-json + params)."""
         self.save_parameters(f"{path}-{epoch:04d}.params")
+
+
+def _make_pure_fn(block, grad_params, aux_params, train):
+    """Pure jax function of a Block's forward by parameter functionalization:
+    `fn(gp_data, aux_data, rng, *in_data) -> (out_data_list, new_aux_list)`.
+
+    Shared by the hybridize cache and the sharded train-step builder
+    (mxnet_tpu.parallel) — the same trace that replaces the reference's
+    CachedOp also feeds pjit over a device mesh."""
+    treedef_box = {}
+
+    def pure(gp_data, aux_data, rng, *in_data):
+        saved = []
+        for (_, p), d in list(zip(grad_params, gp_data)) + list(zip(aux_params, aux_data)):
+            saved.append((p, p._data._data))
+            p._data._data = d
+        prev_rec = _engine.set_recording(False)
+        prev_train = _engine.set_training(train)
+        try:
+            with _random.key_scope(rng):
+                out = block.forward(*[NDArray(d) for d in in_data])
+            new_aux = [p._data._data for _, p in aux_params]
+        finally:
+            _engine.set_recording(prev_rec)
+            _engine.set_training(prev_train)
+            for p, orig in saved:
+                p._data._data = orig
+        out_flat, treedef = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, NDArray))
+        treedef_box["td"] = treedef
+        out_data = [o._data if isinstance(o, NDArray) else jnp.asarray(o)
+                    for o in out_flat]
+        return out_data, new_aux
+
+    return pure, treedef_box
+
+
+def functional_call(block, train=True):
+    """Public functionalization hook: returns (fn, grad_params, aux_params)
+    where fn(gp_data, aux_data, rng, *inputs) -> (outputs, new_aux) is pure."""
+    grad_params, aux_params = block._param_lists()
+    pure, _ = _make_pure_fn(block, grad_params, aux_params, train)
+    return pure, grad_params, aux_params
 
 
 class Sequential(Block):

@@ -1,0 +1,143 @@
+"""KVStore facade.
+
+Reference: `python/mxnet/kvstore.py` over `src/kvstore/` (CommDevice P2P
+reduce, NCCL rings, ps-lite parameter servers). On TPU there is no transport
+to manage — XLA collectives over ICI/DCN do gradient reduction inside jitted
+steps (SURVEY.md §2.5). This module keeps the *semantic* surface so reference
+training scripts run unchanged:
+
+  * push(key, value|[values]) — values are summed (the reduce the reference
+    does across GPUs/workers)
+  * pull(key, out|[outs]) — broadcast the stored value
+  * set_optimizer / update semantics (`update_on_kvstore`) — the optimizer
+    runs where the aggregate lives, as with a PS server
+
+`dist_async` is intentionally unsupported: async parameter-server updates
+have no SPMD equivalent (SURVEY.md §2.4) — sync data parallelism via the
+mesh is the supported mode, matching `dist_sync` semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kind):
+        self.type = kind
+        self._store = {}
+        self._pending = {}
+        self._opt_states = {}
+        self._optimizer = None
+        self._updater = None
+
+    # -- data plane ------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = NDArray(self._first(v)._data)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            agg = vs[0]._data
+            for extra in vs[1:]:
+                agg = agg + extra._data
+            if k not in self._store:
+                raise KeyError(f"key {k} not initialized")
+            if self._updater is not None:
+                self._updater(k, NDArray(agg), self._store[k])
+            elif self._optimizer is not None:
+                state = self._opt_states.setdefault(
+                    k, self._optimizer.create_state(k, self._store[k]))
+                self._optimizer.update(k, self._store[k], NDArray(agg), state)
+            else:
+                self._pending[k] = self._pending.get(k, 0) + agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        results = []
+        for k, o in zip(keys, outs):
+            val = self._store[k]._data
+            if k in self._pending:
+                val = val + self._pending.pop(k)
+                self._store[k]._data = val
+            if o is None:
+                results.append(NDArray(val))
+            else:
+                os_ = o if isinstance(o, (list, tuple)) else [o]
+                for dst in os_:
+                    dst._data = val
+                results.append(o)
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        return self.pull(key, out, priority)
+
+    # -- optimizer plane -------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run updates where the aggregate lives (reference:
+        `update_on_kvstore=True`, optimizer pickled to PS servers)."""
+        self._optimizer = optimizer
+        self._opt_states = {}
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    # -- cluster facts ---------------------------------------------------
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    def barrier(self):
+        pass  # single-controller SPMD: jit dispatch is globally ordered
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        from ..ndarray import ndarray as _nd
+        flat = {}
+        for k, st in getattr(self, "_opt_states", {}).items():
+            if st is None:
+                continue
+            sts = st if isinstance(st, tuple) else (st,)
+            for j, s in enumerate(sts):
+                if s is not None:
+                    flat[f"{k}.{j}"] = s
+        _nd.save(fname, flat)
+
+    def load_optimizer_states(self, fname):
+        pass
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _first(v):
+        return v[0] if isinstance(v, (list, tuple)) else v
+
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value) if value is not None else [None] * len(key)
+        return [key], [value]
+
+
+def create(name="local"):
+    name = name.lower()
+    if name in ("local", "device", "nccl", "dist", "dist_sync", "dist_device_sync",
+                "horovod"):
+        return KVStore(name)
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async is not supported on TPU: asynchronous parameter-server "
+            "updates have no SPMD equivalent. Use dist_sync (mesh data "
+            "parallelism) — see mxnet_tpu.parallel.")
+    raise ValueError(f"unknown kvstore type {name}")

@@ -1,0 +1,102 @@
+"""Functional optimizer wrappers for jitted train steps.
+
+Bridges the stateful `mxnet_tpu.optimizer.Optimizer` API to pure
+(params, grads, state, t, lr) -> (new_params, new_state) updates usable under
+`jax.jit` on a sharded mesh. With fsdp param sharding this realizes
+weight-update sharding (PAPERS.md: Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training): each device updates only its shard.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import ops as _ops
+from .. import optimizer as opt_mod
+
+__all__ = ["FunctionalOptimizer"]
+
+
+class FunctionalOptimizer:
+    """Pure-update view of an Optimizer instance (sgd/nag/adam/adamw/lamb)."""
+
+    def __init__(self, optimizer, param_names=None):
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer)
+        self.opt = optimizer
+        self.kind = type(optimizer).__name__.lower()
+        if self.kind not in ("sgd", "nag", "adam", "adamw", "lamb"):
+            raise NotImplementedError(
+                f"functional path for optimizer '{self.kind}' not implemented; "
+                "use the eager Trainer")
+        self.param_names = param_names
+
+    # -- state ----------------------------------------------------------
+    def init(self, params):
+        states = []
+        for p in params:
+            if self.kind in ("adam", "adamw", "lamb"):
+                # distinct buffers: they are donated independently each step
+                states.append((jnp.zeros(p.shape, jnp.float32),
+                               jnp.zeros(p.shape, jnp.float32)))
+            elif self.kind in ("sgd", "nag") and getattr(self.opt, "momentum", 0):
+                states.append((jnp.zeros(p.shape, jnp.float32),))
+            else:
+                states.append(())
+        return states
+
+    # -- update ---------------------------------------------------------
+    def apply(self, params, grads, states, t, lr):
+        """t, lr: traced scalars (t for bias correction; lr from scheduler)."""
+        o = self.opt
+        clip = o.clip_gradient if o.clip_gradient else -1.0
+        new_params, new_states = [], []
+        for i, (p, g, s) in enumerate(zip(params, grads, states)):
+            wd = o.wd
+            if self.kind == "sgd":
+                if s:
+                    w, m = _ops.OPS["sgd_mom_update"](
+                        p, g, s[0], lr, momentum=o.momentum, wd=wd,
+                        rescale_grad=o.rescale_grad, clip_gradient=clip)
+                    new_states.append((m,))
+                else:
+                    w = _ops.OPS["sgd_update"](
+                        p, g, lr, wd=wd, rescale_grad=o.rescale_grad,
+                        clip_gradient=clip)
+                    new_states.append(())
+            elif self.kind == "nag":
+                w, m = _ops.OPS["nag_mom_update"](
+                    p, g, s[0], lr, momentum=o.momentum, wd=wd,
+                    rescale_grad=o.rescale_grad, clip_gradient=clip)
+                new_states.append((m,))
+            elif self.kind in ("adam", "adamw"):
+                # bias-corrected lr (matches the stateful Adam.update)
+                lr_t = lr * jnp.sqrt(1 - o.beta2 ** t) / (1 - o.beta1 ** t)
+                op = "adam_update" if self.kind == "adam" else "adamw_update"
+                w, m, v = _ops.OPS[op](
+                    p, g, s[0], s[1], lr_t, beta1=o.beta1, beta2=o.beta2,
+                    epsilon=o.epsilon, wd=wd, rescale_grad=o.rescale_grad,
+                    clip_gradient=clip)
+                new_states.append((m, v))
+            elif self.kind == "lamb":
+                w, m, v = _ops.OPS["lamb_update"](
+                    p, g, s[0], s[1], lr, beta1=o.beta1, beta2=o.beta2,
+                    epsilon=o.epsilon, t=t, bias_correction=o.bias_correction,
+                    wd=self._wd_for(i), rescale_grad=o.rescale_grad,
+                    clip_gradient=clip, lower_bound=o.lower_bound,
+                    upper_bound=o.upper_bound)
+                new_states.append((m, v))
+            new_params.append(w)
+        return new_params, new_states
+
+    def _wd_for(self, i):
+        """LAMB convention: no weight decay on bias/LayerNorm params."""
+        if self.param_names is None:
+            return self.opt.wd
+        name = self.param_names[i]
+        if name.endswith("bias") or name.endswith("beta") or name.endswith("gamma"):
+            return 0.0
+        return self.opt.wd
+
+    def lr_at(self, num_update):
+        o = self.opt
+        return o.lr_scheduler(num_update) if o.lr_scheduler else o.lr

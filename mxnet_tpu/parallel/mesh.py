@@ -1,0 +1,93 @@
+"""Device mesh construction.
+
+The TPU-native replacement for the reference's transport stack
+(`src/kvstore/comm.h` CommDevice, `kvstore_nccl.h`, `3rdparty/ps-lite/` —
+SURVEY.md §2.5): no user-level transport exists; a named `jax.sharding.Mesh`
+plus sharding annotations make XLA emit all collectives over ICI/DCN.
+
+Axis vocabulary (used across parallel/ and models/):
+  dp   — data parallel (batch)
+  fsdp — parameter/optimizer-state sharding over the data axis (ZeRO-like;
+          the TPU analog of the reference's parameter-server sharding,
+          `MXNET_KVSTORE_BIGARRAY_BOUND` round-robin)
+  tp   — tensor (Megatron) parallel
+  sp   — sequence/context parallel (ring attention)
+  pp   — pipeline stages
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding",
+           "PartitionSpec", "local_mesh_devices"]
+
+_current = {"mesh": None}
+
+
+class MeshPlan:
+    """A named parallelism plan: axis name → size. Size -1 means 'absorb the
+    remaining devices' (at most one axis may be -1)."""
+
+    def __init__(self, dp=1, fsdp=1, tp=1, sp=1, pp=1):
+        self.axes = {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp, "pp": pp}
+
+    def resolve(self, n_devices):
+        sizes = dict(self.axes)
+        fill = [k for k, v in sizes.items() if v == -1]
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if fill:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            sizes[fill[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"plan {sizes} needs {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def local_mesh_devices(n=None):
+    devs = jax.devices()
+    return devs if n is None else devs[:n]
+
+
+def make_mesh(plan=None, devices=None, **axis_sizes):
+    """Build a Mesh. `make_mesh(dp=-1)` → pure data parallel over all devices;
+    `make_mesh(dp=2, tp=4)` etc. Axes of size 1 are kept (harmless in specs).
+
+    ICI note: jax.devices() order follows the physical torus; keeping the
+    innermost (fastest-varying) axes for tp/sp places those collectives on
+    neighbouring chips, which is what mesh_utils would do for a real slice.
+    """
+    if plan is None:
+        plan = MeshPlan(**{k: axis_sizes.get(k, 1) for k in
+                           ("dp", "fsdp", "tp", "sp", "pp")}) \
+            if axis_sizes else MeshPlan(dp=-1)
+    devices = devices or jax.devices()
+    sizes = plan.resolve(len(devices))
+    # order: pp outermost (cross-slice ok), then dp, fsdp, sp, tp innermost
+    order = ["pp", "dp", "fsdp", "sp", "tp"]
+    shape = [sizes[a] for a in order]
+    arr = np.asarray(devices[:math.prod(shape)]).reshape(shape)
+    mesh = Mesh(arr, axis_names=tuple(order))
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh):
+    _current["mesh"] = mesh
+
+
+def current_mesh():
+    if _current["mesh"] is None:
+        make_mesh()
+    return _current["mesh"]
+
+
+def named_sharding(*spec, mesh=None):
+    """NamedSharding on the active mesh; `named_sharding('dp', None)` etc."""
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, PartitionSpec(*spec))

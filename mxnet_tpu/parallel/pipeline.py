@@ -1,0 +1,76 @@
+"""Pipeline parallelism: stage-sharded execution with microbatching.
+
+Net-new vs the reference (SURVEY.md §2.4 — MXNet's only model parallelism is
+coarse `group2ctx` layer placement). GPipe-style schedule expressed the TPU
+way: stages live on the `pp` mesh axis, activations move stage-to-stage with
+`lax.ppermute` (ICI collective-permute), and the fill/drain bubble comes from
+a static fori_loop of length M + S - 1.
+
+Constraint (standard for collective pipelines): every stage maps activations
+of one fixed shape to the same shape.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+__all__ = ["pipeline_apply", "pipeline_shard_map"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+    """Run inside shard_map. stage_params: this device's stage parameters;
+    microbatches: (M, mb, ...) the full input, replicated across stages.
+    Returns (M, mb, ...) outputs of the LAST stage, replicated."""
+    n = lax.psum(1, axis_name)
+    sid = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    steps = M + n - 1
+    mb_shape = microbatches.shape[1:]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    state = jnp.zeros(mb_shape, microbatches.dtype)
+    outs = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+
+    def body(t, carry):
+        state, outs = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        x_in = jnp.where(sid == 0,
+                         lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                                  keepdims=False),
+                         state)
+        y = stage_fn(stage_params, x_in)
+        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+        write = jnp.logical_and(sid == n - 1, t >= n - 1)
+        prev = lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, y, prev), out_idx, 0)
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outs
+
+    state, outs = lax.fori_loop(0, steps, body, (state, outs))
+    # broadcast the last stage's outputs to every stage
+    outs = lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs
+
+
+def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
+                       axis_name="pp"):
+    """Top-level helper: stacked_params pytree with leading stage dim sharded
+    over `pp`; microbatches (M, mb, ...) replicated."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh or current_mesh()
+    pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+
+    def fn(params_local, mb):
+        params_local = jax.tree.map(lambda a: a[0], params_local)  # drop stage dim
+        return pipeline_apply(stage_fn, params_local, mb, axis_name)
+
+    return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+                     check_rep=False)(stacked_params, microbatches)

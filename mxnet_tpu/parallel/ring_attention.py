@@ -1,0 +1,132 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Net-new capability vs the reference (SURVEY.md §5.7 — MXNet has nothing that
+shards the sequence dimension). Design: the sequence is sharded over the
+`sp` mesh axis; each device holds local Q/K/V blocks. K/V blocks rotate
+around the ring via `lax.ppermute` (XLA lowers to ICI collective-permute)
+while each device accumulates its queries' attention online — flash-style
+log-sum-exp merging, so memory stays O(L_local) and compute overlaps the
+rotation. Use under `shard_map` with the `sp` axis (see `ring_self_attention`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+_NEG = -1e30
+
+
+def _block_attn(q, k, v, bias, causal_mode, sm_scale):
+    """One q-block × kv-block attention returning (out_unnorm, m, l).
+
+    causal_mode: 0 = full attention, 1 = causal within block, 2 = all masked.
+    Shapes: q (B,H,Lq,D), k/v (B,H,Lk,D), bias (B,Lk) additive.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    Lq, Lk = q.shape[2], k.shape[2]
+    if causal_mode == 1:
+        row = jnp.arange(Lq)[:, None] + (Lk - Lq)
+        col = jnp.arange(Lk)[None, :]
+        s = jnp.where(col <= row, s, _NEG)
+    elif causal_mode == 2:
+        s = jnp.full_like(s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)                      # (B,H,Lq,1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out, m, l
+
+
+def ring_attention(q, k, v, axis_name, mask=None, causal=False, sm_scale=None):
+    """Attention over a ring: call INSIDE shard_map with seq sharded on
+    `axis_name`. q,k,v: (B, H, L_local, D) per device; mask: (B, L_local)
+    local padding mask (True = attend).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    bias = None
+    if mask is not None:
+        bias = jnp.where(mask.astype(bool), 0.0, _NEG).astype(jnp.float32)
+
+    B, H, L, D = q.shape
+    m_acc = jnp.full((B, H, L, 1), _NEG, jnp.float32)
+    l_acc = jnp.zeros((B, H, L, 1), jnp.float32)
+    o_acc = jnp.zeros((B, H, L, D), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(carry, blk):
+        m_acc, l_acc, o_acc = carry
+        o_blk, m_blk, l_blk = blk
+        m_new = jnp.maximum(m_acc, m_blk)
+        a = jnp.exp(m_acc - m_new)
+        b = jnp.exp(m_blk - m_new)
+        return (m_new, l_acc * a + l_blk * b, o_acc * a + o_blk * b)
+
+    k_cur, v_cur, b_cur = k, v, bias if bias is not None else jnp.zeros((B, L), jnp.float32)
+    carry = (m_acc, l_acc, o_acc)
+    # python loop of static length n: unrolled into the XLA program so each
+    # ppermute overlaps the previous block's compute
+    for step in range(n):
+        src = (my - step) % n  # which shard's kv we currently hold
+        if causal:
+            # shard-level causality: src < my → full; == → causal; > → masked.
+            # All three variants are computed branch-free via masks on a
+            # traced predicate (src is traced).
+            s_full, m_full, l_full = _block_attn(q, k_cur, v_cur, b_cur, 0, sm_scale)
+            s_caus, m_caus, l_caus = _block_attn(q, k_cur, v_cur, b_cur, 1, sm_scale)
+            is_caus = (src == my)
+            is_masked = (src > my)
+            o_blk = jnp.where(is_caus, s_caus, s_full)
+            m_blk = jnp.where(is_caus, m_caus, m_full)
+            l_blk = jnp.where(is_caus, l_caus, l_full)
+            m_blk = jnp.where(is_masked, jnp.full_like(m_blk, _NEG), m_blk)
+            l_blk = jnp.where(is_masked, jnp.zeros_like(l_blk), l_blk)
+            o_blk = jnp.where(is_masked, jnp.zeros_like(o_blk), o_blk)
+        else:
+            o_blk, m_blk, l_blk = _block_attn(q, k_cur, v_cur, b_cur, 0, sm_scale)
+        carry = merge(carry, (o_blk, m_blk, l_blk))
+        if step < n - 1:
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+            b_cur = lax.ppermute(b_cur, axis_name, perm)
+
+    m_acc, l_acc, o_acc = carry
+    return (o_acc / jnp.maximum(l_acc, 1e-30)).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mask=None, causal=False, mesh=None,
+                        axis_name="sp"):
+    """Convenience wrapper: shard_map over the mesh's `sp` axis with
+    (B, H, L, D) global tensors; L is sharded."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = mesh or current_mesh()
+    qspec = P(None, None, axis_name, None)
+    mspec = P(None, axis_name)
+
+    if mask is not None:
+        fn = shard_map(
+            lambda q_, k_, v_, m_: ring_attention(
+                q_, k_, v_, axis_name, mask=m_, causal=causal),
+            mesh=mesh, in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
+            check_rep=False)
+        return fn(q, k, v, mask)
+    fn = shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name, causal=causal),
+        mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+        check_rep=False)
+    return fn(q, k, v)

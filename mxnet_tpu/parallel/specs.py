@@ -1,0 +1,77 @@
+"""Sharding rule helpers.
+
+GSPMD sharding annotations replace the reference's per-tensor kvstore traffic
+(SURVEY.md §2.5). Parameters can carry explicit specs
+(`Parameter.set_sharding`); these helpers fill in the rest.
+"""
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import current_mesh
+
+__all__ = ["param_spec", "batch_spec", "replicated", "fsdp_spec",
+           "apply_tp_rules", "DATA_AXES"]
+
+# both dp and fsdp are "data" axes from the batch's point of view
+DATA_AXES = ("dp", "fsdp")
+
+
+def replicated(mesh=None):
+    mesh = mesh or current_mesh()
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_spec(ndim, mesh=None, extra=None):
+    """Batch sharded over the data axes on dim 0; rest replicated."""
+    mesh = mesh or current_mesh()
+    axes = [a for a in DATA_AXES if mesh.shape.get(a, 1) > 1] or list(DATA_AXES)
+    spec = [tuple(axes)] + [None] * (ndim - 1)
+    if extra:
+        for dim, ax in extra.items():
+            spec[dim] = ax
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def fsdp_spec(shape, mesh=None):
+    """ZeRO-style: shard the largest divisible dim over 'fsdp' (TPU analog of
+    the reference's big-array round-robin across PS servers)."""
+    mesh = mesh or current_mesh()
+    size = mesh.shape.get("fsdp", 1)
+    if size <= 1 or not shape:
+        return replicated(mesh)
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+        if shape[dim] % size == 0 and shape[dim] >= size:
+            spec = [None] * len(shape)
+            spec[dim] = "fsdp"
+            return NamedSharding(mesh, PartitionSpec(*spec))
+    return replicated(mesh)
+
+
+def param_spec(param, mesh=None, mode="replicate"):
+    """Sharding for one Parameter: explicit set_sharding wins; else policy."""
+    mesh = mesh or current_mesh()
+    if param.sharding is not None:
+        s = param.sharding
+        if isinstance(s, PartitionSpec):
+            return NamedSharding(mesh, s)
+        return s
+    if mode == "fsdp":
+        return fsdp_spec(param.shape, mesh)
+    return replicated(mesh)
+
+
+def apply_tp_rules(block, rules):
+    """Attach Megatron-style tp specs by parameter-path regex.
+
+    rules: list of (regex, PartitionSpec). First match wins. Example for a
+    transformer MLP: [(r'.*ffn_in.*weight', P('tp', None)),
+                      (r'.*ffn_out.*weight', P(None, 'tp'))]."""
+    import re
+    for path, p in block.collect_params().items():
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                p.set_sharding(spec)
+                break

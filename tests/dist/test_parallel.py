@@ -1,0 +1,191 @@
+"""Mesh/sharding/collective tests on the 8-device virtual CPU mesh.
+
+Reference translation (SURVEY.md §4): the reference tests multi-node as
+multi-process on localhost (`tests/nightly/dist_sync_kvstore.py`); here
+`--xla_force_host_platform_device_count=8` gives 8 devices in-process.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.pallas_ops import mha_reference
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def test_make_mesh_shapes():
+    mesh = parallel.make_mesh(dp=-1)
+    assert mesh.shape["dp"] == 8
+    mesh = parallel.make_mesh(dp=2, tp=4)
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 4
+    mesh = parallel.make_mesh(dp=2, fsdp=2, sp=2)
+    assert mesh.shape["sp"] == 2
+    with pytest.raises(ValueError):
+        parallel.make_mesh(dp=3, tp=4)
+
+
+def test_sharded_trainer_dp_matches_single_device():
+    """The sharded full-step jit must compute the same updates as the eager
+    Trainer path (cross-impl consistency oracle)."""
+    np.random.seed(0)
+    X = np.random.normal(size=(32, 10)).astype(np.float32)
+    W = np.random.normal(size=(10,)).astype(np.float32)
+    y = (X @ W > 0).astype(np.float32)
+
+    def build():
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=10), nn.Dense(2, in_units=16))
+        net.initialize()
+        return net
+
+    # eager reference path
+    net1 = build()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr1 = gluon.Trainer(net1.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    from mxnet_tpu import autograd
+    for _ in range(3):
+        with autograd.record():
+            L = loss_fn(net1(nd.array(X)), nd.array(y))
+            Lm = L.mean()
+        Lm.backward()
+        # eager Trainer rescales by batch; loss.mean() already averaged, so
+        # scale grads to match: use batch_size = len(X) after mean → factor 1
+        tr1._optimizer.rescale_grad = 1.0
+        tr1._update()
+
+    # sharded path over dp=8
+    parallel.make_mesh(dp=-1)
+    net2 = build()
+    tr2 = parallel.ShardedTrainer(net2, loss_fn, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(3):
+        tr2.step(nd.array(X), nd.array(y))
+    tr2.sync_to_block()
+
+    for (k, p1), (_, p2) in zip(net1.collect_params().items(),
+                                net2.collect_params().items()):
+        np.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                   rtol=2e-4, atol=2e-4, err_msg=k)
+
+
+def test_sharded_trainer_fsdp():
+    parallel.make_mesh(dp=2, fsdp=4)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16), nn.Dense(8, in_units=32))
+    net.initialize()
+    tr = parallel.ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                 "adam", {"learning_rate": 0.01},
+                                 param_mode="fsdp")
+    X = nd.array(np.random.normal(size=(16, 16)).astype(np.float32))
+    y = nd.array(np.zeros(16, np.float32))
+    l0 = float(tr.step(X, y).asscalar())
+    for _ in range(5):
+        loss = tr.step(X, y)
+    assert float(loss.asscalar()) < l0
+    # fsdp: at least one param actually sharded over the fsdp axis
+    shardings = [p.sharding.spec for p in tr.params]
+    assert any("fsdp" in str(s) for s in shardings)
+
+
+def test_sharded_trainer_lamb_and_scheduler():
+    from mxnet_tpu.lr_scheduler import PolyScheduler
+    parallel.make_mesh(dp=-1)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    tr = parallel.ShardedTrainer(
+        net, gluon.loss.L2Loss(), "lamb",
+        {"learning_rate": 0.01, "lr_scheduler": PolyScheduler(100, base_lr=0.01)})
+    X = nd.array(np.random.normal(size=(8, 8)).astype(np.float32))
+    y = nd.array(np.random.normal(size=(8, 4)).astype(np.float32))
+    for _ in range(3):
+        loss = tr.step(X, y)
+    assert np.isfinite(float(loss.asscalar()))
+
+
+def test_ring_attention_matches_reference():
+    parallel.make_mesh(sp=8)
+    B, H, L, D = 2, 4, 64, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    out_ring = parallel.ring_self_attention(q, k, v)
+    out_ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_causal_and_mask():
+    parallel.make_mesh(sp=8)
+    B, H, L, D = 1, 2, 64, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, L, D)).astype(np.float32))
+    out_ring = parallel.ring_self_attention(q, k, v, causal=True)
+    out_ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+    # padding mask
+    mask = jnp.asarray(rng.rand(B, L) > 0.3)
+    out_ring = parallel.ring_self_attention(q, k, v, mask=mask)
+    bias = jnp.where(mask, 0.0, -1e30)[:, None, None, :]
+    out_ref = mha_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_matches_sequential():
+    parallel.make_mesh(pp=8)
+    D = 16
+    rng = np.random.RandomState(0)
+    # 8 stages, each y = tanh(x @ w)
+    ws = jnp.asarray(rng.normal(0, 0.5, size=(8, D, D)).astype(np.float32))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    M, mb = 4, 8
+    x = jnp.asarray(rng.normal(size=(M, mb, D)).astype(np.float32))
+    out_pp = parallel.pipeline_shard_map(stage_fn, ws, x)
+    # sequential reference
+    ref = x
+    for s in range(8):
+        ref = jnp.tanh(ref @ ws[s])
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kvstore_semantics():
+    kv = mx.kv.create("device")
+    kv.init(3, nd.ones((2, 3)))
+    # push list of per-device grads → summed (reference dist_sync invariant:
+    # pulled value == num_workers × pushed)
+    kv.push(3, [nd.ones((2, 3))] * 4)
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 5.0))
+    with pytest.raises(Exception):
+        mx.kv.create("dist_async")
+
+
+def test_kvstore_update_on_kvstore():
+    from mxnet_tpu import optimizer as opt
+    kv = mx.kv.create("dist_sync")
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5))
+    kv.init(0, nd.ones((4,)))
+    kv.push(0, nd.ones((4,)))
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 0.5))  # 1 - 0.5*1
