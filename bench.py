@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Benchmark: BERT-base pretraining throughput, tokens/sec/chip.
+
+The BASELINE.json headline metric (GluonNLP BERT tokens/sec/chip). Runs the
+flagship path: one jitted train step (forward+loss+backward+LAMB) on the real
+TPU, bf16 compute / f32 optimizer state, flash-attention Pallas kernel.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`vs_baseline` compares against `published` in BASELINE.json when present
+(it ships empty — the reference mount had no numbers), else 1.0.
+"""
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.models import bert as bert_mod
+
+    backend = jax.default_backend()
+    n_dev = len(jax.devices())
+    mesh = parallel.make_mesh(dp=-1)
+
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        batch, seq_len, masked = 32, 512, 76
+        cfg = bert_mod.bert_base_config(dtype="bfloat16")
+        steps, warmup = 20, 4
+    else:  # CPU smoke mode so the script always runs
+        batch, seq_len, masked = 8, 64, 10
+        cfg = bert_mod.bert_tiny_config(max_length=64)
+        steps, warmup = 3, 1
+
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "lamb",
+        {"learning_rate": 1e-3, "wd": 0.01})
+
+    b = bert_mod.make_synthetic_batch(cfg, batch, seq_len, masked, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k]) for k in ("mlm_labels", "mlm_weights", "nsp_labels")]
+
+    # NOTE: sync via scalar host fetch — on the axon tunnel platform
+    # block_until_ready does not actually block. The final loss depends on
+    # every prior step's params, so one fetch fences the whole timed region.
+    for _ in range(warmup):
+        loss = trainer.step(data, labels)
+    float(loss.asscalar())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(data, labels)
+    loss_val = float(loss.asscalar())
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq_len * steps / dt
+    per_chip = tokens_per_sec / n_dev
+
+    # rough MFU: BERT fwd+bwd ≈ 6 * params * tokens FLOPs (ignoring attn quadratic)
+    n_params = trainer.param_count
+    flops_per_token = 6 * n_params
+    peak = {"tpu": 394e12}.get(backend)  # v5e bf16 peak per chip
+    mfu = (per_chip * flops_per_token / peak) if peak and on_tpu else None
+    print(f"# backend={backend} devices={n_dev} params={n_params/1e6:.1f}M "
+          f"batch={batch} seq={seq_len} steps={steps} time={dt:.2f}s "
+          f"loss={loss_val:.3f}"
+          + (f" est_mfu={mfu:.3f}" if mfu else ""), file=sys.stderr)
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            published = json.load(f).get("published", {})
+        baseline = published.get("bert_base_tokens_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

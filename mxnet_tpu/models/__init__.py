@@ -1,0 +1,19 @@
+"""Model zoo: the five BASELINE.json workload families (SURVEY.md §2.3/L12).
+
+Reference ecosystems (GluonCV/GluonNLP/Sockeye/GluonTS) are separate repos
+consuming only the Python API; here the models ship in-tree, built from
+gluon blocks with TPU-first internals (flash attention, scan RNN, bf16).
+"""
+from . import bert
+from . import resnet
+from . import transformer
+from . import deepar
+from . import ssd
+
+from .bert import BERTModel, BERTForPretraining, bert_base_config, bert_large_config
+from .resnet import get_resnet, resnet18_v1, resnet50_v1, resnet101_v1
+
+__all__ = ["bert", "resnet", "transformer", "deepar", "ssd",
+           "BERTModel", "BERTForPretraining", "bert_base_config",
+           "bert_large_config", "get_resnet", "resnet18_v1", "resnet50_v1",
+           "resnet101_v1"]

@@ -1,0 +1,232 @@
+"""BERT — the flagship workload (BASELINE.json: GluonNLP BERT pretraining).
+
+Reference: GluonNLP's BERTModel/BERTEncoder over mxnet's fused attention ops
+(`src/operator/contrib/transformer.cc`). TPU-first re-design:
+  * attention = Pallas flash kernel (mxnet_tpu.pallas_ops), bf16 in/f32 acc
+  * one jitted train step via parallel.ShardedTrainer (LAMB, weight-update
+    sharding); tp rules shard QKV/FFN Megatron-style; sp rules enable ring
+    attention for long sequences
+  * MLM gathers masked positions before the vocab projection so the big
+    (B,P,V) logits tensor — not (B,L,V) — hits the MXU
+
+Pretraining objective matches GluonNLP: MLM over masked positions + NSP.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon import nn, HybridBlock, loss as gloss
+from ..gluon.parameter import Parameter
+from ..ndarray import NDArray
+from ..ndarray import ndarray as F
+
+
+def bert_base_config(**overrides):
+    cfg = dict(vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
+               num_heads=12, max_length=512, type_vocab_size=2, dropout=0.1,
+               dtype="float32")
+    cfg.update(overrides)
+    return cfg
+
+
+def bert_large_config(**overrides):
+    cfg = bert_base_config(units=1024, hidden_size=4096, num_layers=24,
+                           num_heads=16)
+    cfg.update(overrides)
+    return cfg
+
+
+def bert_tiny_config(**overrides):
+    """Test-scale config."""
+    cfg = bert_base_config(vocab_size=128, units=64, hidden_size=128,
+                           num_layers=2, num_heads=4, max_length=64, dropout=0.0)
+    cfg.update(overrides)
+    return cfg
+
+
+class BERTAttention(HybridBlock):
+    """Self-attention with fused QKV and the flash kernel."""
+
+    def __init__(self, units, num_heads, dropout=0.0, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self.qkv = nn.Dense(3 * units, in_units=units, flatten=False, dtype=dtype,
+                            weight_initializer="xavier")
+        self.proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
+                             weight_initializer="xavier")
+        self._dropout = dropout
+
+    def forward(self, x, mask=None):
+        # x: (B, L, E); mask: (B, L) 1=valid
+        qkv = self.qkv(x)  # (B, L, 3E)
+        out = F.fused_self_attention(qkv, mask, num_heads=self._num_heads)
+        return self.proj(out)
+
+
+class BERTEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self.attention = BERTAttention(units, num_heads, dropout, dtype)
+        self.attn_ln = nn.LayerNorm(in_channels=units)
+        self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
+                               dtype=dtype, weight_initializer="xavier")
+        self.ffn_out = nn.Dense(units, in_units=hidden_size, flatten=False,
+                                dtype=dtype, weight_initializer="xavier")
+        self.ffn_ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        attn = self.attention(x, mask)
+        if self.dropout:
+            attn = self.dropout(attn)
+        x = self.attn_ln(x + attn)
+        h = F.Activation(self.ffn_in(x), act_type="gelu")
+        h = self.ffn_out(h)
+        if self.dropout:
+            h = self.dropout(h)
+        return self.ffn_ln(x + h)
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder stack + pooler (reference: gluonnlp BERTModel)."""
+
+    def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
+                 max_length=512, type_vocab_size=2, dropout=0.1,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
+                                       weight_initializer="xavier")
+        self.token_type_embed = nn.Embedding(type_vocab_size, units, dtype=dtype,
+                                             weight_initializer="xavier")
+        self.position_embed = Parameter("position_weight", shape=(max_length, units),
+                                        dtype=dtype, init="xavier")
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(BERTEncoderLayer(units, hidden_size, num_heads,
+                                             dropout, dtype))
+        self.pooler = nn.Dense(units, in_units=units, flatten=False,
+                               activation="tanh", dtype=dtype,
+                               weight_initializer="xavier")
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        B, L = inputs.shape
+        max_len = self.position_embed.shape[0]
+        if L > max_len:
+            raise ValueError(
+                f"sequence length {L} exceeds max_length {max_len}")
+        x = self.word_embed(inputs)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        pos = NDArray(self.position_embed.data()._data[:L])
+        x = x + pos.expand_dims(axis=0)
+        x = self.embed_ln(x)
+        if self.embed_dropout:
+            x = self.embed_dropout(x)
+        mask = None
+        if valid_length is not None:
+            import jax.numpy as jnp
+            vl = valid_length._data if isinstance(valid_length, NDArray) else valid_length
+            mask = NDArray(jnp.arange(L)[None, :] < vl[:, None].astype(jnp.int32))
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler(F.slice_axis(x, axis=1, begin=0, end=1).squeeze(axis=1))
+        return x, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads (reference: gluonnlp BERTForPretrain)."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        units, vocab = cfg["units"], cfg["vocab_size"]
+        self.bert = BERTModel(**cfg)
+        self.mlm_transform = nn.Dense(units, in_units=units, flatten=False,
+                                      activation=None, dtype=cfg["dtype"],
+                                      weight_initializer="xavier")
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        # decoder weight tied to word embedding; separate bias
+        self.mlm_bias = Parameter("mlm_bias", shape=(vocab,), init="zeros")
+        self.nsp = nn.Dense(2, in_units=units, dtype=cfg["dtype"],
+                            weight_initializer="xavier")
+
+    def forward(self, inputs, token_types, valid_length, masked_positions):
+        """Returns (mlm_scores (B,P,V), nsp_scores (B,2))."""
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+        seq, pooled = self.bert(inputs, token_types, valid_length)
+        # gather masked positions before the vocab matmul: (B, P, E)
+        gathered = apply_op(
+            lambda s, p: jnp.take_along_axis(s, p.astype(jnp.int32)[..., None], 1),
+            seq, masked_positions)
+        h = self.mlm_transform(gathered)
+        h = F.Activation(h, act_type="gelu")
+        h = self.mlm_ln(h)
+        scores = apply_op(
+            lambda hh, w, b: jnp.matmul(hh, w.T) + b,
+            h, self.bert.word_embed.weight.data(), self.mlm_bias.data())
+        return scores, self.nsp(pooled)
+
+
+def bert_pretrain_loss(mlm_scores, nsp_scores, mlm_labels, mlm_weights, nsp_labels):
+    """Pretraining loss on NDArrays (ShardedTrainer loss_fn AND eager
+    autograd compatible). mlm_scores (B,P,V), mlm_labels (B,P),
+    mlm_weights (B,P) 1 for real masked positions, nsp_labels (B,).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import apply_op
+
+    def compute(ms, ns, lbl, w, nl):
+        logp = jax.nn.log_softmax(ms.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, lbl.astype(jnp.int32)[..., None], -1)[..., 0]
+        w = w.astype(jnp.float32)
+        mlm_loss = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+        nlogp = jax.nn.log_softmax(ns.astype(jnp.float32), -1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(nlogp, nl.astype(jnp.int32)[:, None], -1))
+        return mlm_loss + nsp_loss
+
+    return apply_op(compute, mlm_scores, nsp_scores, mlm_labels, mlm_weights,
+                    nsp_labels)
+
+
+def tp_rules(tp_axis="tp"):
+    """Megatron sharding for BERT params (apply via parallel.apply_tp_rules):
+    QKV and FFN-in split over heads/hidden (dim 0 of (out,in) weights),
+    proj and FFN-out split on input dim; embeddings sharded over vocab."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"\.qkv\.weight$", P(tp_axis, None)),
+        (r"\.qkv\.bias$", P(tp_axis)),
+        (r"\.ffn_in\.weight$", P(tp_axis, None)),
+        (r"\.ffn_in\.bias$", P(tp_axis)),
+        (r"\.proj\.weight$", P(None, tp_axis)),
+        (r"\.ffn_out\.weight$", P(None, tp_axis)),
+        (r"word_embed\.weight$", P(tp_axis, None)),
+    ]
+
+
+def make_synthetic_batch(cfg, batch_size, seq_len, num_masked=20, seed=0):
+    """Deterministic synthetic pretraining batch (zero-egress environments)."""
+    rng = np.random.RandomState(seed)
+    V = cfg["vocab_size"]
+    data = dict(
+        input_ids=rng.randint(0, V, (batch_size, seq_len)).astype(np.int32),
+        token_types=(rng.rand(batch_size, seq_len) > 0.5).astype(np.int32),
+        valid_length=np.full((batch_size,), seq_len, np.int32),
+        masked_positions=np.stack(
+            [rng.choice(seq_len, num_masked, replace=False)
+             for _ in range(batch_size)]).astype(np.int32),
+        mlm_labels=rng.randint(0, V, (batch_size, num_masked)).astype(np.int32),
+        mlm_weights=np.ones((batch_size, num_masked), np.float32),
+        nsp_labels=rng.randint(0, 2, (batch_size,)).astype(np.int32),
+    )
+    return data

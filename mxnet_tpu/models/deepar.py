@@ -1,0 +1,143 @@
+"""DeepAR probabilistic forecasting (BASELINE.json workload #5).
+
+Reference: GluonTS DeepAREstimator (autoregressive LSTM emitting distribution
+parameters; trained by negative log-likelihood, forecast by ancestral
+sampling). TPU-first: the LSTM is the lax.scan fused layer; sampling rolls
+the network with a scan as well, so the whole sampler jits.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn, rnn, HybridBlock
+from ..ndarray import NDArray
+from ..ndarray import ndarray as F
+
+
+class GaussianOutput:
+    """Distribution head: projects hidden → (mu, sigma)."""
+
+    args_dim = 2
+
+    @staticmethod
+    def params(raw):
+        import jax.numpy as jnp
+        mu = raw[..., 0]
+        sigma = jnp.logaddexp(raw[..., 1], 0.0) + 1e-6  # softplus
+        return mu, sigma
+
+    @staticmethod
+    def nll(raw, target):
+        import jax.numpy as jnp
+        mu, sigma = GaussianOutput.params(raw)
+        t = target.astype(jnp.float32)
+        return 0.5 * jnp.log(2 * jnp.pi) + jnp.log(sigma) + \
+            0.5 * jnp.square((t - mu) / sigma)
+
+    @staticmethod
+    def sample(raw, key):
+        import jax
+        import jax.numpy as jnp
+        mu, sigma = GaussianOutput.params(raw)
+        return mu + sigma * jax.random.normal(key, mu.shape)
+
+
+class NegativeBinomialOutput:
+    args_dim = 2
+
+    @staticmethod
+    def params(raw):
+        import jax.numpy as jnp
+        mu = jnp.logaddexp(raw[..., 0], 0.0) + 1e-6
+        alpha = jnp.logaddexp(raw[..., 1], 0.0) + 1e-6
+        return mu, alpha
+
+    @staticmethod
+    def nll(raw, target):
+        import jax.numpy as jnp
+        from jax.scipy.special import gammaln
+        mu, alpha = NegativeBinomialOutput.params(raw)
+        t = target.astype(jnp.float32)
+        r = 1.0 / alpha
+        p = mu / (mu + r)
+        return -(gammaln(t + r) - gammaln(r) - gammaln(t + 1)
+                 + r * jnp.log(1 - p) + t * jnp.log(p))
+
+    @staticmethod
+    def sample(raw, key):
+        import jax
+        import jax.numpy as jnp
+        mu, alpha = NegativeBinomialOutput.params(raw)
+        k1, k2 = jax.random.split(key)
+        r = 1.0 / alpha
+        rate = jax.random.gamma(k1, r) * mu * alpha
+        return jax.random.poisson(k2, rate).astype(jnp.float32)
+
+
+class DeepAR(HybridBlock):
+    """context window conditioning → h; prediction by NLL on known targets
+    (training) or ancestral sampling (forecast)."""
+
+    def __init__(self, num_cells=40, num_layers=2, context_length=24,
+                 prediction_length=12, distr=GaussianOutput, num_features=1,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.context_length = context_length
+        self.prediction_length = prediction_length
+        self.distr = distr
+        self.lstm = rnn.LSTM(num_cells, num_layers=num_layers, layout="NTC",
+                             dropout=dropout, input_size=num_features + 1)
+        self.proj = nn.Dense(distr.args_dim, in_units=num_cells, flatten=False)
+
+    def forward(self, past_target, features=None):
+        """Teacher-forced: past_target (B, T); returns raw distr params
+        (B, T-1, args_dim) predicting target[t] from target[<t]."""
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+
+        def make_input(t, f=None):
+            x = t[:, :-1, None].astype(jnp.float32)  # lagged input
+            extra = f[:, :-1].astype(jnp.float32) if f is not None \
+                else jnp.zeros_like(x)
+            return jnp.concatenate([x, extra], axis=-1)
+
+        x = apply_op(make_input, past_target) if features is None \
+            else apply_op(make_input, past_target, features)
+        h = self.lstm(x)
+        return self.proj(h)
+
+    def loss(self, past_target, features=None):
+        raw = self.forward(past_target, features)
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+        return apply_op(
+            lambda r, t: jnp.mean(self.distr.nll(r, t[:, 1:])),
+            raw, past_target)
+
+    def sample_paths(self, context, num_samples=100, features=None):
+        """Ancestral sampling: returns (num_samples, B, prediction_length)."""
+        import jax
+        import jax.numpy as jnp
+        from .. import random as _random
+
+        B = context.shape[0]
+        out = []
+        for s in range(num_samples):
+            seq = context._data.astype(jnp.float32)
+            for t in range(self.prediction_length):
+                raw = self.forward(NDArray(seq))
+                step_raw = raw._data[:, -1]
+                val = self.distr.sample(step_raw, _random.next_key())
+                seq = jnp.concatenate([seq, val[:, None]], axis=1)
+            out.append(seq[:, context.shape[1]:])
+        return NDArray(jnp.stack(out))
+
+
+def crps_eval(samples, target):
+    """Sample-based CRPS (GluonTS quality metric), numpy."""
+    s = np.asarray(samples)  # (S, B, T)
+    t = np.asarray(target)   # (B, T)
+    term1 = np.mean(np.abs(s - t[None]), axis=0)
+    term2 = 0.5 * np.mean(
+        np.abs(s[:, None] - s[None, :]), axis=(0, 1))
+    return float(np.mean(term1 - term2))

@@ -1,0 +1,134 @@
+"""ResNet family (BASELINE.json: GluonCV ResNet-50 images/sec/chip).
+
+Reference: GluonCV / `python/mxnet/gluon/model_zoo/vision/resnet.py`
+(BasicBlockV1/V2, BottleneckV1/V2, resnet18..152). NCHW layout at the API;
+XLA retiles for the MXU. Train in bf16 with f32 BN statistics by casting the
+net (`net.cast('bfloat16')`) — BN computes in f32 internally (ops/nn_ops).
+"""
+from __future__ import annotations
+
+from ..gluon import nn, HybridBlock
+from ..ndarray import ndarray as F
+
+__all__ = ["BasicBlockV1", "BottleneckV1", "ResNetV1", "get_resnet",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1"]
+
+
+def _conv3x3(channels, stride, in_channels):
+    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
+                     use_bias=False, in_channels=in_channels,
+                     weight_initializer=None)
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(_conv3x3(channels, stride, in_channels))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv3x3(channels, 1, channels))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.ds = nn.HybridSequential()
+            self.ds.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                  use_bias=False, in_channels=in_channels))
+            self.ds.add(nn.BatchNorm())
+        else:
+            self.ds = None
+
+    def forward(self, x):
+        residual = x if self.ds is None else self.ds(x)
+        return F.Activation(self.body(x) + residual, act_type="relu")
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        self.body = nn.HybridSequential()
+        self.body.add(nn.Conv2D(mid, kernel_size=1, strides=stride, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv3x3(mid, 1, mid))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, kernel_size=1, use_bias=False))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.ds = nn.HybridSequential()
+            self.ds.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
+                                  use_bias=False, in_channels=in_channels))
+            self.ds.add(nn.BatchNorm())
+        else:
+            self.ds = None
+
+    def forward(self, x):
+        residual = x if self.ds is None else self.ds(x)
+        return F.Activation(self.body(x) + residual, act_type="relu")
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        if thumbnail:  # CIFAR-style stem
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            stage = nn.HybridSequential()
+            in_c = channels[i]
+            stage.add(block(channels[i + 1], stride,
+                            downsample=channels[i + 1] != in_c or stride != 1,
+                            in_channels=in_c))
+            for _ in range(num_layer - 1):
+                stage.add(block(channels[i + 1], 1, downsample=False,
+                                in_channels=channels[i + 1]))
+            self.features.add(stage)
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes, in_units=channels[-1])
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+_SPECS = {
+    18: (BasicBlockV1, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: (BasicBlockV1, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: (BottleneckV1, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: (BottleneckV1, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: (BottleneckV1, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+
+
+def get_resnet(num_layers, classes=1000, **kwargs):
+    block, layers, channels = _SPECS[num_layers]
+    return ResNetV1(block, layers, channels, classes=classes, **kwargs)
+
+
+def resnet18_v1(**kw):
+    return get_resnet(18, **kw)
+
+
+def resnet34_v1(**kw):
+    return get_resnet(34, **kw)
+
+
+def resnet50_v1(**kw):
+    return get_resnet(50, **kw)
+
+
+def resnet101_v1(**kw):
+    return get_resnet(101, **kw)
+
+
+def resnet152_v1(**kw):
+    return get_resnet(152, **kw)

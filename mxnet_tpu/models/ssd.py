@@ -1,0 +1,200 @@
+"""SSD detection (BASELINE.json workload #4: SSD300 / YOLOv3 family).
+
+Reference: GluonCV SSD (VGG/ResNet backbone + multi-scale heads + anchors +
+MultiBoxTarget/NMS ops from `src/operator/contrib/`). TPU-first choices:
+anchors are precomputed host-side constants; matching and hard-negative
+mining are vectorized jnp (static shapes); NMS is an O(N²) mask-matrix
+suppression inside jit (XLA-friendly) instead of the reference's sequential
+CUDA kernel.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+import numpy as np
+
+from ..gluon import nn, HybridBlock
+from ..ndarray import NDArray
+from ..ndarray import ndarray as F
+
+__all__ = ["SSD", "generate_anchors", "multibox_target", "non_max_suppression",
+           "MultiBoxLoss"]
+
+
+# --------------------------------------------------------------------------
+# anchors (reference: `src/operator/contrib/multibox_prior.cc`)
+# --------------------------------------------------------------------------
+
+def generate_anchors(feat_sizes, image_size=300,
+                     sizes=((0.1, 0.141), (0.2, 0.272), (0.37, 0.447),
+                            (0.54, 0.619), (0.71, 0.79), (0.88, 0.961)),
+                     ratios=((1, 2, 0.5),) * 6):
+    """Returns (N, 4) center-size anchors in [0,1] coords."""
+    anchors = []
+    for (fh, fw), size, ratio in zip(feat_sizes, sizes, ratios):
+        for i, j in itertools.product(range(fh), range(fw)):
+            cy, cx = (i + 0.5) / fh, (j + 0.5) / fw
+            s0, s1 = size[0], size[1]
+            anchors.append([cx, cy, s0, s0])
+            anchors.append([cx, cy, math.sqrt(s0 * s1), math.sqrt(s0 * s1)])
+            for r in ratio:
+                if r == 1:
+                    continue
+                sr = math.sqrt(r)
+                anchors.append([cx, cy, s0 * sr, s0 / sr])
+    return np.asarray(anchors, np.float32)
+
+
+def _corner(boxes):
+    import jax.numpy as jnp
+    cx, cy, w, h = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _iou(a, b):
+    """a (N,4), b (M,4) corner boxes → (N,M)."""
+    import jax.numpy as jnp
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-12)
+
+
+def multibox_target(anchors, gt_boxes, gt_labels, iou_thresh=0.5):
+    """Match anchors to ground truth (reference: MultiBoxTarget).
+
+    anchors (N,4) center-size; gt_boxes (B,M,4) corner, padded with -1;
+    gt_labels (B,M) padded with -1. Returns cls_targets (B,N) [0=bg],
+    box_targets (B,N,4), box_mask (B,N,1).
+    """
+    import jax.numpy as jnp
+    anchors_c = _corner(anchors)
+
+    def one(gtb, gtl):
+        valid = gtl >= 0
+        iou = _iou(anchors_c, gtb)                     # (N, M)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)              # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= iou_thresh
+        # force-match: each gt's best anchor
+        best_anchor = jnp.argmax(iou, axis=0)          # (M,)
+        forced = jnp.zeros(anchors.shape[0], bool).at[best_anchor].set(valid)
+        matched = matched | forced
+        gt_for_anchor = gtb[best_gt]                   # (N,4) corner
+        lbl = jnp.where(matched, gtl[best_gt] + 1, 0)  # 0 = background
+        # encode (reference MultiBoxTarget variances 0.1/0.2)
+        gw = gt_for_anchor[:, 2] - gt_for_anchor[:, 0]
+        gh = gt_for_anchor[:, 3] - gt_for_anchor[:, 1]
+        gx = (gt_for_anchor[:, 0] + gt_for_anchor[:, 2]) / 2
+        gy = (gt_for_anchor[:, 1] + gt_for_anchor[:, 3]) / 2
+        tx = (gx - anchors[:, 0]) / anchors[:, 2] / 0.1
+        ty = (gy - anchors[:, 1]) / anchors[:, 3] / 0.1
+        tw = jnp.log(jnp.maximum(gw, 1e-6) / anchors[:, 2]) / 0.2
+        th = jnp.log(jnp.maximum(gh, 1e-6) / anchors[:, 3]) / 0.2
+        box_t = jnp.stack([tx, ty, tw, th], -1) * matched[:, None]
+        return lbl, box_t, matched[:, None].astype(jnp.float32)
+
+    import jax
+    return jax.vmap(one)(gt_boxes, gt_labels)
+
+
+def non_max_suppression(boxes, scores, iou_thresh=0.45, topk=100):
+    """XLA-friendly NMS: O(N²) suppression matrix + top-k, static shapes.
+
+    boxes (N,4) corner, scores (N,). Returns (topk indices, topk scores);
+    suppressed entries get score -1.
+    """
+    import jax.numpy as jnp
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    iou = _iou(b, b)
+    keep_mask = jnp.ones(N, bool)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_thresh) & keep[i] & (jnp.arange(N) > i)
+        return keep & ~sup
+
+    import jax
+    keep_mask = jax.lax.fori_loop(0, min(N, topk), body, keep_mask)
+    s = jnp.where(keep_mask, s, -1.0)
+    k = min(topk, N)
+    top_s, top_i = jax.lax.top_k(s, k)
+    return order[top_i], top_s
+
+
+class SSD(HybridBlock):
+    """SSD with a ResNet-ish backbone and multi-scale heads."""
+
+    def __init__(self, num_classes=20, num_anchors_per_pos=4, channels=(64, 128, 256, 512),
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self._na = num_anchors_per_pos
+        self.stem = nn.HybridSequential()
+        self.stem.add(nn.Conv2D(channels[0], 3, 2, 1, activation="relu"),
+                      nn.BatchNorm())
+        self.stages = nn.HybridSequential()
+        self.cls_heads = nn.HybridSequential()
+        self.box_heads = nn.HybridSequential()
+        for c in channels:
+            stage = nn.HybridSequential()
+            stage.add(nn.Conv2D(c, 3, 2, 1, use_bias=False), nn.BatchNorm(),
+                      nn.Activation("relu"),
+                      nn.Conv2D(c, 3, 1, 1, use_bias=False), nn.BatchNorm(),
+                      nn.Activation("relu"))
+            self.stages.add(stage)
+            self.cls_heads.add(nn.Conv2D(self._na * (num_classes + 1), 3, 1, 1))
+            self.box_heads.add(nn.Conv2D(self._na * 4, 3, 1, 1))
+
+    def forward(self, x):
+        """Returns (cls_preds (B,N,C+1), box_preds (B,N,4), feat_sizes)."""
+        x = self.stem(x)
+        cls_out, box_out, feat_sizes = [], [], []
+        for stage, ch, bh in zip(self.stages, self.cls_heads, self.box_heads):
+            x = stage(x)
+            feat_sizes.append(x.shape[2:])
+            B = x.shape[0]
+            c = ch(x).transpose(axes=(0, 2, 3, 1)) \
+                .reshape(shape=(B, -1, self.num_classes + 1))
+            b = bh(x).transpose(axes=(0, 2, 3, 1)).reshape(shape=(B, -1, 4))
+            cls_out.append(c)
+            box_out.append(b)
+        return (F.concat(*cls_out, dim=1), F.concat(*box_out, dim=1), feat_sizes)
+
+
+class MultiBoxLoss:
+    """SSD loss: softmax CE (with hard negative mining 3:1) + smooth-L1."""
+
+    def __init__(self, neg_ratio=3.0):
+        self.neg_ratio = neg_ratio
+
+    def __call__(self, cls_preds, box_preds, cls_targets, box_targets, box_mask):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+
+        def compute(cp, bp, ct, bt, bm):
+            logp = jax.nn.log_softmax(cp.astype(jnp.float32), -1)
+            ct = ct.astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, ct[..., None], -1)[..., 0]  # (B,N)
+            pos = ct > 0
+            n_pos = jnp.maximum(jnp.sum(pos, 1), 1)
+            # hard negative mining: top (neg_ratio * n_pos) negatives by loss
+            neg_loss = jnp.where(pos, -jnp.inf, nll)
+            rank = jnp.argsort(jnp.argsort(-neg_loss, 1), 1)
+            neg = rank < (self.neg_ratio * n_pos)[:, None]
+            cls_loss = jnp.sum(nll * (pos | neg), 1) / n_pos
+            diff = jnp.abs(bp.astype(jnp.float32) - bt.astype(jnp.float32)) * bm
+            sl1 = jnp.where(diff < 1, 0.5 * diff * diff, diff - 0.5)
+            box_loss = jnp.sum(sl1, (1, 2)) / n_pos
+            return jnp.mean(cls_loss + box_loss)
+
+        return apply_op(compute, cls_preds, box_preds, cls_targets,
+                        box_targets, box_mask)

@@ -1,0 +1,179 @@
+"""Sockeye-style Transformer NMT (BASELINE.json workload #3).
+
+Reference: Amazon Sockeye (MXNet seq2seq; encoder/decoder transformer with
+label smoothing, beam search). TPU-first: flash attention everywhere
+(causal for the decoder), static-shape greedy/beam decode via lax loops —
+no BucketingModule needed since XLA pads to static shapes anyway.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn, HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray import NDArray
+from ..ndarray import ndarray as F
+
+
+def _positional_encoding(max_len, units):
+    pos = np.arange(max_len)[:, None]
+    dim = np.arange(units // 2)[None, :]
+    angle = pos / np.power(10000, 2 * dim / units)
+    enc = np.zeros((max_len, units), np.float32)
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        self.q_proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
+                               weight_initializer="xavier")
+        self.k_proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
+                               weight_initializer="xavier")
+        self.v_proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
+                               weight_initializer="xavier")
+        self.out_proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
+                                 weight_initializer="xavier")
+
+    def forward(self, q, kv, mask=None, causal=False):
+        B, Lq, E = q.shape
+        Lk = kv.shape[1]
+        H = self._heads
+        D = E // H
+        qh = self.q_proj(q).reshape(shape=(B, Lq, H, D)).transpose(axes=(0, 2, 1, 3))
+        kh = self.k_proj(kv).reshape(shape=(B, Lk, H, D)).transpose(axes=(0, 2, 1, 3))
+        vh = self.v_proj(kv).reshape(shape=(B, Lk, H, D)).transpose(axes=(0, 2, 1, 3))
+        out = F.flash_attention(qh, kh, vh, mask, causal=causal)
+        out = out.transpose(axes=(0, 2, 1, 3)).reshape(shape=(B, Lq, E))
+        return self.out_proj(out)
+
+
+class TransformerLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 is_decoder=False, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._is_decoder = is_decoder
+        self.self_attn = MultiHeadAttention(units, num_heads, dtype)
+        self.self_ln = nn.LayerNorm(in_channels=units)
+        if is_decoder:
+            self.cross_attn = MultiHeadAttention(units, num_heads, dtype)
+            self.cross_ln = nn.LayerNorm(in_channels=units)
+        self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
+                               dtype=dtype, weight_initializer="xavier")
+        self.ffn_out = nn.Dense(units, in_units=hidden_size, flatten=False,
+                                dtype=dtype, weight_initializer="xavier")
+        self.ffn_ln = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, enc_out=None, self_mask=None, enc_mask=None):
+        h = self.self_attn(x, x, mask=self_mask, causal=self._is_decoder)
+        if self.dropout:
+            h = self.dropout(h)
+        x = self.self_ln(x + h)
+        if self._is_decoder and enc_out is not None:
+            h = self.cross_attn(x, enc_out, mask=enc_mask)
+            if self.dropout:
+                h = self.dropout(h)
+            x = self.cross_ln(x + h)
+        h = self.ffn_out(F.Activation(self.ffn_in(x), act_type="relu"))
+        if self.dropout:
+            h = self.dropout(h)
+        return self.ffn_ln(x + h)
+
+
+class TransformerNMT(HybridBlock):
+    """Encoder-decoder for translation. forward() = teacher-forced training
+    scores; `greedy_decode`/`beam_search` for inference."""
+
+    def __init__(self, src_vocab, tgt_vocab, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, max_length=256, dropout=0.1,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self.src_embed = nn.Embedding(src_vocab, units, dtype=dtype,
+                                      weight_initializer="xavier")
+        self.tgt_embed = nn.Embedding(tgt_vocab, units, dtype=dtype,
+                                      weight_initializer="xavier")
+        from ..gluon.parameter import Constant
+        self.pos_enc = Constant("pos_enc", _positional_encoding(max_length, units))
+        self.encoder = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.encoder.add(TransformerLayer(units, hidden_size, num_heads,
+                                              dropout, False, dtype))
+        self.decoder = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.decoder.add(TransformerLayer(units, hidden_size, num_heads,
+                                              dropout, True, dtype))
+        self.out_proj = nn.Dense(tgt_vocab, in_units=units, flatten=False,
+                                 dtype=dtype, weight_initializer="xavier")
+
+    def _embed(self, embed, tokens):
+        import jax.numpy as jnp
+        x = embed(tokens) * (self._units ** 0.5)
+        L = tokens.shape[1]
+        return x + NDArray(self.pos_enc.data()._data[:L][None])
+
+    def encode(self, src_tokens, src_valid=None):
+        import jax.numpy as jnp
+        x = self._embed(self.src_embed, src_tokens)
+        mask = None
+        if src_valid is not None:
+            L = src_tokens.shape[1]
+            mask = NDArray(jnp.arange(L)[None, :] <
+                           src_valid._data[:, None].astype(jnp.int32))
+        for layer in self.encoder:
+            x = layer(x, self_mask=mask)
+        return x, mask
+
+    def forward(self, src_tokens, tgt_tokens, src_valid=None):
+        enc_out, enc_mask = self.encode(src_tokens, src_valid)
+        y = self._embed(self.tgt_embed, tgt_tokens)
+        for layer in self.decoder:
+            y = layer(y, enc_out=enc_out, enc_mask=enc_mask)
+        return self.out_proj(y)
+
+    # -- inference -------------------------------------------------------
+    def greedy_decode(self, src_tokens, bos=1, eos=2, max_len=None, src_valid=None):
+        """Static-shape greedy decode (re-encodes the growing target each
+        step; fine for evaluation; a KV-cache decoder is the perf TODO)."""
+        import jax.numpy as jnp
+        max_len = max_len or min(self._max_length, 2 * src_tokens.shape[1] + 8)
+        B = src_tokens.shape[0]
+        enc_out, enc_mask = self.encode(src_tokens, src_valid)
+        tgt = np.full((B, 1), bos, np.int32)
+        finished = np.zeros(B, bool)
+        for _ in range(max_len - 1):
+            y = self._embed(self.tgt_embed, NDArray(jnp.asarray(tgt)))
+            for layer in self.decoder:
+                y = layer(y, enc_out=enc_out, enc_mask=enc_mask)
+            logits = self.out_proj(F.slice_axis(y, axis=1, begin=-1, end=None))
+            nxt = np.asarray(logits._data.argmax(-1))[:, -1]
+            nxt = np.where(finished, eos, nxt)
+            finished |= nxt == eos
+            tgt = np.concatenate([tgt, nxt[:, None].astype(np.int32)], axis=1)
+            if finished.all():
+                break
+        return tgt
+
+
+def label_smoothing_loss(logits, labels, smoothing=0.1, pad_id=0):
+    """Sockeye-style smoothed CE over NDArrays; ignores pad positions."""
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import apply_op
+
+    def compute(lg, lbl):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+        lbl = lbl.astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, lbl[..., None], -1)[..., 0]
+        uniform = -jnp.mean(logp, axis=-1)
+        loss = (1 - smoothing) * nll + smoothing * uniform
+        keep = (lbl != pad_id).astype(jnp.float32)
+        return jnp.sum(loss * keep) / jnp.maximum(jnp.sum(keep), 1.0)
+
+    return apply_op(compute, logits, labels)

@@ -26,7 +26,7 @@ from ..context import Context, current_context
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "eye", "linspace", "concatenate", "save", "load", "waitall",
-           "from_jax", "imperative_invoke"]
+           "from_jax", "imperative_invoke", "apply_op"]
 
 
 def _unwrap(x):
@@ -327,20 +327,9 @@ def _getitem_op(data, key=None):
 # `Imperative::Invoke`, `src/imperative/imperative.cc`)
 # --------------------------------------------------------------------------
 
-def imperative_invoke(op_name, args, kwargs):
-    fn = _ops.OPS[op_name]
+def _invoke_pure(pure, args):
+    """Execute a pure fn on unwrapped args, wrap outputs, record on tape."""
     in_data = tuple(_unwrap(a) for a in args)
-    if op_name in _ops.RNG_OPS:
-        # Pin this invocation's randomness to one key so the autograd vjp
-        # replay reproduces the forward sample (same dropout mask etc.).
-        from .. import random as _random
-        key = _random.next_key()
-
-        def pure(*xs, _key=key):
-            with _random.key_scope(_key):
-                return fn(*xs, **kwargs)
-    else:
-        pure = (lambda *xs: fn(*xs, **kwargs))
     out = pure(*in_data)
     multi = isinstance(out, tuple)
     outs = tuple(NDArray(o) for o in (out if multi else (out,)))
@@ -361,6 +350,30 @@ def imperative_invoke(op_name, args, kwargs):
                     parents.append(None)
             _engine.record_op(pure, in_data, parents, outs)
     return outs if multi else outs[0]
+
+
+def imperative_invoke(op_name, args, kwargs):
+    fn = _ops.OPS[op_name]
+    if op_name in _ops.RNG_OPS:
+        # Pin this invocation's randomness to one key so the autograd vjp
+        # replay reproduces the forward sample (same dropout mask etc.).
+        from .. import random as _random
+        key = _random.next_key()
+
+        def pure(*xs, _key=key):
+            with _random.key_scope(_key):
+                return fn(*xs, **kwargs)
+    else:
+        pure = (lambda *xs: fn(*xs, **kwargs))
+    return _invoke_pure(pure, args)
+
+
+def apply_op(fn, *args, **kwargs):
+    """Run an arbitrary pure jax function over NDArrays with full autograd
+    support — the escape hatch for model code that drops below the op
+    registry (reference analog: CustomOp / mx.operator.CustomOpProp, without
+    the ceremony). `fn(*jax_arrays, **kwargs) -> array | tuple`."""
+    return _invoke_pure(lambda *xs: fn(*xs, **kwargs), args)
 
 
 # --------------------------------------------------------------------------

@@ -363,9 +363,26 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
     return out
 
 
-@register("multi_head_attention")
-def multi_head_attention(q, k, v, num_heads, mask=None, dropout_p=0.0, _training=None):
-    """Batched multi-head attention on (B, L, H, D) tensors — the fused path
-    models use. Dispatches to the Pallas flash kernel on TPU."""
+@register("flash_attention")
+def flash_attention_op(q, k, v, mask=None, causal=False, sm_scale=None):
+    """Fused attention on (B, H, L, D); Pallas kernel on TPU, XLA fallback on
+    CPU meshes. mask: (B, Lk) padding mask, True = attendable."""
     from ..pallas_ops import flash_attention
-    return flash_attention(q, k, v, mask=mask)
+    return flash_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+
+
+@register("fused_self_attention")
+def fused_self_attention(qkv, mask=None, num_heads=1, causal=False):
+    """Self-attention from a fused QKV projection (B, L, 3E) → (B, L, E).
+    The model-facing fused path (replaces the reference's interleaved-matmul
+    attention ops for new code)."""
+    from ..pallas_ops import flash_attention
+    B, L, E3 = qkv.shape
+    H = num_heads
+    D = E3 // 3 // H
+    x = qkv.reshape(B, L, 3, H, D)
+    q = x[:, :, 0].transpose(0, 2, 1, 3)
+    k = x[:, :, 1].transpose(0, 2, 1, 3)
+    v = x[:, :, 2].transpose(0, 2, 1, 3)
+    out = flash_attention(q, k, v, mask=mask, causal=causal)
+    return out.transpose(0, 2, 1, 3).reshape(B, L, H * D)

@@ -42,8 +42,9 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
 # pallas forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *,
                 sm_scale, causal, block_q, block_k, kv_len):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (block_q, D)
     num_kb = kv_len // block_k
@@ -66,7 +67,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # (block_q, block_k)
-        s = s + bias_ref[:, pl.ds(kb * block_k, block_k)]  # (1, block_k) bcast
+        s = s + bias_ref[pl.ds(bh, 1), pl.ds(kb * block_k, block_k)]  # (1,bk)
         if causal:
             row = qi * block_q + causal_off + \
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -83,7 +84,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:, :] = (m + jnp.log(l)).T
 
 
 try:  # pallas import is deferred so CPU-only environments still import us
@@ -102,7 +102,7 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
     vr = v.reshape(B * H, Lk, D)
     biasr = jnp.broadcast_to(bias[:, None, :], (B, H, Lk)).reshape(B * H, Lk)
     grid = (B * H, Lq // block_q)
-    out, lse = pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(
             _fwd_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, kv_len=Lk),
@@ -111,35 +111,29 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Lk), lambda b, i: (b, 0)),
+            # full-array spec: (1, Lk) blocks violate the (8,128) sublane rule
+            pl.BlockSpec((B * H, Lk), lambda b, i: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Lq), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )(qr, kr, vr, biasr)
-    return out.reshape(B, H, Lq, D), lse.reshape(B, H, Lq)
+    return out.reshape(B, H, Lq, D)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k):
-    out, _ = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
-    return out
+    return _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
 
 
 def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, bias, out, lse)
+    out = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, bias, out)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v, bias, out, lse = res
+    q, k, v, bias, out = res
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
@@ -148,7 +142,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
         row = jnp.arange(Lq)[:, None] + (Lk - Lq)
         col = jnp.arange(Lk)[None, :]
         s = jnp.where(col <= row, s, _NEG)
-    p = jnp.exp(s - lse[..., None])                       # (B,H,Lq,Lk) f32
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - lse)                                  # (B,H,Lq,Lk) f32
     g32 = g.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))

@@ -29,22 +29,35 @@ __all__ = ["ShardedTrainer"]
 
 class ShardedTrainer:
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, param_mode="replicate", donate=True,
-                 loss_has_aux_outputs=False):
+                 mesh=None, param_mode="replicate", donate=True):
         from .. import optimizer as opt_mod
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh or current_mesh()
         self.param_mode = param_mode
-        self._fn, self._grad_params, self._aux_params = functional_call(block, train=True)
-        self._names = [name for name, _ in self._grad_params]
-        opt = opt_mod.create(optimizer, **(optimizer_params or {})) \
+        self._opt = opt_mod.create(optimizer, **(optimizer_params or {})) \
             if isinstance(optimizer, str) else optimizer
-        self.fopt = FunctionalOptimizer(opt, self._names)
+        self._donate = donate
+        self.num_update = 0
+        self._step_cache = {}
+        self._ready = False
+        try:
+            self._setup()
+        except Exception:
+            # deferred parameter shapes: resolved by an eager probe pass on
+            # the first step's batch (reference: deferred init on forward)
+            pass
+
+    def _setup(self):
+        self._fn, self._grad_params, self._aux_params = functional_call(
+            self.block, train=True)
+        self._names = [name for name, _ in self._grad_params]
+        self.fopt = FunctionalOptimizer(self._opt, self._names)
 
         # shardings
         self._pshard = [
-            _specs.param_spec(p, self.mesh, param_mode) for _, p in self._grad_params]
+            _specs.param_spec(p, self.mesh, self.param_mode)
+            for _, p in self._grad_params]
         self._aux_shard = [_specs.replicated(self.mesh) for _ in self._aux_params]
         rep = _specs.replicated(self.mesh)
 
@@ -57,10 +70,8 @@ class ShardedTrainer:
         self.opt_state = [
             tuple(jax.device_put(z, s) for z in st)
             for st, s in zip(self.fopt.init(self.params), self._pshard)]
-        self.num_update = 0
-        self._step_cache = {}
-        self._donate = donate
         self._rep = rep
+        self._ready = True
 
     # ------------------------------------------------------------------
     def _build_step(self, n_data, n_label, batch_shapes):
@@ -109,6 +120,14 @@ class ShardedTrainer:
         (global batch; sharded onto the mesh's data axes here)."""
         data = data if isinstance(data, (list, tuple)) else [data]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        if not self._ready:
+            with jax.default_device(jax.devices()[0]):
+                prev = _engine.set_recording(False)
+                try:
+                    self.block(*data)  # eager probe resolves deferred shapes
+                finally:
+                    _engine.set_recording(prev)
+            self._setup()
         batch = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
                  for b in list(data) + list(labels)]
         shapes = tuple(b.shape for b in batch)
