@@ -2,3 +2,4 @@
 from .ndarray import *  # noqa: F401,F403
 from .ndarray import NDArray, _MODULE_OPS, imperative_invoke  # noqa: F401
 from . import random  # noqa: F401
+from . import contrib  # noqa: F401

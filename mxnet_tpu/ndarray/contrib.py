@@ -1,0 +1,212 @@
+"""nd.contrib: control-flow sugar over NDArrays.
+
+Reference: `python/mxnet/ndarray/contrib.py` (`foreach`, `while_loop`,
+`cond`). The reference's imperative path is a plain Python loop (each inner
+op records on the autograd tape) and only the symbolic path builds a subgraph
+op (`src/operator/control_flow.cc`). We keep the same split, TPU-style:
+
+  * eager (concrete NDArrays): Python loop — every inner op records on the
+    tape, so closures over Parameters differentiate correctly, exactly like
+    the reference imperative path.
+  * traced (inputs are jax tracers, i.e. inside `hybridize()`/`jit`/pjit):
+    lower to `lax.scan` / masked scan / `lax.cond`
+    (`mxnet_tpu.ops.control_flow`) so the whole loop compiles to one XLA
+    While — no Python unrolling in the compiled graph.
+
+Output shapes agree between the two paths (while_loop pads per-step outputs
+to `max_iterations` in both) so `hybridize()` is shape-transparent.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import control_flow as _cf
+from .ndarray import NDArray, _invoke_pure, _unwrap
+from . import ndarray as _nd
+
+__all__ = ["foreach", "while_loop", "cond", "isinf", "isnan", "isfinite"]
+
+
+def _flat(x):
+    """Flatten NDArray | list/tuple of NDArray -> (list, was_list)."""
+    if isinstance(x, (list, tuple)):
+        return list(x), True
+    return [x], False
+
+
+def _pack(nds, was_list):
+    return list(nds) if was_list else nds[0]
+
+
+def _is_traced(arrays):
+    return any(isinstance(_unwrap(a), jax.core.Tracer) for a in arrays)
+
+
+def foreach(body, data, init_states):
+    """`body(data_slice, states) -> (outs, new_states)` scanned over axis 0.
+
+    Reference: mx.nd.contrib.foreach -> `_foreach` subgraph op.
+    """
+    data_list, data_is_list = _flat(data)
+    state_list, state_is_list = _flat(init_states)
+
+    if _is_traced(data_list + state_list):
+        spec = {}
+
+        def body_raw(xs, st):
+            o, ns = body(_pack([NDArray(a) for a in xs], data_is_list),
+                         _pack([NDArray(a) for a in st], state_is_list))
+            o_flat, spec["out_is_list"] = _flat(o)
+            return [_unwrap(x) for x in o_flat], \
+                [_unwrap(x) for x in _flat(ns)[0]]
+
+        outs, fin = _cf.foreach(body_raw,
+                                [_unwrap(d) for d in data_list],
+                                [_unwrap(s) for s in state_list])
+        outs = [NDArray(o) for o in outs]
+        fin = [NDArray(f) for f in fin]
+        return (_pack(outs, spec["out_is_list"]),
+                _pack(fin, state_is_list))
+
+    # eager: python loop, inner ops record on the tape
+    length = data_list[0].shape[0]
+    states = init_states
+    cols = None
+    out_is_list = True
+    for t in range(length):
+        xs = _pack([d[t] for d in data_list], data_is_list)
+        o, states = body(xs, states)
+        o_flat, out_is_list = _flat(o)
+        if cols is None:
+            cols = [[] for _ in o_flat]
+        for c, x in zip(cols, o_flat):
+            c.append(x)
+    outs = [_nd.stack(*c, axis=0) for c in (cols or [])]
+    return _pack(outs, out_is_list), states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop; per-step outputs stacked and zero-padded to
+    `[max_iterations, ...]` (identical shape eager vs traced).
+
+    Reference: mx.nd.contrib.while_loop(cond, func, loop_vars,
+    max_iterations) -> `_while_loop` subgraph op. Also returns final
+    loop_vars.
+    """
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    lv_list, lv_is_list = _flat(loop_vars)
+
+    def call_args(nds):
+        # reference semantics: funcs are called with loop_vars unpacked
+        return tuple(nds)
+
+    if _is_traced(lv_list):
+        spec = {}
+
+        def cond_raw(lv):
+            return _unwrap(cond(*call_args([NDArray(a) for a in lv])))
+
+        def func_raw(lv):
+            o, nlv = func(*call_args([NDArray(a) for a in lv]))
+            o_flat, spec["out_is_list"] = _flat(o)
+            return [_unwrap(x) for x in o_flat], \
+                [_unwrap(x) for x in _flat(nlv)[0]]
+
+        outs, fin = _cf.while_loop(cond_raw, func_raw,
+                                   [_unwrap(v) for v in lv_list],
+                                   max_iterations)
+        outs = [NDArray(o) for o in outs]
+        fin = [NDArray(f) for f in fin]
+        return _pack(outs, spec["out_is_list"]), _pack(fin, lv_is_list)
+
+    # eager python loop
+    cur = lv_list
+    cols = None
+    out_is_list = True
+    steps = 0
+    for _ in range(int(max_iterations)):
+        keep = cond(*call_args(cur))
+        if not bool(_unwrap(keep) if isinstance(keep, NDArray) else keep):
+            break
+        o, nlv = func(*call_args(cur))
+        o_flat, out_is_list = _flat(o)
+        cur = _flat(nlv)[0]
+        if cols is None:
+            cols = [[] for _ in o_flat]
+        for c, x in zip(cols, o_flat):
+            c.append(x)
+        steps += 1
+    if cols is None:
+        # never ran: probe shapes abstractly to build all-zero outputs
+        probe_spec = {}
+
+        def _probe(lv):
+            o = func(*call_args([NDArray(a) for a in lv]))[0]
+            o_flat, probe_spec["out_is_list"] = _flat(o)
+            return [_unwrap(x) for x in o_flat]
+
+        probe = jax.eval_shape(_probe, tuple(_unwrap(v) for v in lv_list))
+        out_is_list = probe_spec["out_is_list"]
+        cols = [[] for _ in probe]
+        shapes = [(p.shape, p.dtype) for p in probe]
+    else:
+        shapes = [(tuple(_unwrap(c[0]).shape), _unwrap(c[0]).dtype)
+                  for c in cols]
+    outs = []
+    for c, (shp, dt) in zip(cols, shapes):
+        pad = int(max_iterations) - len(c)
+        rows = list(c) + [NDArray(jnp.zeros(shp, dt))] * pad
+        outs.append(_nd.stack(*rows, axis=0))
+    return _pack(outs, out_is_list), _pack(cur, lv_is_list)
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Conditional. `pred`: scalar NDArray (or zero-arg callable); branch
+    funcs take `inputs` (or are zero-arg closures, as in the reference).
+
+    Reference: mx.nd.contrib.cond -> `_cond` subgraph op; the imperative
+    path evaluates `pred` and runs one branch directly — ours too, unless
+    traced, where it lowers to `lax.cond`.
+    """
+    in_list = _flat(inputs)[0] if inputs is not None else []
+    pred_val = pred() if callable(pred) else pred
+
+    if _is_traced(in_list + [pred_val]):
+        spec = {}
+
+        def branch(fn, tag):
+            def raw(xs):
+                out = fn(*[NDArray(a) for a in xs]) if xs else fn()
+                o_flat, spec[tag] = _flat(out)
+                return [_unwrap(x) for x in o_flat]
+            return raw
+
+        outs = _cf.cond(_unwrap(pred_val), branch(then_func, "then"),
+                        branch(else_func, "else"),
+                        [_unwrap(x) for x in in_list])
+        if spec["then"] != spec["else"]:
+            raise TypeError(
+                "cond branches must return the same structure "
+                f"(then: {'list' if spec['then'] else 'NDArray'}, "
+                f"else: {'list' if spec['else'] else 'NDArray'})")
+        return _pack([NDArray(o) for o in outs], spec["then"])
+
+    take_then = bool(_unwrap(pred_val) if isinstance(pred_val, NDArray)
+                     else pred_val)
+    fn = then_func if take_then else else_func
+    return fn(*in_list) if in_list else fn()
+
+
+# small contrib numerics the reference keeps under mx.nd.contrib
+def isinf(x):
+    return _invoke_pure(lambda a: jnp.isinf(a), (x,))
+
+
+def isnan(x):
+    return _invoke_pure(lambda a: jnp.isnan(a), (x,))
+
+
+def isfinite(x):
+    return _invoke_pure(lambda a: jnp.isfinite(a), (x,))

@@ -1,0 +1,97 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+TPU-native redesign of the reference's control-flow subgraph ops
+(`src/operator/control_flow.cc`: `_foreach`, `_while_loop`, `_cond`, each a
+stateful op executing a captured NNVM subgraph per iteration). Here the
+"subgraph" is just a Python callable traced by XLA: `foreach` lowers to
+`lax.scan`, `while_loop` to a masked `lax.scan` (so per-step outputs have a
+static shape, padded to `max_iterations`), and `cond` to `lax.cond` — all
+compile-friendly, no data-dependent Python control flow (SURVEY.md §7.1).
+
+These are *pure level* functions on raw jax arrays; the NDArray front-end
+(`mxnet_tpu.ndarray.contrib`) wraps them with unwrap/record/wrap, and models
+(DeepAR's AR decode, NMT beam search) call them directly.
+
+Conventions:
+  * `data` / `states` / `outputs` are flat lists of arrays (the reference
+    supports nested lists; flatten at the front-end).
+  * callables receive and return flat lists.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def foreach(body, data, init_states):
+    """Scan `body` over axis 0 of each array in `data`.
+
+    body(xs: list, states: list) -> (outs: list, new_states: list)
+    Returns (stacked outs: list, final states: list).
+    Reference: `_foreach` in src/operator/control_flow.cc.
+    """
+    data = list(data)
+    init_states = list(init_states)
+
+    def scan_body(carry, xs):
+        outs, new_states = body(list(xs), list(carry))
+        return tuple(new_states), tuple(outs)
+
+    carry, ys = lax.scan(scan_body, tuple(init_states), tuple(data))
+    return list(ys), list(carry)
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations):
+    """Bounded while loop with per-step stacked outputs.
+
+    cond_fn(loop_vars: list) -> scalar bool array
+    func(loop_vars: list) -> (step_outputs: list, new_loop_vars: list)
+
+    Returns (outputs: list of [max_iterations, ...] arrays, final loop_vars).
+    Semantics follow the reference `_while_loop`: rows at and beyond the step
+    where `cond_fn` first fails are zero-padding. Lowering: a `lax.scan` of
+    length `max_iterations` whose body is a `lax.cond` on the (carried)
+    predicate — static shapes throughout, so XLA can pipeline it; the loop
+    does not early-exit on device, it masks (the standard TPU trade for
+    static shapes).
+    """
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static bound)")
+    loop_vars = list(loop_vars)
+
+    # Discover per-step output structure by abstract-evaluating one step.
+    out_shapes = jax.eval_shape(lambda lv: func(list(lv))[0], tuple(loop_vars))
+
+    def step(carry, _):
+        alive, lv = carry
+        pred = jnp.asarray(cond_fn(list(lv))).astype(bool).reshape(())
+        alive = jnp.logical_and(alive, pred)
+
+        def do_step(lv):
+            outs, new_lv = func(list(lv))
+            return tuple(outs), tuple(new_lv)
+
+        def skip(lv):
+            outs = tuple(jnp.zeros(s.shape, s.dtype) for s in out_shapes)
+            return outs, tuple(lv)
+
+        outs, new_lv = lax.cond(alive, do_step, skip, lv)
+        return (alive, new_lv), outs
+
+    (_, final_lv), ys = lax.scan(
+        step, (jnp.asarray(True), tuple(loop_vars)), None,
+        length=int(max_iterations))
+    return list(ys), list(final_lv)
+
+
+def cond(pred, then_func, else_func, inputs):
+    """lax.cond over flat input list; both branches must return the same
+    structure (reference `_cond` enforces the same via subgraph signatures)."""
+    inputs = tuple(inputs)
+    out = lax.cond(
+        jnp.asarray(pred).astype(bool).reshape(()),
+        lambda xs: tuple(then_func(list(xs))),
+        lambda xs: tuple(else_func(list(xs))),
+        inputs)
+    return list(out)

@@ -1,0 +1,182 @@
+"""Control-flow op tests (reference: tests/python/unittest/test_contrib_control_flow.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import autograd
+
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, state):
+        new = x + state
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    expect = np.cumsum(np.arange(12, dtype=np.float32).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+
+def test_foreach_multi_state_grad():
+    data = nd.array(np.random.RandomState(0).rand(5, 2).astype(np.float32))
+    w = nd.array(np.random.RandomState(1).rand(2).astype(np.float32))
+    w.attach_grad()
+
+    def body(x, states):
+        s, = states
+        new = s + x * w
+        return [new * 2], [new]
+
+    with autograd.record():
+        outs, states = nd.contrib.foreach(body, [data], [nd.zeros((2,))])
+        loss = outs[0].sum()
+    loss.backward()
+
+    # d(loss)/dw: loss = 2*sum_t cumsum(x*w) = 2*sum_t (T-t) terms
+    xs = data.asnumpy()
+    T = xs.shape[0]
+    coef = np.array([2 * (T - t) for t in range(T)], dtype=np.float32)
+    expect = (xs * coef[:, None]).sum(axis=0)
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_while_loop():
+    # sum integers until total >= 10; outputs padded to max_iterations
+    def cond(i, total):
+        return total < 10
+
+    def func(i, total):
+        return i, [i + 1, total + i]
+
+    outs, (i_fin, total_fin) = nd.contrib.while_loop(
+        cond, func, [nd.array([1.0]), nd.array([0.0])], max_iterations=8)
+    # steps: i=1,2,3,4 -> totals 1,3,6,10
+    np.testing.assert_allclose(total_fin.asnumpy(), [10.0])
+    np.testing.assert_allclose(i_fin.asnumpy(), [5.0])
+    got = outs.asnumpy().ravel()
+    np.testing.assert_allclose(got[:4], [1, 2, 3, 4])
+    np.testing.assert_allclose(got[4:], 0)  # masked padding rows
+
+
+def test_while_loop_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+
+    def cond(v):
+        return v < 100
+
+    def func(v):
+        return v, [v * v]
+
+    with autograd.record():
+        outs, fin = nd.contrib.while_loop(cond, func, [x], max_iterations=5)
+        loss = fin[0].sum()
+    loss.backward()
+    # v -> v^2 applied while v<100: 2 -> 4 -> 16 -> 256(stop). fin=256=x^8
+    np.testing.assert_allclose(fin[0].asnumpy(), [256.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [8 * 2.0 ** 7], rtol=1e-5)
+
+
+def test_cond():
+    a, b = nd.array([3.0]), nd.array([4.0])
+    out = nd.contrib.cond(a.sum() < b.sum(),
+                          lambda x, y: x + y,
+                          lambda x, y: x - y,
+                          inputs=[a, b])
+    np.testing.assert_allclose(out.asnumpy(), [7.0])
+    out = nd.contrib.cond(a.sum() > b.sum(),
+                          lambda x, y: x + y,
+                          lambda x, y: x - y,
+                          inputs=[a, b])
+    np.testing.assert_allclose(out.asnumpy(), [-1.0])
+
+
+def test_cond_grad():
+    a = nd.array([3.0])
+    a.attach_grad()
+    with autograd.record():
+        out = nd.contrib.cond(a.sum() > 0,
+                              lambda x: x * x,
+                              lambda x: -x,
+                              inputs=[a])
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [6.0])
+
+
+def test_foreach_in_hybrid_jit():
+    """foreach must trace inside a jitted HybridBlock forward."""
+    from mxnet_tpu import gluon
+
+    class Cum(gluon.HybridBlock):
+        def forward(self, x):
+            outs, _ = nd.contrib.foreach(
+                lambda xi, s: (xi + s, xi + s), x, nd.zeros_like(x[0]))
+            return outs
+
+    net = Cum()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.ones((3, 2), dtype=np.float32))
+    out = net(x)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.cumsum(np.ones((3, 2)), axis=0))
+
+
+def test_isnan_isinf():
+    x = nd.array([np.nan, np.inf, 1.0])
+    assert nd.contrib.isnan(x).asnumpy().tolist() == [True, False, False]
+    assert nd.contrib.isinf(x).asnumpy().tolist() == [False, True, False]
+    assert nd.contrib.isfinite(x).asnumpy().tolist() == [False, False, True]
+
+
+def test_while_loop_traced_vec1_pred():
+    """Regression: (1,)-shaped cond result must work under jit (traced path)."""
+    import jax
+    from mxnet_tpu.ops import control_flow as cf
+
+    def run(v0):
+        outs, fin = cf.while_loop(lambda lv: lv[0] < 10.0,
+                                  lambda lv: ([lv[0]], [lv[0] + 1.0]),
+                                  [v0], max_iterations=4)
+        return outs[0], fin[0]
+
+    outs, fin = jax.jit(run)(np.array([0.0], dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(fin), [4.0])
+    np.testing.assert_allclose(np.asarray(outs).ravel(), [0, 1, 2, 3])
+
+
+def test_while_loop_never_runs_structure():
+    """Regression: zero-iteration loop must preserve single-output structure."""
+    def cond(v):
+        return v > 100
+
+    def func(v):
+        return v, [v + 1]
+
+    outs, fin = nd.contrib.while_loop(cond, func, [nd.array([1.0])],
+                                      max_iterations=3)
+    assert isinstance(outs, nd.NDArray)  # not a 1-element list
+    np.testing.assert_allclose(outs.asnumpy(), np.zeros((3, 1)))
+    np.testing.assert_allclose(fin[0].asnumpy(), [1.0])
+
+
+def test_cond_traced_structure_mismatch():
+    """Regression: branches with list-vs-scalar structure must raise."""
+    from mxnet_tpu import gluon
+
+    class Bad(gluon.HybridBlock):
+        def forward(self, x):
+            return nd.contrib.cond(x.sum() > 0,
+                                   lambda a: [a + 1],
+                                   lambda a: a - 1,
+                                   inputs=[x])
+
+    net = Bad()
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(TypeError):
+        net(nd.array([1.0]))
