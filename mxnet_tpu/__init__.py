@@ -39,6 +39,9 @@ _LAZY = {
     "kvstore": ".kvstore",
     "mod": ".module",
     "module": ".module",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "model": ".module",
     "profiler": ".profiler",
     "parallel": ".parallel",
     "test_utils": ".test_utils",

@@ -30,9 +30,9 @@ class Initializer:
         """Name-aware dispatch like the reference: *_bias→zero, *_gamma→one,
         running stats→zero/one."""
         lname = name.lower()
-        if lname.endswith("bias") or lname.endswith("beta") or lname.endswith("running_mean"):
+        if lname.endswith(("bias", "beta", "running_mean", "moving_mean")):
             return Zero()._init(_random.next_key(), tuple(shape), jnp.dtype(dtype))
-        if lname.endswith("gamma") or lname.endswith("running_var"):
+        if lname.endswith(("gamma", "running_var", "moving_var")):
             return One()._init(_random.next_key(), tuple(shape), jnp.dtype(dtype))
         return self(shape, dtype)
 

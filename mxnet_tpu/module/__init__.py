@@ -1,0 +1,489 @@
+"""`mx.mod` — the classic symbolic training API.
+
+Reference: `python/mxnet/module/` — `BaseModule.fit()` (epoch loop with
+metric/callback/checkpoint), `Module` (bind → `DataParallelExecutorGroup`
+of per-GPU `GraphExecutor`s), `BucketingModule` (one executor per sequence
+bucket, shared params).
+
+TPU-native redesign: `Module` binds ONE jit-compiled Executor
+(`mxnet_tpu.symbol.executor`) — data parallelism over devices is the mesh
+layer's job (`mxnet_tpu.parallel`), not an executor-group copy loop, so
+`DataParallelExecutorGroup` has no analog here. `BucketingModule` keeps its
+role (per-shape compiled graphs, shared param store) because XLA compiles
+per shape — it is the recompile-avoidance cache for variable-length data.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+from collections import namedtuple
+
+import numpy as _np
+
+from .. import initializer as _init_mod
+from .. import metric as _metric
+from .. import optimizer as _opt
+from ..base import MXNetError
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "BatchEndParam",
+           "save_checkpoint", "load_checkpoint"]
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Reference: `mx.model.save_checkpoint` — symbol JSON + params file."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    _nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference: `mx.model.load_checkpoint`."""
+    from .. import symbol as _sym
+    symbol = _sym.load(f"{prefix}-symbol.json")
+    loaded = _nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        tag, name = k.split(":", 1)
+        (arg_params if tag == "arg" else aux_params)[name] = v
+    return symbol, arg_params, aux_params
+
+
+class BaseModule:
+    """Epoch-loop driver (reference: module/base_module.py `fit`)."""
+
+    def __init__(self, logger=None):
+        self.logger = logger or logging.getLogger(__name__)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # -- subclass surface ------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False):
+        raise NotImplementedError
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        raise NotImplementedError
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        raise NotImplementedError
+
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError
+
+    def backward(self):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+    def get_outputs(self):
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError
+
+    # -- shared driver ---------------------------------------------------
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, batch_end_callback=None,
+              reset=True, epoch=0):
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        if reset:
+            eval_data.reset()
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback:
+                param = BatchEndParam(epoch, nbatch, eval_metric, locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(param)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outputs.append([o.asnumpy() for o in self.get_outputs()])
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        return [_nd.array(_np.concatenate([row[i] for row in outputs]))
+                for i in range(n_out)]
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, initializer=None,
+            arg_params=None, aux_params=None, allow_missing=False,
+            force_rebind=False, force_init=False, begin_epoch=0,
+            num_epoch=None, validation_metric=None):
+        """The classic training loop (reference: `BaseModule.fit`)."""
+        if num_epoch is None:
+            raise MXNetError("fit: num_epoch is required")
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    param = BatchEndParam(epoch, nbatch, eval_metric,
+                                          locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(param)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data:
+                res = self.score(eval_data, validation_metric, epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _desc_name_shape(d):
+    """DataDesc | (name, shape) -> (name, shape)."""
+    if hasattr(d, "name"):
+        return d.name, tuple(d.shape)
+    name, shape = d[0], d[1]
+    return name, tuple(shape)
+
+
+class Module(BaseModule):
+    """Single-executor symbolic module (reference: module/module.py)."""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=None, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._opt_states = {}
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for d in data_shapes or []:
+            name, shape = _desc_name_shape(d)
+            shapes[name] = shape
+        for d in label_shapes or []:
+            name, shape = _desc_name_shape(d)
+            shapes[name] = shape
+        grad_req = {n: ("null" if (n in self._data_names
+                                   or n in self._label_names
+                                   or n in self._fixed_param_names
+                                   or not for_training)
+                        else "write")
+                    for n in self._symbol.list_arguments()}
+        self._exec = self._symbol.simple_bind(ctx=self._context,
+                                              grad_req=grad_req, **shapes)
+        self._for_training = for_training
+        self.binded = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        # kvstore accepted for API parity; gradient aggregation is the mesh
+        # layer's job under SPMD (SURVEY.md §2.5), so it is a no-op here.
+        if self.optimizer_initialized and not force_init:
+            return
+        params = dict(optimizer_params or {})
+        idx2name = dict(enumerate(self._param_names))
+        self._optimizer = _opt.create(optimizer, param_idx2name=idx2name,
+                                      **params)
+        self._opt_states = {}
+        self.optimizer_initialized = True
+        # Module.load(load_optimizer_states=True): restore states now that
+        # an optimizer exists (init_params runs before init_optimizer in
+        # fit(), so the restore must happen here)
+        pre = getattr(self, "_preloaded", None)
+        if pre is not None and pre[2]:
+            self.load_optimizer_states(pre[2])
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if not self.binded:
+            raise MXNetError("forward: call bind first")
+        if is_train is None:  # reference default: the bind-time flag
+            is_train = getattr(self, "_for_training", False)
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=bool(is_train), **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("update: call init_optimizer first")
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict[name]
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if i not in self._opt_states:
+                self._opt_states[i] = self._optimizer.create_state(i, weight)
+            self._optimizer.update(i, weight, grad, self._opt_states[i])
+
+    def get_outputs(self):
+        return self._exec.outputs
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------
+    def get_params(self):
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: a.copy() for n, a in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    def save_optimizer_states(self, fname):
+        states = {
+            i: _state_to_np(s) for i, s in self._opt_states.items()}
+        with open(fname, "wb") as f:
+            pickle.dump({"states": states,
+                         "num_update": self._optimizer.num_update}, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._opt_states = {i: _state_from_np(s)
+                            for i, s in blob["states"].items()}
+        self._optimizer.num_update = blob["num_update"]
+
+    @classmethod
+    def load(cls, prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = cls(symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params,
+                          f"{prefix}-{epoch:04d}.states"
+                          if load_optimizer_states else None)
+        return mod
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        pre = getattr(self, "_preloaded", None)
+        if pre is not None and arg_params is None:
+            arg_params, aux_params = pre[0], pre[1]
+        self._init_params_impl(initializer, arg_params, aux_params,
+                               allow_missing, force_init)
+
+    def _init_params_impl(self, initializer, arg_params, aux_params,
+                          allow_missing, force_init):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params: call bind first")
+        initializer = initializer or _init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._data = _np_to(arg_params[name], arr)
+            else:
+                if arg_params and not allow_missing:
+                    raise MXNetError(
+                        f"init_params: '{name}' missing from arg_params "
+                        f"(pass allow_missing=True to initialize it)")
+                arr._data = initializer.init_array(name, arr.shape, arr.dtype)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params and name in aux_params:
+                arr._data = _np_to(aux_params[name], arr)
+            else:
+                arr._data = initializer.init_array(name, arr.shape, arr.dtype)
+        self.params_initialized = True
+
+
+def _np_to(src, like):
+    import jax.numpy as jnp
+    data = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    if tuple(data.shape) != like.shape:
+        raise MXNetError(
+            f"param shape mismatch: got {tuple(data.shape)}, "
+            f"expected {like.shape}")
+    return data.astype(like._data.dtype)
+
+
+def _state_to_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_to_np(s) for s in state)
+    return state.asnumpy() if isinstance(state, NDArray) else state
+
+
+def _state_from_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_state_from_np(s) for s in state)
+    return _nd.array(state)
+
+
+class BucketingModule(BaseModule):
+    """Variable-length training without recompile storms: one compiled
+    Module per bucket key, single shared parameter store (reference:
+    module/bucketing_module.py; SURVEY.md §5.7 lists it as the closest
+    long-sequence artifact)."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr = None
+        self._opt_args = None
+        self._init_args = None
+
+    @property
+    def symbol(self):
+        return self._curr.symbol if self._curr else None
+
+    def _get_module(self, key, data_shapes, label_shapes, for_training=True):
+        if key not in self._buckets:
+            symbol, data_names, label_names = self._sym_gen(key)
+            mod = Module(symbol, data_names, label_names,
+                         logger=self.logger, context=self._context,
+                         **self._kwargs)
+            mod.bind(data_shapes, label_shapes, for_training=for_training)
+            if self._curr is not None:
+                # share params with the master module: alias the SAME
+                # NDArray objects so every bucket sees every update
+                master = self._buckets[self._default_key]
+                for n in mod._param_names:
+                    if n in master._exec.arg_dict:
+                        mod._exec.arg_dict[n] = master._exec.arg_dict[n]
+                        mod._exec.grad_dict[n] = master._exec.grad_dict[n]
+                for n in list(mod._exec.aux_dict):
+                    if n in master._exec.aux_dict:
+                        mod._exec.aux_dict[n] = master._exec.aux_dict[n]
+                mod.params_initialized = True
+                mod._optimizer = master._optimizer
+                mod._opt_states = master._opt_states
+                mod.optimizer_initialized = master.optimizer_initialized
+            elif self._init_args:
+                mod.init_params(**self._init_args)
+            self._buckets[key] = mod
+        return self._buckets[key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False):
+        self._for_training = for_training
+        mod = self._get_module(self._default_key, data_shapes, label_shapes,
+                               for_training)
+        self._curr = mod
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._init_args = kwargs
+        self._curr.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr.init_optimizer(**kwargs)
+        for mod in self._buckets.values():
+            mod._optimizer = self._curr._optimizer
+            mod._opt_states = self._curr._opt_states
+            mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        self._curr = self._get_module(bucket_key, data_shapes, label_shapes,
+                                      getattr(self, "_for_training", True))
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        if key != (self._curr and getattr(self._curr, "_bucket_key", None)):
+            shapes = [(n, a.shape) for n, a in
+                      zip(self._curr._data_names, data_batch.data)]
+            lshapes = [(n, a.shape) for n, a in
+                       zip(self._curr._label_names, data_batch.label or [])]
+            self.switch_bucket(key, shapes, lshapes or None)
+            self._curr._bucket_key = key
+        self._curr.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._curr.update()
+
+    def get_outputs(self):
+        return self._curr.get_outputs()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._buckets[self._default_key].get_params()

@@ -476,8 +476,10 @@ def save(fname, data):
         meta = "dict"
     else:
         raise TypeError(type(data))
-    _np.savez(fname if fname.endswith(".npz") else fname + ".npz",
-              __mx_meta__=meta, **payload)
+    # write via a file object so numpy keeps the EXACT filename (the
+    # reference writes `prefix-0042.params` with no extension appended)
+    with open(fname, "wb") as f:
+        _np.savez(f, __mx_meta__=meta, **payload)
 
 
 def load(fname):

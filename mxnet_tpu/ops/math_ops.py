@@ -272,3 +272,17 @@ def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
     if not is_ascend:
         out = jnp.flip(out, axis=axis)
     return out.astype(jnp.dtype(dtype))
+
+
+# --------------------------------------------------------------------------
+# creation ops with no inputs (reference: src/operator/tensor/init_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_zeros")
+def _zeros_op(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(shape), jnp.dtype(dtype))
+
+
+@register("_ones")
+def _ones_op(shape=(), dtype="float32"):
+    return jnp.ones(tuple(shape), jnp.dtype(dtype))

@@ -13,6 +13,8 @@ neighbourhood (SURVEY.md §7.1).
 """
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 import jax
@@ -197,14 +199,55 @@ def softmin(data, axis=-1):
     return jax.nn.softmax(-data, axis=axis)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         normalization):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        normalization):
+    out = jax.nn.softmax(data, axis=-1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, normalization,
+                        res, g):
+    # Loss-layer semantics of the reference (`src/operator/softmax_output.cc`):
+    # d(data) = softmax - onehot(label), scaled — the incoming head gradient
+    # is intentionally ignored (out_grad=False path).
+    out, label = res
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), out.shape[-1],
+                            dtype=out.dtype)
+    grad = out - onehot
+    if use_ignore:
+        mask = (label.astype(jnp.int32) != ignore_label).astype(out.dtype)
+        grad = grad * mask[..., None]
+        if normalization == "valid":
+            grad = grad / jnp.maximum(mask.sum(), 1.0)
+    elif normalization == "valid":
+        grad = grad / float(np.prod(label.shape))
+    if normalization == "batch":
+        grad = grad / out.shape[0]
+    # integer primals require float0 cotangents (as numpy arrays) under
+    # custom_vjp; float labels get ordinary zeros
+    label_cot = np.zeros(label.shape, jax.dtypes.float0) \
+        if jnp.issubdtype(label.dtype, jnp.integer) else jnp.zeros_like(label)
+    return grad * grad_scale, label_cot
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput")
 def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
                    multi_output=False, use_ignore=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0, preserve_shape=False):
-    # Forward is plain softmax; the fused backward of the reference
-    # (`src/operator/softmax_output.cc`) is unnecessary — jax.vjp of
-    # cross-entropy produces the same fused gradient under XLA.
-    return jax.nn.softmax(data, axis=-1)
+    if label is None:
+        return jax.nn.softmax(data, axis=-1)
+    return _softmax_output_core(data, label, float(grad_scale),
+                                int(ignore_label), bool(use_ignore),
+                                str(normalization))
 
 
 @register("softmax_cross_entropy")

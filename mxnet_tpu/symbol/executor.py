@@ -1,0 +1,253 @@
+"""Executor: bind a Symbol graph to arrays and run it under jit.
+
+TPU-native replacement for the reference `GraphExecutor`
+(`src/executor/graph_executor.cc`): instead of the NNVM pass pipeline
+(Gradient/InferShape/PlanMemory/AttachOpExecs) the whole graph is evaluated
+as one pure function and handed to `jax.jit` — XLA does memory planning and
+fusion; `jax.vjp` builds the backward. Forward and forward+backward are
+compiled lazily per (is_train,) and cached; re-binding with new shapes just
+re-traces (the reference re-binds executors via `Executor::Reshape`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import _engine
+from .. import ops as _ops
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+
+def _eval_graph(sym, values, training):
+    """Evaluate the DAG: values maps var name -> raw array. Returns
+    (head outputs list, aux updates dict name->array)."""
+    from . import _schema_for
+
+    memo = {}
+    aux_updates = {}
+    for node in sym._topo_nodes():
+        if node.is_var:
+            if node.name not in values:
+                raise MXNetError(f"unbound variable '{node.name}'")
+            memo[id(node)] = (values[node.name],)
+            continue
+        ins = [memo[id(src)][idx] for src, idx in node.inputs]
+        fn = _ops.get(node.op)
+        out = fn(*ins, **node.attrs)
+        outs = out if isinstance(out, tuple) else (out,)
+        sch = _schema_for(node.op)
+        if sch and sch.aux_map and training:
+            # functional aux-state writeback (reference: in-place moving
+            # stats mutation inside BatchNorm's FCompute); aux inputs are
+            # always the trailing len(sch.aux) inputs of the node
+            for out_idx, aux_pos in sch.aux_map:
+                src, _ = node.inputs[len(node.inputs) - len(sch.aux)
+                                     + aux_pos]
+                aux_updates[src.name] = outs[out_idx]
+        if sch:
+            outs = outs[:sch.visible] if sch.visible < len(outs) else outs
+        memo[id(node)] = outs
+    heads = [memo[id(node)][idx] for node, idx in sym._heads]
+    return heads, aux_updates
+
+
+class Executor:
+    """Reference surface: forward/backward/outputs/arg_dict/grad_dict/
+    aux_dict (`python/mxnet/executor.py`)."""
+
+    def __init__(self, sym, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+        self._symbol = sym
+        self._ctx = ctx
+        self.arg_dict = arg_dict      # name -> NDArray
+        self.grad_dict = grad_dict    # name -> NDArray | None
+        self.aux_dict = aux_dict      # name -> NDArray
+        self._grad_req = grad_req     # name -> 'write'|'add'|'null'
+        self.outputs = []
+        self._fwd_cache = {}
+        self._bwd_cache = {}
+        self._last_train = False
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def _simple_bind(cls, sym, ctx, grad_req, shapes):
+        shape_dict = sym._infer_shapes_dict(shapes)
+        arg_dict, grad_dict, aux_dict = {}, {}, {}
+        req = {}
+        for name in sym.list_arguments():
+            if name not in shape_dict:
+                raise MXNetError(
+                    f"simple_bind: cannot infer shape of '{name}'; "
+                    f"provide it explicitly")
+            arr = _nd.zeros(shape_dict[name])
+            arg_dict[name] = arr
+            r = grad_req if isinstance(grad_req, str) \
+                else grad_req.get(name, "write")
+            req[name] = r
+            grad_dict[name] = _nd.zeros(shape_dict[name]) \
+                if r != "null" else None
+        for name in sym.list_auxiliary_states():
+            aux_dict[name] = _nd.zeros(shape_dict[name])
+        return cls(sym, ctx, arg_dict, grad_dict, aux_dict, req)
+
+    @classmethod
+    def _bind(cls, sym, ctx, args, args_grad, grad_req, aux_states):
+        def to_dict(vals, names):
+            if vals is None:
+                return {}
+            if isinstance(vals, dict):
+                return {k: (v if isinstance(v, NDArray) else _nd.array(v))
+                        for k, v in vals.items()}
+            return {n: (v if isinstance(v, NDArray) else _nd.array(v))
+                    for n, v in zip(names, vals)}
+
+        arg_names = sym.list_arguments()
+        arg_dict = to_dict(args, arg_names)
+        grad_dict = to_dict(args_grad, arg_names)
+        aux_dict = to_dict(aux_states, sym.list_auxiliary_states())
+        req = {n: (grad_req if isinstance(grad_req, str)
+                   else grad_req.get(n, "write")) if n in grad_dict
+               else "null" for n in arg_names}
+        for n in arg_names:
+            if n not in grad_dict:
+                grad_dict[n] = None
+        return cls(sym, ctx, arg_dict, grad_dict, aux_dict, req)
+
+    # ------------------------------------------------------------------
+    def _names(self):
+        args = list(self.arg_dict.keys())
+        auxs = list(self.aux_dict.keys())
+        return args, auxs
+
+    def _compiled_fwd(self, training):
+        if training not in self._fwd_cache:
+            args, auxs = self._names()
+            sym = self._symbol
+
+            def fwd(arg_vals, aux_vals, rng):
+                values = dict(zip(args, arg_vals))
+                values.update(zip(auxs, aux_vals))
+                prev_r = _engine.set_recording(False)
+                prev_t = _engine.set_training(training)
+                try:
+                    with _random.key_scope(rng):
+                        heads, aux_up = _eval_graph(sym, values, training)
+                finally:
+                    _engine.set_recording(prev_r)
+                    _engine.set_training(prev_t)
+                new_aux = [aux_up.get(n, values[n]) for n in auxs]
+                return heads, new_aux
+
+            self._fwd_cache[training] = jax.jit(fwd)
+        return self._fwd_cache[training]
+
+    def _compiled_bwd(self):
+        if not self._bwd_cache:
+            args, auxs = self._names()
+            diff_args = [n for n in args if self._grad_req[n] != "null"]
+            sym = self._symbol
+
+            def fwd_for_grad(diff_vals, fixed_vals, aux_vals, rng):
+                values = dict(zip(diff_args, diff_vals))
+                values.update(
+                    zip([n for n in args if self._grad_req[n] == "null"],
+                        fixed_vals))
+                values.update(zip(auxs, aux_vals))
+                prev_r = _engine.set_recording(False)
+                prev_t = _engine.set_training(True)
+                try:
+                    with _random.key_scope(rng):
+                        heads, _ = _eval_graph(sym, values, True)
+                finally:
+                    _engine.set_recording(prev_r)
+                    _engine.set_training(prev_t)
+                return tuple(heads)
+
+            def bwd(diff_vals, fixed_vals, aux_vals, rng, out_grads):
+                _, vjp = jax.vjp(
+                    lambda dv: fwd_for_grad(dv, fixed_vals, aux_vals, rng),
+                    tuple(diff_vals))
+                (grads,) = vjp(tuple(out_grads))
+                return grads
+
+            self._bwd_cache["fn"] = jax.jit(bwd)
+            self._bwd_cache["diff"] = diff_args
+        return self._bwd_cache["fn"], self._bwd_cache["diff"]
+
+    # ------------------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument '{k}'")
+            arr = v if isinstance(v, NDArray) else _nd.array(v)
+            self.arg_dict[k]._data = jnp.asarray(
+                arr._data, self.arg_dict[k]._data.dtype)
+        args, auxs = self._names()
+        fwd = self._compiled_fwd(bool(is_train))
+        rng = _random.next_key()
+        heads, new_aux = fwd([self.arg_dict[n]._data for n in args],
+                             [self.aux_dict[n]._data for n in auxs], rng)
+        self._last_rng = rng
+        if is_train:
+            for n, a in zip(auxs, new_aux):
+                self.aux_dict[n]._data = a
+        self.outputs = [NDArray(h) for h in heads]
+        self._last_train = bool(is_train)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        bwd, diff_args = self._compiled_bwd()
+        args, auxs = self._names()
+        if out_grads is None:
+            out_grads = [jnp.ones(o.shape, o._data.dtype)
+                         for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            out_grads = [g._data if isinstance(g, NDArray)
+                         else jnp.asarray(g) for g in out_grads]
+        fixed = [n for n in args if self._grad_req[n] == "null"]
+        grads = bwd([self.arg_dict[n]._data for n in diff_args],
+                    [self.arg_dict[n]._data for n in fixed],
+                    [self.aux_dict[n]._data for n in auxs],
+                    getattr(self, "_last_rng", _random.next_key()),
+                    out_grads)
+        for n, g in zip(diff_args, grads):
+            if self._grad_req[n] == "add":
+                self.grad_dict[n]._data = self.grad_dict[n]._data + g
+            else:
+                self.grad_dict[n]._data = g
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n]
+                for n in self._symbol.list_auxiliary_states()]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v,
+                    self.arg_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown param '{k}'")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = jnp.asarray(
+                    v._data if isinstance(v, NDArray) else v,
+                    self.aux_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux '{k}'")

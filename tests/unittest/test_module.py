@@ -1,0 +1,223 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py,
+tests/python/train/)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import io as mio
+from mxnet_tpu import module as mmod
+
+
+def _mlp_sym(hidden=32, classes=4):
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    # normalization="batch": mean-gradient semantics so lr is batch-size
+    # independent (the reference default "null" sums over the batch)
+    return sym.SoftmaxOutput(h, name="softmax", normalization="batch")
+
+
+def _blob_data(n=256, classes=4, dim=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.normal(0, 3.0, (classes, dim))
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.normal(0, 0.5, (n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def test_module_fit_converges():
+    """End-to-end: classic fit() reaches high accuracy on separable blobs
+    (reference tier: tests/python/train MLP-on-MNIST threshold tests)."""
+    x, y = _blob_data()
+    it = mio.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    score = mod.score(it, "acc")
+    assert dict(score)["accuracy"] > 0.95, score
+
+
+def test_module_forward_predict_shapes():
+    x, y = _blob_data(64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds[0].shape == (64, 4)
+    probs = preds[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(64), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _blob_data(64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    assert os.path.exists(f"{prefix}-symbol.json")
+    assert os.path.exists(f"{prefix}-0002.params")
+    assert os.path.exists(f"{prefix}-0002.states")
+
+    mod2 = mmod.Module.load(prefix, 2, load_optimizer_states=True,
+                            context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    p1 = mod.predict(it)[0].asnumpy()
+    p2 = mod2.predict(it)[0].asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    # resume training from the checkpoint must keep optimizer state
+    mod2.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": 0.01})
+    assert mod2._optimizer is not None
+
+
+def test_module_fixed_params():
+    x, y = _blob_data(64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu(),
+                      fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod._exec.arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(before, after)
+    # trainable param must have moved
+    assert not np.allclose(before.sum(), mod._exec.arg_dict["fc2_weight"].asnumpy().sum())
+
+
+def test_bucketing_module():
+    """Two sequence-length buckets share parameters (reference:
+    module/bucketing_module.py)."""
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        h = sym.FullyConnected(data, num_hidden=8, name="fc1", flatten=True)
+        out = sym.SoftmaxOutput(h, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = mmod.BucketingModule(sym_gen, default_bucket_key=10,
+                              context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 10))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params(initializer=mx.init.Xavier())
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    rs = np.random.RandomState(0)
+
+    def make_batch(seq_len):
+        b = mio.DataBatch(
+            data=[nd.array(rs.rand(4, seq_len).astype(np.float32))],
+            label=[nd.array(rs.randint(0, 8, 4).astype(np.float32))])
+        b.bucket_key = seq_len
+        return b
+
+    # default bucket trains... but a different bucket would need its own
+    # fc1_weight shape; use same dim so params are shared legitimately
+    b10 = make_batch(10)
+    bm.forward_backward(b10)
+    bm.update()
+    w_master = bm._buckets[10]._exec.arg_dict["fc1_weight"]
+    b10b = make_batch(10)
+    bm.forward_backward(b10b)
+    bm.update()
+    assert len(bm._buckets) == 1
+    arg, aux = bm.get_params()
+    assert "fc1_weight" in arg
+
+
+def test_bucketing_module_shares_params_across_buckets():
+    # bucket key changes batch length along axis 0 only => same param shapes
+    def sym_gen(n_steps):
+        data = sym.Variable("data")
+        h = sym.reshape(data, shape=(-1, 5))
+        h = sym.FullyConnected(h, num_hidden=3, name="fc1")
+        out = sym.SoftmaxOutput(h, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = mmod.BucketingModule(sym_gen, default_bucket_key=2,
+                              context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 2, 5))],
+            label_shapes=[("softmax_label", (8,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+    rs = np.random.RandomState(1)
+
+    def make_batch(steps):
+        b = mio.DataBatch(
+            data=[nd.array(rs.rand(4, steps, 5).astype(np.float32))],
+            label=[nd.array(rs.randint(0, 3, 4 * steps).astype(np.float32))])
+        b.bucket_key = steps
+        return b
+
+    bm.forward_backward(make_batch(2))
+    bm.update()
+    bm.forward_backward(make_batch(3))   # new bucket compiled on demand
+    bm.update()
+    assert set(bm._buckets) == {2, 3}
+    # both buckets must reference the SAME weight object
+    assert bm._buckets[2]._exec.arg_dict["fc1_weight"] is \
+        bm._buckets[3]._exec.arg_dict["fc1_weight"]
+
+
+def test_forward_default_respects_bind_mode():
+    """Regression: bind(for_training=False) must run eval-mode forwards."""
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn")
+    mod = mmod.Module(net, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 4))], for_training=False)
+    mod.init_params()
+    before = mod._exec.aux_dict["bn_moving_mean"].asnumpy().copy()
+    x = np.random.RandomState(0).normal(5.0, 1.0, (8, 4)).astype(np.float32)
+    mod.forward(mio.DataBatch(data=[nd.array(x)], label=None))
+    np.testing.assert_array_equal(
+        mod._exec.aux_dict["bn_moving_mean"].asnumpy(), before)
+
+
+def test_init_params_missing_raises():
+    """Regression: allow_missing=False must reject incomplete arg_params."""
+    x, y = _blob_data(32)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    with pytest.raises(Exception):
+        mod.init_params(arg_params={"fc1_weight": nd.zeros((32, 10))},
+                        allow_missing=False)
+    mod.init_params(arg_params={"fc1_weight": nd.zeros((32, 10))},
+                    allow_missing=True, force_init=True)
+
+
+def test_load_restores_optimizer_states(tmp_path):
+    """Regression: Module.load(load_optimizer_states=True) -> states live."""
+    x, y = _blob_data(64)
+    it = mio.NDArrayIter(x, y, batch_size=16)
+    mod = mmod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    mod2 = mmod.Module.load(prefix, 2, load_optimizer_states=True,
+                            context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    mod2.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": 0.01})
+    assert mod2._opt_states, "optimizer states not restored"
+    # adam state of param 0: (mean, var) tuple with nonzero content
+    s0 = mod2._opt_states[0]
+    assert any(float(abs(t.asnumpy()).sum()) > 0
+               for t in (s0 if isinstance(s0, tuple) else (s0,)))

@@ -1,0 +1,174 @@
+"""Symbol API tests (reference: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    h = sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+def test_list_arguments_auto_vars():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    args, outs, auxs = net.infer_shape(data=(32, 100))
+    d = dict(zip(net.list_arguments(), args))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert outs == [(32, 10)]
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv1")
+    b = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    args, outs, auxs = p.infer_shape(data=(4, 3, 16, 16))
+    d = dict(zip(p.list_arguments(), args))
+    assert d["conv1_weight"] == (8, 3, 3, 3)
+    assert d["conv1_bias"] == (8,)
+    da = dict(zip(p.list_auxiliary_states(), auxs))
+    assert da["bn1_moving_mean"] == (8,)
+    assert p.list_auxiliary_states() == ["bn1_moving_mean", "bn1_moving_var"]
+    assert outs == [(4, 8, 8, 8)]
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2.0 * a + b / 4.0 - 3.0
+    ex = c.bind(args={"a": nd.array([1.0, 2.0]), "b": nd.array([4.0, 8.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [2 + 1 - 3, 4 + 2 - 3])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    args, outs, _ = net2.infer_shape(data=(8, 50))
+    assert outs == [(8, 10)]
+    d = dict(zip(net2.list_arguments(), args))
+    assert d["fc1_weight"] == (16, 50)
+
+
+def test_simple_bind_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(6, 20))
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = nd.array(rs.normal(0, 0.1, arr.shape).astype(np.float32))
+    x = rs.normal(size=(6, 20)).astype(np.float32)
+    y = rs.randint(0, 10, size=(6,)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    probs = outs[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(6), rtol=1e-5)
+    ex.backward()
+    # SoftmaxOutput loss-layer grad: softmax - onehot
+    onehot = np.eye(10, dtype=np.float32)[y.astype(int)]
+    # grad wrt fc2 bias equals column-sums of (p - onehot)
+    expect_bias_grad = (probs - onehot).sum(axis=0)
+    np.testing.assert_allclose(ex.grad_dict["fc2_bias"].asnumpy(),
+                               expect_bias_grad, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 4))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    x = np.random.RandomState(0).normal(2.0, 3.0, (8, 4)).astype(np.float32)
+    ex.forward(is_train=True, data=x)
+    # moving_mean updated toward batch mean with momentum 0.5
+    expect = 0.5 * 0.0 + 0.5 * x.mean(axis=0)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               expect, rtol=1e-4)
+    # eval mode must NOT update aux
+    before = ex.aux_dict["bn_moving_mean"].asnumpy()
+    ex.forward(is_train=False, data=x)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               before)
+
+
+def test_grad_req_null_and_add():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    ex = c.simple_bind(ctx=mx.cpu(), grad_req={"a": "add", "b": "null"},
+                       a=(3,), b=(3,))
+    ex.arg_dict["a"][:] = nd.array([1.0, 2.0, 3.0])
+    ex.arg_dict["b"][:] = nd.array([4.0, 5.0, 6.0])
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.backward()  # add accumulates
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [8.0, 10.0, 12.0])
+    assert ex.grad_dict["b"] is None
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    s1 = a * 2.0
+    s2 = a + 1.0
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(args={"a": nd.array([3.0])})
+    o = ex.forward()
+    np.testing.assert_allclose(o[0].asnumpy(), [6.0])
+    np.testing.assert_allclose(o[1].asnumpy(), [4.0])
+    second = g[1]
+    assert second.list_outputs() == g.list_outputs()[1:2]
+
+
+def test_sym_op_namespace_generic():
+    a = sym.Variable("a")
+    out = sym.reshape(a, shape=(2, 3))
+    args, outs, _ = out.infer_shape(a=(6,))
+    assert outs == [(2, 3)]
+    out2 = sym.concat(a, a, dim=0)
+    _, outs2, _ = out2.infer_shape(a=(6,))
+    assert outs2 == [(12,)]
+
+
+def test_unbound_variable_error():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    ex = (a + b).bind(args={"a": nd.array([1.0])})
+    with pytest.raises(MXNetError):
+        ex.forward()
+
+
+def test_variable_head_infer_shape():
+    """Regression: a bare variable symbol must report its own out shape."""
+    v = sym.Variable("x")
+    args, outs, _ = v.infer_shape(x=(2, 3))
+    assert outs == [(2, 3)]
+
+
+def test_internals_lookup_suffix():
+    """Regression: removesuffix semantics for internals lookup by name."""
+    a = sym.Variable("a")
+    o = sym.FullyConnected(a, num_hidden=4, name="convout")
+    ints = o.get_internals()
+    picked = ints["convout"]
+    assert picked.list_outputs()[0].startswith("convout")
