@@ -121,7 +121,11 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(batch, is_train=False)
-            outputs.append([o.asnumpy() for o in self.get_outputs()])
+            pad = getattr(batch, "pad", 0) or 0
+            row = [o.asnumpy() for o in self.get_outputs()]
+            if pad:
+                row = [o[:o.shape[0] - pad] for o in row]
+            outputs.append(row)
         if not outputs:
             return []
         n_out = len(outputs[0])
@@ -311,7 +315,9 @@ class Module(BaseModule):
             i: _state_to_np(s) for i, s in self._opt_states.items()}
         with open(fname, "wb") as f:
             pickle.dump({"states": states,
-                         "num_update": self._optimizer.num_update}, f)
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count":
+                             dict(self._optimizer._index_update_count)}, f)
 
     def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
@@ -319,6 +325,12 @@ class Module(BaseModule):
         self._opt_states = {i: _state_from_np(s)
                             for i, s in blob["states"].items()}
         self._optimizer.num_update = blob["num_update"]
+        # restore per-index step counts so Adam-style bias correction
+        # continues from t instead of resetting to t=1 on resume
+        counts = blob.get("index_update_count")
+        if counts is None:  # older checkpoints: seed every index at num_update
+            counts = {i: blob["num_update"] for i in blob["states"]}
+        self._optimizer._index_update_count.update(counts)
 
     @classmethod
     def load(cls, prefix, epoch, load_optimizer_states=False, **kwargs):

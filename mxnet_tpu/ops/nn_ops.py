@@ -243,6 +243,10 @@ _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
                    multi_output=False, use_ignore=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0, preserve_shape=False):
+    if out_grad or multi_output or smooth_alpha:
+        raise NotImplementedError(
+            "SoftmaxOutput: out_grad/multi_output/smooth_alpha are not "
+            "supported; silently ignoring them would corrupt gradients")
     if label is None:
         return jax.nn.softmax(data, axis=-1)
     return _softmax_output_core(data, label, float(grad_scale),
