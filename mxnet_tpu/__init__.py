@@ -47,6 +47,9 @@ _LAZY = {
     "test_utils": ".test_utils",
     "lr_scheduler": ".lr_scheduler",
     "image": ".image",
+    "contrib": ".contrib",
+    "recordio": ".io.recordio",
+    "rtc": ".rtc",
 }
 
 

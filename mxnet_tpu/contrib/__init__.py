@@ -1,0 +1,9 @@
+"""Contrib subpackage (reference: `python/mxnet/contrib/`).
+
+Provided: `amp` (automatic mixed precision — bf16-first on TPU),
+`quantization` (int8 post-training quantization). ONNX import/export is
+intentionally not provided in this build; `mxnet_tpu.symbol` JSON plus
+`.params` files are the interchange formats.
+"""
+from . import amp  # noqa: F401
+from . import quantization  # noqa: F401

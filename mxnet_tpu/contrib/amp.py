@@ -1,0 +1,199 @@
+"""Automatic mixed precision (reference: `python/mxnet/contrib/amp/amp.py`,
+op lists in `contrib/amp/lists/symbol.py`).
+
+TPU-native AMP is **bfloat16-first**: bf16 shares float32's exponent range,
+so the MXU runs at full rate without the float16 loss-scaling dance. The
+reference's three op lists survive as the cast policy:
+
+  * TARGET_OPS  — matmul/conv class ops, cast inputs to the target dtype
+                  (these are the MXU FLOPs);
+  * FP32_OPS    — reductions/normalizations/softmax, forced to float32;
+  * everything else — runs in whatever dtype arrives (XLA type-propagates).
+
+`init()` wraps the op registry once; dynamic loss scaling (`scale_loss`,
+`LossScaler`) is provided for float16 parity and defaults to a constant
+scale of 1 for bfloat16.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops as _ops
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "LossScaler",
+           "convert_hybrid_block", "list_target_ops", "list_fp32_ops"]
+
+# The MXU-bound ops (reference: FP16_FUNCS — ops whitelisted to run in
+# reduced precision because they are tensor-core/MXU friendly).
+TARGET_OPS = [
+    "dot", "batch_dot", "FullyConnected", "Convolution", "Deconvolution",
+    "linalg_gemm", "linalg_gemm2",
+    "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "flash_attention", "fused_self_attention",
+]
+
+# Numerically sensitive ops pinned to f32 (reference: FP32_FUNCS).
+FP32_OPS = [
+    "softmax", "log_softmax", "softmin", "SoftmaxOutput",
+    "softmax_cross_entropy", "BatchNorm", "LayerNorm", "GroupNorm",
+    "InstanceNorm", "L2Normalization", "norm", "mean", "sum", "prod",
+    "nansum", "nanprod",
+]
+
+_initialized = False
+_target_dtype = None
+
+
+def list_target_ops():
+    return list(TARGET_OPS)
+
+
+def list_fp32_ops():
+    return list(FP32_OPS)
+
+
+def _cast_args(args, dtype):
+    out = []
+    for a in args:
+        if hasattr(a, "dtype") and jnp.issubdtype(jnp.asarray(a).dtype,
+                                                  jnp.floating):
+            out.append(jnp.asarray(a).astype(dtype))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def _wrap(fn, dtype, restore_dtype=None):
+    def wrapped(*args, **kwargs):
+        cast = _cast_args(args, dtype)
+        out = fn(*cast, **kwargs)
+        if restore_dtype is not None:
+            if isinstance(out, tuple):
+                out = tuple(o.astype(restore_dtype)
+                            if jnp.issubdtype(o.dtype, jnp.floating) else o
+                            for o in out)
+            elif jnp.issubdtype(out.dtype, jnp.floating):
+                out = out.astype(restore_dtype)
+        return out
+    wrapped.op_name = getattr(fn, "op_name", None)
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         fp32_ops=None, conditional_fp32_ops=None):
+    """Install the mixed-precision cast policy over the op registry
+    (reference: amp.init patches the generated op namespaces)."""
+    global _initialized, _target_dtype
+    if _initialized:
+        return
+    target_dtype = jnp.dtype(target_dtype)
+    if target_dtype not in (jnp.dtype(jnp.bfloat16), jnp.dtype(np.float16)):
+        raise ValueError("target_dtype must be bfloat16 (TPU-native) or "
+                         "float16")
+    _target_dtype = target_dtype
+    for name in (target_precision_ops or TARGET_OPS):
+        if name in _ops.OPS:
+            _ops.OPS[name] = _wrap(_ops.OPS[name], target_dtype)
+    for name in (fp32_ops or FP32_OPS):
+        if name in _ops.OPS:
+            _ops.OPS[name] = _wrap(_ops.OPS[name], jnp.float32)
+    _initialized = True
+
+
+def _deinit_for_tests():
+    """Undo init() — test helper only."""
+    global _initialized, _target_dtype
+    for name, fn in list(_ops.OPS.items()):
+        orig = getattr(fn, "_amp_original", None)
+        if orig is not None:
+            _ops.OPS[name] = orig
+    _initialized = False
+    _target_dtype = None
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: amp/loss_scaler.py): double the
+    scale every `scale_window` clean steps, halve on overflow. With bf16
+    this stays at 1.0 unless the user opts in."""
+
+    def __init__(self, init_scale=None, scale_factor=2.0, scale_window=2000):
+        if init_scale is None:
+            init_scale = 1.0 if _target_dtype == jnp.dtype(jnp.bfloat16) \
+                else 2.0 ** 16
+        self.loss_scale = float(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+        self._pending_unscaled = False
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite. One fused check with a
+        single host sync (reference: multi_all_finite kernel)."""
+        flags = []
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else p
+            data = getattr(g, "_data", g)
+            if data is None:
+                data = getattr(g, "_values", None)  # sparse grads
+            if data is None:
+                continue
+            flags.append(jnp.isfinite(data).all())
+        if not flags:
+            return False
+        return not bool(jnp.stack(flags).all())  # single device->host sync
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler to a gluon Trainer; its `step()` then unscales
+    gradients and skips non-finite steps (reference: amp.init_trainer)."""
+    trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """`with amp.scale_loss(loss, trainer) as l: autograd.backward(l)`."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide gradients by the loss scale now (e.g. before clipping);
+    the following `trainer.step()` will then NOT unscale again."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p.grad()
+        if g is not None and g._data is not None:
+            g._data = g._data * inv
+    scaler._pending_unscaled = True
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16"):
+    """Cast a block's parameters to the target dtype for low-precision
+    inference (reference: amp.convert_hybrid_block)."""
+    block.cast(target_dtype)
+    return block

@@ -49,8 +49,25 @@ class Trainer:
         self._states_created = True
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Scale gradients by 1/batch_size and apply updates."""
-        self._optimizer.rescale_grad = 1.0 / batch_size
+        """Scale gradients by 1/batch_size and apply updates. When AMP is
+        attached (contrib.amp.init_trainer), also unscale by the dynamic
+        loss scale and skip non-finite steps."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.loss_scale != 1.0:
+            # bf16's default scale of 1.0 skips the whole dance — no
+            # overflow sync on the hot path (the point of bf16-first AMP)
+            if getattr(scaler, "_pending_unscaled", False):
+                self._optimizer.rescale_grad = 1.0 / batch_size
+                scaler._pending_unscaled = False
+            else:
+                self._optimizer.rescale_grad = \
+                    1.0 / (batch_size * scaler.loss_scale)
+            overflow = scaler.has_overflow(self._params)
+            scaler.update_scale(overflow)
+            if overflow:
+                return  # skip the update, as the reference AMP trainer does
+        else:
+            self._optimizer.rescale_grad = 1.0 / batch_size
         self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):

@@ -1,0 +1,522 @@
+"""Image API: decode / resize / augment / iterate (reference:
+`python/mxnet/image/image.py`, `python/mxnet/image/detection.py`,
+`src/io/image_aug_default.cc`).
+
+The reference decodes and augments on CPU threads with OpenCV; here the
+host-side pipeline uses PIL + numpy (the C++ fast path lives in `native/`,
+used by `mxnet_tpu.io.ImageRecordIter` when built). Augmenter composition,
+`CreateAugmenter`, and `ImageIter` keep the reference surface so training
+scripts port unchanged. Output batches are NCHW float32, ready for
+device transfer (device-side normalize/augment would burn HBM bandwidth
+for no MXU win — host augment + async prefetch is the TPU-friendly split).
+"""
+from __future__ import annotations
+
+import io as _io
+import logging
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from ..ndarray import NDArray, array as nd_array
+from ..io import DataBatch, DataIter
+from ..io.recordio import IndexedRecordIO, unpack
+
+__all__ = [
+    "imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+    "ForceResizeAug", "RandomCropAug", "CenterCropAug", "RandomSizedCropAug",
+    "HorizontalFlipAug", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "ColorJitterAug", "HueJitterAug", "LightingAug",
+    "ColorNormalizeAug", "RandomGrayAug", "CastAug", "CreateAugmenter",
+    "ImageIter",
+]
+
+
+def _to_np(src):
+    if isinstance(src, NDArray):
+        return src.asnumpy()
+    return np.asarray(src)
+
+
+def _require_pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("mx.image decode/resize requires Pillow") from e
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode JPEG/PNG bytes to an HWC uint8 NDArray (reference:
+    mx.image.imdecode → cv::imdecode)."""
+    Image = _require_pil()
+    img = Image.open(_io.BytesIO(buf))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR like OpenCV default
+    if not flag:
+        arr = arr[:, :, None]
+    return nd_array(arr, dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+# cv2 code -> PIL resample: NEAREST->NEAREST, LINEAR->BILINEAR,
+# CUBIC->BICUBIC, AREA->BOX, LANCZOS4->LANCZOS
+_PIL_INTERP = {0: 0, 1: 2, 2: 3, 3: 4, 4: 1}
+
+
+def imresize(src, w, h, interp=2):
+    Image = _require_pil()
+    arr = _to_np(src)
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr.squeeze(-1) if squeeze else arr)
+    img = img.resize((int(w), int(h)), resample=_PIL_INTERP.get(interp, 3))
+    out = np.asarray(img)
+    if squeeze:
+        out = out[:, :, None]
+    return nd_array(out, dtype=arr.dtype.name)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals `size` (reference: resize_short)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _to_np(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd_array(out, dtype=arr.dtype.name)
+
+
+def center_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    new_w, new_h = min(new_w, w), min(new_h, h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Inception-style random-area crop (reference: random_size_crop)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _to_np(src).astype(np.float32)
+    arr = arr - np.asarray(_to_np(mean), np.float32)
+    if std is not None:
+        arr = arr / np.asarray(_to_np(std), np.float32)
+    return nd_array(arr)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference: Augmenter classes in python/mxnet/image/image.py)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd_array(_to_np(src)[:, ::-1].copy())
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(_to_np(src).astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _COEF = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray_mean = (arr * self._COEF).sum(axis=-1).mean() * (1.0 - alpha)
+        return nd_array(arr * alpha + gray_mean)
+
+
+class SaturationJitterAug(Augmenter):
+    _COEF = ContrastJitterAug._COEF
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (arr * self._COEF).sum(axis=-1, keepdims=True) * (1.0 - alpha)
+        return nd_array(arr * alpha + gray)
+
+
+class HueJitterAug(Augmenter):
+    """Approximate hue rotation in RGB via the YIQ rotation matrix
+    (reference: HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        arr = _to_np(src).astype(np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        return nd_array(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA noise (reference: LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd_array(_to_np(src).astype(np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _COEF = ContrastJitterAug._COEF
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src).astype(np.float32)
+            gray = (arr * self._COEF).sum(axis=-1, keepdims=True)
+            return nd_array(np.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd_array(_to_np(src).astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter chain factory (reference: CreateAugmenter —
+    same knobs as ImageRecordIter's C++ defaults)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.779, 103.939])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference: mx.image.ImageIter — python-side ImageRecordIter)
+# ---------------------------------------------------------------------------
+
+class ImageIter(DataIter):
+    """Iterate images from a .rec file or an image list + root directory,
+    decoding and augmenting on host, yielding NCHW float32 batches."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, imglist=None, label_width=1,
+                 data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise ValueError("data_shape must be (3, H, W)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._provide_data = [(data_name, (batch_size,) + self.data_shape)]
+        self._provide_label = [(label_name, (batch_size, label_width)
+                                if label_width > 1 else (batch_size,))]
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.shuffle = shuffle
+        self.record = None
+        self.imglist = {}
+        self.path_root = path_root
+
+        if path_imgrec is not None:
+            idx_path = kwargs.get("path_imgidx") or \
+                os.path.splitext(path_imgrec)[0] + ".idx"
+            self.record = IndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.record.keys)
+        elif path_imglist is not None:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+        elif imglist is not None:
+            for i, item in enumerate(imglist):
+                self.imglist[i] = (np.asarray(item[:-1], np.float32), item[-1])
+            self.seq = list(self.imglist.keys())
+        else:
+            raise ValueError("need path_imgrec, path_imglist, or imglist")
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.record is not None:
+            s = self.record.read_idx(idx)
+            header, img_bytes = unpack(s)
+            return header.label, img_bytes
+        label, fname = self.imglist[idx]
+        path = os.path.join(self.path_root or ".", fname)
+        with open(path, "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img_bytes = self.next_sample()
+                try:
+                    img = imdecode(img_bytes)
+                except Exception as e:
+                    logging.debug("skipping undecodable image: %s", e)
+                    continue
+                for aug in self.aug_list:
+                    img = aug(img)
+                arr = _to_np(img)
+                if arr.shape[:2] != (h, w):
+                    arr = _to_np(imresize(arr, w, h))
+                batch_data[i] = arr.astype(np.float32).transpose(2, 0, 1)
+                batch_label[i] = np.ravel(label)[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            for j in range(i, self.batch_size):  # pad with wrap-around
+                batch_data[j] = batch_data[j % max(i, 1)]
+                batch_label[j] = batch_label[j % max(i, 1)]
+        label_out = batch_label if self.label_width > 1 else batch_label[:, 0]
+        return DataBatch(data=[nd_array(batch_data)],
+                         label=[nd_array(label_out)],
+                         pad=self.batch_size - i)

@@ -42,22 +42,31 @@ class KVStore:
             self._store[k] = NDArray(self._first(v)._data)
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import BaseSparseNDArray, add as sparse_add
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vs = v if isinstance(v, (list, tuple)) else [v]
-            agg = vs[0]._data
-            for extra in vs[1:]:
-                agg = agg + extra._data
+            if any(isinstance(x, BaseSparseNDArray) for x in vs):
+                # sparse aggregate stays sparse so the optimizer can take
+                # its lazy row-update path (reference: sparse push keeps
+                # kRowSparseStorage through the server merge)
+                agg = vs[0]
+                for extra in vs[1:]:
+                    agg = sparse_add(agg, extra)
+            else:
+                agg = NDArray(sum((x._data for x in vs[1:]), vs[0]._data))
             if k not in self._store:
                 raise KeyError(f"key {k} not initialized")
             if self._updater is not None:
-                self._updater(k, NDArray(agg), self._store[k])
+                self._updater(k, agg, self._store[k])
             elif self._optimizer is not None:
                 state = self._opt_states.setdefault(
                     k, self._optimizer.create_state(k, self._store[k]))
-                self._optimizer.update(k, self._store[k], NDArray(agg), state)
+                self._optimizer.update(k, self._store[k], agg, state)
             else:
-                self._pending[k] = self._pending.get(k, 0) + agg
+                dense = agg.todense()._data \
+                    if isinstance(agg, BaseSparseNDArray) else agg._data
+                self._pending[k] = self._pending.get(k, 0) + dense
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
@@ -70,14 +79,46 @@ class KVStore:
             if o is None:
                 results.append(NDArray(val))
             else:
+                from ..ndarray.sparse import BaseSparseNDArray, cast_storage
                 os_ = o if isinstance(o, (list, tuple)) else [o]
                 for dst in os_:
-                    dst._data = val
+                    if isinstance(dst, BaseSparseNDArray):
+                        cast_storage(NDArray(val), dst.stype).copyto(dst)
+                    else:
+                        dst._data = val
                 results.append(o)
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        return self.pull(key, out, priority)
+        """Pull only the requested rows as row_sparse (reference:
+        KVStoreDist row_sparse pull of sharded embeddings)."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        import numpy as _np
+        import jax.numpy as jnp
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            rids_list = row_ids if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(key)
+            results = [self.row_sparse_pull(k, o, priority, r)
+                       for k, o, r in zip(key, outs, rids_list)]
+            return out if out is not None else results
+
+        full = self.pull(key)
+        rids = row_ids[0] if isinstance(row_ids, (list, tuple)) else row_ids
+        rows = _np.unique(_np.asarray(rids._data
+                                      if isinstance(rids, NDArray) else rids)
+                          .astype(_np.int32).ravel())
+        vals = full._data[jnp.asarray(rows)]
+        rsp = RowSparseNDArray(vals, jnp.asarray(rows), full.shape)
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                rsp.copyto(o)
+            return out
+        return rsp
 
     # -- optimizer plane -------------------------------------------------
     def set_optimizer(self, optimizer):

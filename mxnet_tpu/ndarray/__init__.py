@@ -3,3 +3,5 @@ from .ndarray import *  # noqa: F401,F403
 from .ndarray import NDArray, _MODULE_OPS, imperative_invoke  # noqa: F401
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import RowSparseNDArray, CSRNDArray  # noqa: F401

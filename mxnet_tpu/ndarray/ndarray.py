@@ -177,6 +177,18 @@ class NDArray:
         return self._data
 
     # ------------------------------------------------------------------
+    # storage type (reference: kDefaultStorage / FInferStorageType)
+    # ------------------------------------------------------------------
+    @property
+    def stype(self):
+        return "default"
+
+    def tostype(self, stype):
+        """Convert storage type (reference: NDArray.tostype / cast_storage)."""
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
+
+    # ------------------------------------------------------------------
     # mutation (the reference's defining NDArray feature)
     # ------------------------------------------------------------------
     def _check_mutable(self):

@@ -1,0 +1,419 @@
+"""Sparse NDArrays: row_sparse + csr storage (reference:
+`python/mxnet/ndarray/sparse.py`, `include/mxnet/ndarray.h`
+`kRowSparseStorage`/`kCSRStorage`, `src/operator/tensor/cast_storage-inl.h`).
+
+TPU-native design: XLA is a dense compiler, so sparsity here is a *storage
+and communication* format, not a kernel format. The compressed arrays
+(values + indices [+ indptr]) live on device as ordinary jax arrays; the
+sparse compute that matters — embedding-style row gather/scatter, csr×dense
+matmul, lazy row-wise optimizer updates — lowers to XLA gather/scatter and
+`jax.experimental.sparse.BCOO` dot_general (which XLA tiles onto the MXU as
+gather+matmul), and everything else densifies explicitly via `tostype()`.
+Host-side index bookkeeping (unions, nonzero scans) runs in numpy at the
+imperative boundary, exactly where the reference ran its CPU fallback.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray, _unwrap, array as _dense_array
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
+    "dot", "add", "retain", "cast_storage",
+]
+
+
+def _as_jax(x, dtype=None):
+    a = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+class BaseSparseNDArray(NDArray):
+    """Common behavior for compressed-storage arrays. `_data` is unused
+    (dense ops must go through `tostype('default')` explicitly, mirroring
+    the reference's storage-type dispatch that refuses dense kernels on
+    sparse inputs)."""
+
+    __slots__ = ("_values", "_indices", "_shape")
+
+    def __init__(self, values, indices, shape):
+        super().__init__(None)
+        self._values = values
+        self._indices = indices
+        self._shape = tuple(int(s) for s in shape)
+
+    # -- overridden dense-handle surface --------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def data(self):
+        """The non-zero values array (reference: MXNDArrayGetDataNDArray)."""
+        return NDArray(self._values)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def nnz(self):
+        return int(self._values.shape[0]) if self._values.ndim else 0
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+
+    def asnumpy(self):
+        return _np.asarray(self.todense()._data)
+
+    def astype(self, dtype, copy=True):
+        out = self.copy()
+        out._values = out._values.astype(jnp.dtype(dtype))
+        return out
+
+    def copyto(self, other):
+        if isinstance(other, BaseSparseNDArray):
+            other._values = self._values
+            other._indices = self._indices
+            other._shape = self._shape
+            return other
+        if isinstance(other, NDArray):
+            other._data = self.todense()._data
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def todense(self):
+        return self.tostype("default")
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {'x'.join(map(str, self._shape))} "
+                f"nnz={self.nnz} @{self.context}>")
+
+    @property
+    def context(self):
+        from ..context import Context, current_context
+        try:
+            dev = next(iter(self._values.devices()))
+            return Context(dev.platform, dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"{type(self).__name__} does not support dense op '{name}'; "
+            f"call .tostype('default') first")
+
+    # sparse-aware operators (reference: elemwise storage-type dispatch)
+    def __add__(self, other):
+        return add(self, other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            out = self.copy()
+            out._values = out._values * other
+            return out
+        return NDArray(self.todense()._data * _as_jax(other))
+
+    __rmul__ = __mul__
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows-compressed tensor: `values[(i, ...)]` holds row `indices[i]` of
+    the logical array; all other rows are zero. The gradient format of
+    Embedding/take (reference: kRowSparseStorage)."""
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, dtype=self._values.dtype)
+            if self.nnz:
+                dense = dense.at[self._indices].set(self._values)
+            return NDArray(dense)
+        if stype == "csr":
+            raise ValueError("row_sparse -> csr cast is not defined "
+                             "(matches reference cast_storage)")
+        raise ValueError(f"unknown stype {stype!r}")
+
+    def retain(self, row_ids):
+        """Keep only rows whose index appears in `row_ids`
+        (reference: _retain, sparse row_sparse_pull support)."""
+        rids = _np.asarray(_unwrap(row_ids)).astype(_np.int32).ravel()
+        cur = _np.asarray(self._indices)
+        mask = _np.isin(cur, rids)
+        keep = _np.nonzero(mask)[0]
+        return RowSparseNDArray(self._values[jnp.asarray(keep)],
+                                jnp.asarray(cur[mask]), self._shape)
+
+    def copy(self):
+        return RowSparseNDArray(self._values, self._indices, self._shape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed-sparse-row matrix (reference: kCSRStorage; aux arrays
+    indptr + indices over a flat values array)."""
+
+    __slots__ = ("_indptr",)
+
+    def __init__(self, values, indices, indptr, shape):
+        super().__init__(values, indices, shape)
+        self._indptr = indptr
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self._shape, dtype=self._values.dtype)
+            if self.nnz:
+                rows = self._expand_rows()
+                dense = dense.at[rows, self._indices].set(self._values)
+            return NDArray(dense)
+        if stype == "row_sparse":
+            return cast_storage(self.tostype("default"), "row_sparse")
+        raise ValueError(f"unknown stype {stype!r}")
+
+    def _expand_rows(self):
+        indptr = _np.asarray(self._indptr)
+        counts = _np.diff(indptr)
+        return jnp.asarray(_np.repeat(_np.arange(len(counts)), counts))
+
+    def _to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+        rows = self._expand_rows()
+        idx = jnp.stack([rows.astype(jnp.int32),
+                         self._indices.astype(jnp.int32)], axis=1)
+        return jsparse.BCOO((self._values, idx), shape=self._shape)
+
+    def asscipy(self):
+        import scipy.sparse as sp
+        return sp.csr_matrix((_np.asarray(self._values),
+                              _np.asarray(self._indices),
+                              _np.asarray(self._indptr)), shape=self._shape)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            lo, hi = int(self._indptr[key]), int(self._indptr[key + 1])
+            row = jnp.zeros((self._shape[1],), self._values.dtype)
+            if hi > lo:
+                row = row.at[self._indices[lo:hi]].set(self._values[lo:hi])
+            return NDArray(row)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise ValueError("csr slicing requires step 1")
+            indptr = _np.asarray(self._indptr)
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            new_indptr = jnp.asarray(indptr[start:stop + 1] - indptr[start])
+            return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                              new_indptr, (stop - start, self._shape[1]))
+        raise TypeError("csr supports int/slice row indexing only")
+
+    def copy(self):
+        return CSRNDArray(self._values, self._indices, self._indptr,
+                          self._shape)
+
+    def copyto(self, other):
+        if isinstance(other, CSRNDArray):
+            other._indptr = self._indptr
+        return super().copyto(other)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    """Build a RowSparseNDArray from (data, indices) or a dense source
+    (reference: mx.nd.sparse.row_sparse_array)."""
+    if isinstance(arg, RowSparseNDArray):
+        return arg.copy()
+    if isinstance(arg, tuple) and len(arg) == 2:
+        values = _as_jax(arg[0], dtype)
+        indices = _as_jax(arg[1]).astype(jnp.int32)
+        if shape is None:
+            nrows = int(_np.asarray(indices).max()) + 1 if indices.shape[0] else 0
+            shape = (nrows,) + tuple(values.shape[1:])
+        order = _np.argsort(_np.asarray(indices), kind="stable")
+        return RowSparseNDArray(values[jnp.asarray(order)],
+                                indices[jnp.asarray(order)], shape)
+    dense = _dense_array(arg, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    """Build a CSRNDArray from (data, indices, indptr), a scipy csr, or a
+    dense source (reference: mx.nd.sparse.csr_matrix)."""
+    if isinstance(arg, CSRNDArray):
+        return arg.copy()
+    if isinstance(arg, tuple) and len(arg) == 3:
+        values = _as_jax(arg[0], dtype)
+        indices = _as_jax(arg[1]).astype(jnp.int32)
+        indptr = _as_jax(arg[2]).astype(jnp.int32)
+        if shape is None:
+            ncols = int(_np.asarray(indices).max()) + 1 if indices.shape[0] else 0
+            shape = (int(indptr.shape[0]) - 1, ncols)
+        return CSRNDArray(values, indices, indptr, shape)
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(arg):
+            csr = arg.tocsr()
+            return CSRNDArray(jnp.asarray(csr.data if dtype is None
+                                          else csr.data.astype(dtype)),
+                              jnp.asarray(csr.indices.astype(_np.int32)),
+                              jnp.asarray(csr.indptr.astype(_np.int32)),
+                              csr.shape)
+    except ImportError:
+        pass
+    dense = _dense_array(arg, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    dtype = jnp.dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "default":
+        return NDArray(jnp.zeros(shape, dtype))
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+empty = zeros
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-aware mx.nd.sparse.array."""
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(source):
+            return csr_matrix(source, dtype=dtype)
+    except ImportError:
+        pass
+    if isinstance(source, BaseSparseNDArray):
+        return source.copy()
+    raise ValueError("use row_sparse_array/csr_matrix for raw tuples")
+
+
+# ---------------------------------------------------------------------------
+# sparse ops
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype):
+    """Dense <-> sparse conversion (reference: `cast_storage` op,
+    `src/operator/tensor/cast_storage-inl.h`)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    dense_np = _np.asarray(_unwrap(arr))
+    if stype == "default":
+        return NDArray(jnp.asarray(dense_np))
+    if stype == "row_sparse":
+        row_nonzero = _np.nonzero(dense_np.reshape(dense_np.shape[0], -1)
+                                  .any(axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(dense_np[row_nonzero]),
+                                jnp.asarray(row_nonzero.astype(_np.int32)),
+                                dense_np.shape)
+    if stype == "csr":
+        if dense_np.ndim != 2:
+            raise ValueError("csr requires 2-D input")
+        import scipy.sparse as sp
+        csr = sp.csr_matrix(dense_np)
+        return CSRNDArray(jnp.asarray(csr.data),
+                          jnp.asarray(csr.indices.astype(_np.int32)),
+                          jnp.asarray(csr.indptr.astype(_np.int32)),
+                          dense_np.shape)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference: `src/operator/tensor/dot-inl.h` storage
+    dispatch). Supported, as in the reference:
+      csr × dense -> dense; csr.T × dense -> dense (row_sparse in the
+      reference when rhs rows are sparse — returned dense here, a superset);
+      dense × row_sparse-as-dense falls back to densify.
+    Lowered through BCOO dot_general so XLA emits gather+MXU-matmul.
+    """
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            raise ValueError("dot(csr, dense, transpose_b=True) unsupported "
+                             "(matches reference)")
+        bcoo = lhs._to_bcoo()
+        rhs_j = _as_jax(rhs)
+        out = (bcoo.T @ rhs_j) if transpose_a else (bcoo @ rhs_j)
+        return NDArray(out)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+        a, b = _as_jax(lhs_d), _as_jax(rhs_d)
+        if transpose_a:
+            a = a.T
+        if transpose_b:
+            b = b.T
+        return NDArray(a @ b)
+    from . import dot as dense_dot
+    return dense_dot(lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b)
+
+
+def add(lhs, rhs):
+    """Elementwise add with storage dispatch (reference:
+    elemwise_add sparse paths)."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise ValueError("shape mismatch")
+        li = _np.asarray(lhs._indices)
+        ri = _np.asarray(rhs._indices)
+        union = _np.union1d(li, ri)
+        vals = jnp.zeros((len(union),) + lhs.shape[1:],
+                         jnp.result_type(lhs._values.dtype, rhs._values.dtype))
+        lpos = _np.searchsorted(union, li)
+        rpos = _np.searchsorted(union, ri)
+        if len(li):
+            vals = vals.at[jnp.asarray(lpos)].add(lhs._values.astype(vals.dtype))
+        if len(ri):
+            vals = vals.at[jnp.asarray(rpos)].add(rhs._values.astype(vals.dtype))
+        return RowSparseNDArray(vals, jnp.asarray(union.astype(_np.int32)),
+                                lhs.shape)
+    lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return NDArray(_as_jax(lhs_d) + _as_jax(rhs_d))
+
+
+def retain(arr, indices):
+    if not isinstance(arr, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    return arr.retain(indices)

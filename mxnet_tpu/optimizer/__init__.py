@@ -104,11 +104,28 @@ def _assign(weight, new_data):
     weight._data = new_data._data if isinstance(new_data, NDArray) else new_data
 
 
+def _is_row_sparse(grad):
+    from ..ndarray.sparse import RowSparseNDArray
+    return isinstance(grad, RowSparseNDArray)
+
+
+def _sparse_rows(grad, clip, rescale):
+    """Prepare (rows, row_grads) for a lazy row-wise update (reference:
+    sgd_update/adam_update kRowSparseStorage kernels with lazy_update)."""
+    import jax.numpy as jnp
+    rows = grad._indices
+    g = grad._values * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return rows, g
+
+
 @register("sgd")
 class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.multi_precision and weight.dtype != "float32":
@@ -122,6 +139,30 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad) and not self.lazy_update:
+            grad = grad.tostype("default")  # non-lazy: decay ALL rows
+        if _is_row_sparse(grad):
+            import jax.numpy as jnp
+            rows, g = _sparse_rows(grad, self._clip(), self.rescale_grad)
+            # multi_precision: do the row math on the fp32 master copy,
+            # then mirror the touched rows into the low-precision weight.
+            w32 = state[1] if (self.multi_precision
+                               and isinstance(state, tuple)) else None
+            master = w32._data if w32 is not None else weight._data
+            w_rows = master[rows]
+            g = g.astype(w_rows.dtype) + wd * w_rows
+            mom = state[0] if isinstance(state, tuple) else state
+            if self.momentum and mom is not None:
+                m_rows = self.momentum * mom._data[rows] - lr * g
+                mom._data = mom._data.at[rows].set(m_rows)
+                new_rows = w_rows + m_rows
+            else:
+                new_rows = w_rows - lr * g
+            if w32 is not None:
+                w32._data = w32._data.at[rows].set(new_rows)
+            weight._data = weight._data.at[rows].set(
+                new_rows.astype(weight._data.dtype))
+            return
         if self.multi_precision and isinstance(state, tuple):
             mom, w32 = state
             if mom is not None:
@@ -170,6 +211,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (zeros(weight.shape, dtype="float32"),
@@ -181,6 +223,21 @@ class Adam(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
+        if _is_row_sparse(grad) and not self.lazy_update:
+            grad = grad.tostype("default")  # non-lazy: decay ALL moments
+        if _is_row_sparse(grad):
+            import jax.numpy as jnp
+            rows, g = _sparse_rows(grad, self._clip(), self.rescale_grad)
+            w_rows = weight._data[rows]
+            g = g.astype(jnp.float32) + wd * w_rows.astype(jnp.float32)
+            m_rows = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+            v_rows = self.beta2 * var._data[rows] + (1 - self.beta2) * g * g
+            mean._data = mean._data.at[rows].set(m_rows)
+            var._data = var._data.at[rows].set(v_rows)
+            step = -lr * m_rows / (jnp.sqrt(v_rows) + self.epsilon)
+            weight._data = weight._data.at[rows].add(
+                step.astype(weight._data.dtype))
+            return
         new_w, new_mean, new_var = _ops.OPS["adam_update"](
             weight._data, grad._data, mean._data, var._data, lr,
             beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, wd=wd,

@@ -1,0 +1,246 @@
+"""Profiler facade (reference: `src/profiler/profiler.cc`,
+`python/mxnet/profiler.py`).
+
+The reference profiler timestamps every engine opr on its device lane and
+dumps chrome://tracing JSON plus aggregate per-op tables
+(`src/profiler/aggregate_stats.cc`). On TPU the low-level op timeline is
+XLA's job — `jax.profiler` emits full device traces viewable in
+TensorBoard/Perfetto — so this module keeps the `mx.profiler`-shaped
+frontend: host-side named scopes/events/counters collected into
+chrome://tracing JSON, with optional passthrough to `jax.profiler` for
+device-level traces.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "set_config", "set_state", "start", "stop", "pause", "resume",
+    "dump", "dumps", "Domain", "Scope", "scope", "Task", "Frame",
+    "Event", "Counter", "Marker", "start_jax_trace", "stop_jax_trace",
+]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "aggregate_stats": False,
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "continuous_dump": False,
+}
+_state = {"running": False, "paused": False}
+_events = []            # chrome-trace event dicts (ts in µs)
+_agg = {}               # name -> [count, total_us, min_us, max_us]
+_epoch_ns = time.perf_counter_ns()
+
+
+def _now_us():
+    return (time.perf_counter_ns() - _epoch_ns) / 1e3
+
+
+def set_config(**kwargs):
+    """Configure the profiler (reference C API: MXSetProcessProfilerConfig)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise ValueError(f"unknown profiler config keys: {sorted(unknown)}")
+    _config.update(kwargs)
+
+
+def set_state(state="stop"):
+    """'run' or 'stop' (reference: MXSetProcessProfilerState)."""
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    _state["running"] = state == "run"
+    _state["paused"] = False
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def pause():
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def _active():
+    return _state["running"] and not _state["paused"]
+
+
+def _record(ev, name, dur_us=None):
+    with _lock:
+        _events.append(ev)
+        if dur_us is not None and _config["aggregate_stats"]:
+            s = _agg.get(name)
+            if s is None:
+                _agg[name] = [1, dur_us, dur_us, dur_us]
+            else:
+                s[0] += 1
+                s[1] += dur_us
+                s[2] = min(s[2], dur_us)
+                s[3] = max(s[3], dur_us)
+
+
+def dump(finished=True, filename=None):
+    """Write collected events as chrome://tracing JSON
+    (reference: MXDumpProfile → chrome tracing format)."""
+    path = filename or _config["filename"]
+    with _lock:
+        events = list(_events)
+        if finished:
+            _events.clear()
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def dumps(reset=False):
+    """Aggregate per-name stats table (reference: AggregateStats::Dump)."""
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        if reset:
+            _agg.clear()
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+             f"{'Max(ms)':>10}{'Avg(ms)':>10}"]
+    for name, (cnt, tot, mn, mx) in rows:
+        lines.append(f"{name:<40}{cnt:>8}{tot / 1e3:>12.3f}{mn / 1e3:>10.3f}"
+                     f"{mx / 1e3:>10.3f}{tot / cnt / 1e3:>10.3f}")
+    return "\n".join(lines)
+
+
+class Domain:
+    """Named grouping of profiler objects (reference: profiler.Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(name, domain=self)
+
+    def new_counter(self, name, value=None):
+        c = Counter(name, domain=self)
+        if value is not None:
+            c.set_value(value)
+        return c
+
+    def new_marker(self, name):
+        return Marker(name, domain=self)
+
+
+class Scope:
+    """Timed region context manager; appears as a complete ('X') event."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.domain = domain
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is None or not _active():
+            return False
+        t1 = _now_us()
+        dur = t1 - self._t0
+        _record({
+            "name": self.name, "ph": "X", "ts": self._t0, "dur": dur,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "cat": self.domain.name if self.domain else "host",
+        }, self.name, dur_us=dur)
+        return False
+
+    start = __enter__
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+
+scope = Scope      # mx.profiler.scope('name') usage
+Task = Scope       # Tasks/Frames are host-timed regions too
+Frame = Scope
+
+
+class Event(Scope):
+    """Instantaneous or timed event; `mark()` drops an instant event."""
+
+    def mark(self):
+        if _active():
+            _record({
+                "name": self.name, "ph": "i", "ts": _now_us(), "s": "p",
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "cat": self.domain.name if self.domain else "host",
+            }, self.name)
+
+
+class Counter:
+    """Named counter series (reference: profiler.Counter)."""
+
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.domain = domain
+        self._value = value
+
+    def _emit(self):
+        if _active():
+            _record({
+                "name": self.name, "ph": "C", "ts": _now_us(),
+                "pid": os.getpid(),
+                "args": {self.name: self._value},
+            }, self.name)
+
+    def set_value(self, value):
+        self._value = value
+        self._emit()
+
+    def increment(self, delta=1):
+        self._value += delta
+        self._emit()
+
+    def decrement(self, delta=1):
+        self._value -= delta
+        self._emit()
+
+
+class Marker:
+    """Instant marker (reference: profiler.Marker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.domain = domain
+
+    def mark(self, scope="process"):
+        if _active():
+            _record({
+                "name": self.name, "ph": "i", "ts": _now_us(),
+                "s": {"process": "p", "global": "g", "thread": "t"}.get(scope, "p"),
+                "pid": os.getpid(), "tid": threading.get_ident(),
+            }, self.name)
+
+
+# --- device-level tracing: delegate to jax.profiler -------------------------
+
+def start_jax_trace(logdir):
+    """Start an XLA device trace (TensorBoard/Perfetto). The TPU-native
+    replacement for the reference's engine-integrated device timelines."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_jax_trace():
+    import jax
+    jax.profiler.stop_trace()

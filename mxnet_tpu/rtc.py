@@ -1,0 +1,91 @@
+"""Runtime kernel compilation facade (reference: `src/common/rtc.cc`,
+`python/mxnet/rtc.py` — NVRTC compilation of user CUDA source).
+
+TPU-native equivalent: user-supplied **Pallas** kernels compiled at runtime
+by Mosaic/XLA. `PallasModule` mirrors `mx.rtc.CudaModule`'s shape —
+construct from kernel source or a kernel function, `get_kernel` binds a
+signature, `launch` runs on device — but the kernel language is Pallas
+(grid + BlockSpecs) instead of CUDA C, because that is what the hardware
+JIT-compiles here. Raw CUDA source is rejected with a clear error.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule", "Kernel"]
+
+
+class Kernel:
+    """A launchable compiled kernel (reference: rtc.CudaModule.Kernel)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Run the kernel. grid/block dims are accepted for API parity but
+        ignored — Pallas grids are part of the kernel definition, and XLA
+        owns scheduling."""
+        raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+               for a in args]
+        out = self._fn(*raw)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) for o in out)
+        return NDArray(out)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """Compile-and-run container for user Pallas kernels.
+
+    Two construction modes:
+      * `PallasModule(source=...)` — a string of Python source defining one
+        or more functions that call `pl.pallas_call`; exec'd with
+        jax/jnp/pl/pltpu in scope (the NVRTC-analog path).
+      * `PallasModule(kernels={'name': fn})` — pre-built callables.
+    """
+
+    def __init__(self, source=None, kernels=None, exports=None):
+        self._kernels = dict(kernels or {})
+        if source is not None:
+            if "__global__" in source or "blockIdx" in source:
+                raise ValueError(
+                    "CUDA source is not supported on TPU; write a Pallas "
+                    "kernel (see /opt/skills/guides/pallas_guide.md and "
+                    "mxnet_tpu.pallas_ops for examples)")
+            from jax.experimental import pallas as pl
+            try:
+                from jax.experimental.pallas import tpu as pltpu
+            except ImportError:  # CPU-only envs
+                pltpu = None
+            ns = {"jax": jax, "jnp": jnp, "pl": pl, "pltpu": pltpu}
+            exec(compile(source, "<rtc>", "exec"), ns)
+            for name, obj in ns.items():
+                if callable(obj) and not name.startswith("_") and \
+                        name not in ("jax", "jnp", "pl", "pltpu"):
+                    self._kernels.setdefault(name, obj)
+        if exports is not None:
+            missing = set(exports) - set(self._kernels)
+            if missing:
+                raise ValueError(f"exported kernels not found: {sorted(missing)}")
+
+    def get_kernel(self, name, signature=None):
+        """Bind a kernel by name (signature accepted for parity; Pallas
+        kernels carry their own typing)."""
+        if name not in self._kernels:
+            raise KeyError(f"kernel {name!r} not in module "
+                           f"(have {sorted(self._kernels)})")
+        return Kernel(jax.jit(self._kernels[name]), name)
+
+
+def CudaModule(*args, **kwargs):
+    """Reference-named constructor; exists to give reference users a clear
+    landing point."""
+    raise NotImplementedError(
+        "mx.rtc.CudaModule compiles CUDA, which TPU cannot run. Use "
+        "mx.rtc.PallasModule with a Pallas kernel instead.")

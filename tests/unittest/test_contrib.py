@@ -1,0 +1,125 @@
+"""contrib.amp + contrib.quantization tests (reference:
+tests/python/unittest/test_contrib_amp.py, test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.contrib import amp, quantization
+from mxnet_tpu.gluon import nn, Trainer
+
+
+@pytest.fixture
+def amp_initialized():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp._deinit_for_tests()
+
+
+def test_amp_casts_matmul_to_bf16(amp_initialized):
+    a = nd.ones((4, 8))
+    b = nd.ones((8, 4))
+    out = nd.dot(a, b)
+    assert out.dtype == np.dtype("bfloat16") or str(out.dtype) == "bfloat16"
+    np.testing.assert_allclose(out.asnumpy().astype(np.float32), 8.0)
+
+
+def test_amp_keeps_softmax_fp32(amp_initialized):
+    x = nd.array(np.random.randn(2, 5).astype(np.float32))
+    out = nd.softmax(x.astype("bfloat16"))
+    assert str(out.dtype) == "float32"
+    np.testing.assert_allclose(out.asnumpy().sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_amp_trainer_loss_scaling(amp_initialized):
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    trainer._amp_loss_scaler.loss_scale = 4.0  # force a non-trivial scale
+    x = nd.ones((2, 4))
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            autograd.backward([scaled])
+    w_before = net.weight.data().asnumpy().copy()
+    g_scaled = net.weight.grad().asnumpy().copy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    # update must use the UNSCALED gradient: w' = w - lr * g_scaled/scale
+    np.testing.assert_allclose(w_after, w_before - 0.1 * g_scaled / 4.0,
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_amp_skips_nonfinite_step(amp_initialized):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scale0 = trainer._amp_loss_scaler.loss_scale = 8.0
+    x = nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+        autograd.backward([loss])
+    net.weight.grad()._data = net.weight.grad()._data * np.inf
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    assert trainer._amp_loss_scaler.loss_scale == scale0 / 2.0
+
+
+def test_quantize_params_roundtrip():
+    w = nd.array(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    q, scale = quantization.quantize_params(w)
+    assert q.dtype == np.int8
+    np.testing.assert_allclose(q.astype(np.float32) * scale, w.asnumpy(),
+                               atol=scale)
+
+
+def test_quantized_dense_matches_float():
+    rng = np.random.RandomState(1)
+    dense = nn.Dense(32, in_units=64)
+    dense.initialize()
+    x = nd.array(rng.randn(8, 64).astype(np.float32))
+    ref = dense(x).asnumpy()
+    qd = quantization.QuantizedDense(dense)
+    out = qd(x).asnumpy()
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.05, f"int8 relative error too high: {err}"
+
+
+def test_quantize_block_with_calibration():
+    rng = np.random.RandomState(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8))
+    net.add(nn.Dense(4, in_units=16))
+    net.initialize()
+    calib = [nd.array(rng.randn(4, 8).astype(np.float32)) for _ in range(3)]
+    x = nd.array(rng.randn(4, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    quantization.quantize_block(net, calib_data=calib)
+    out = net(x).asnumpy()
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.1, f"quantized net error too high: {err}"
+
+
+def test_amp_unscale_then_step_no_double_unscale(amp_initialized):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    trainer._amp_loss_scaler.loss_scale = 4.0
+    x = nd.ones((1, 3))
+    with autograd.record():
+        loss = net(x).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            autograd.backward([scaled])
+    w_before = net.weight.data().asnumpy().copy()
+    amp.unscale(trainer)  # e.g. for gradient clipping
+    g_unscaled = net.weight.grad().asnumpy().copy()
+    trainer.step(1)
+    w_after = net.weight.data().asnumpy()
+    np.testing.assert_allclose(w_after, w_before - 0.1 * g_unscaled,
+                               rtol=1e-3, atol=1e-6)

@@ -1,0 +1,121 @@
+"""mx.image + im2rec tests (reference: tests/python/unittest/test_image.py)."""
+import io as _io
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mx_image
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _make_jpeg(w=32, h=24, seed=0):
+    rng = np.random.RandomState(seed)
+    arr = rng.randint(0, 255, (h, w, 3), np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_imdecode_shapes():
+    img = mx_image.imdecode(_make_jpeg(40, 30))
+    assert img.shape == (30, 40, 3)
+    assert img.dtype == np.uint8
+    gray = mx_image.imdecode(_make_jpeg(40, 30), flag=0)
+    assert gray.shape == (30, 40, 1)
+
+
+def test_resize_and_crops():
+    img = mx_image.imdecode(_make_jpeg(64, 48))
+    r = mx_image.imresize(img, 32, 24)
+    assert r.shape == (24, 32, 3)
+    rs = mx_image.resize_short(img, 36)
+    assert min(rs.shape[:2]) == 36
+    c, rect = mx_image.center_crop(img, (20, 16))
+    assert c.shape == (16, 20, 3) and rect[2:] == (20, 16)
+    rc, _ = mx_image.random_crop(img, (20, 16))
+    assert rc.shape == (16, 20, 3)
+    rsc, _ = mx_image.random_size_crop(img, (20, 16), (0.3, 1.0),
+                                       (0.75, 1.333))
+    assert rsc.shape == (16, 20, 3)
+
+
+def test_color_normalize_and_augmenters():
+    img = mx_image.imdecode(_make_jpeg(16, 16, seed=1))
+    mean = np.array([120.0, 115.0, 100.0], np.float32)
+    std = np.array([58.0, 57.0, 57.0], np.float32)
+    out = mx_image.color_normalize(img, mean, std)
+    expect = (img.asnumpy().astype(np.float32) - mean) / std
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+    for aug in [mx_image.HorizontalFlipAug(1.0),
+                mx_image.BrightnessJitterAug(0.3),
+                mx_image.ContrastJitterAug(0.3),
+                mx_image.SaturationJitterAug(0.3),
+                mx_image.HueJitterAug(0.1),
+                mx_image.RandomGrayAug(1.0),
+                mx_image.CastAug()]:
+        res = aug(img)
+        assert res.shape == img.shape
+
+
+def test_create_augmenter_chain():
+    augs = mx_image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1)
+    img = mx_image.imdecode(_make_jpeg(48, 48))
+    for aug in augs:
+        img = aug(img)
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == np.float32
+
+
+def _write_image_tree(root):
+    for cls in ["cat", "dog"]:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(3):
+            with open(os.path.join(d, f"{cls}_{i}.jpg"), "wb") as f:
+                f.write(_make_jpeg(40, 40, seed=hash(cls) % 100 + i))
+
+
+def test_im2rec_and_imageiter(tmp_path):
+    root = tmp_path / "imgs"
+    _write_image_tree(str(root))
+    prefix = str(tmp_path / "data")
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = os.path.join(repo, "tools", "im2rec.py")
+    subprocess.check_call([sys.executable, script, "--list", prefix, str(root)])
+    assert os.path.exists(prefix + ".lst")
+    subprocess.check_call([sys.executable, script, prefix, str(root),
+                           "--resize", "32"])
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    it = mx_image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                            path_imgrec=prefix + ".rec", shuffle=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)
+    labels = set()
+    it.reset()
+    for b in it:
+        labels.update(np.asarray(b.label[0].asnumpy()).tolist())
+        break
+    assert labels <= {0.0, 1.0}
+
+
+def test_imageiter_from_imglist(tmp_path):
+    root = tmp_path / "imgs2"
+    _write_image_tree(str(root))
+    imglist = [[0.0, "cat/cat_0.jpg"], [1.0, "dog/dog_1.jpg"]]
+    it = mx_image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                            imglist=imglist, path_root=str(root))
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    np.testing.assert_array_equal(batch.label[0].asnumpy(), [0.0, 1.0])

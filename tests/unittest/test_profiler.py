@@ -1,0 +1,70 @@
+"""Profiler facade tests (reference: tests/python/unittest/test_profiler.py)."""
+import json
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_scope_dump_chrome_trace(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "profile.json"),
+                        aggregate_stats=True)
+    profiler.start()
+    with profiler.Scope("matmul_block"):
+        time.sleep(0.01)
+    with profiler.Scope("matmul_block"):
+        time.sleep(0.005)
+    profiler.stop()
+    path = profiler.dump()
+    trace = json.load(open(path))
+    evs = [e for e in trace["traceEvents"] if e["name"] == "matmul_block"]
+    assert len(evs) == 2
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in evs)
+
+
+def test_aggregate_stats_table():
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+    with profiler.Scope("agg_region"):
+        time.sleep(0.002)
+    profiler.stop()
+    table = profiler.dumps(reset=True)
+    assert "agg_region" in table
+    assert "Count" in table
+
+
+def test_counter_marker_and_pause(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "p.json"))
+    profiler.start()
+    d = profiler.Domain("train")
+    c = d.new_counter("loss_scale", 128)
+    c.increment(128)
+    m = d.new_marker("epoch_end")
+    m.mark()
+    profiler.pause()
+    with profiler.Scope("not_recorded"):
+        pass
+    profiler.resume()
+    profiler.stop()
+    trace = json.load(open(profiler.dump(filename=str(tmp_path / "p.json"))))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "loss_scale" in names and "epoch_end" in names
+    assert "not_recorded" not in names
+
+
+def test_stopped_records_nothing(tmp_path):
+    profiler.set_state("stop")
+    profiler.dump(filename=str(tmp_path / "drain.json"))  # drain prior events
+    with profiler.Scope("off"):
+        pass
+    trace = json.load(open(profiler.dump(filename=str(tmp_path / "x.json"))))
+    assert trace["traceEvents"] == []
+
+
+def test_bad_config_key_raises():
+    try:
+        profiler.set_config(bogus=True)
+    except ValueError as e:
+        assert "bogus" in str(e)
+    else:
+        raise AssertionError("expected ValueError")

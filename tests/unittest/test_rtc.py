@@ -1,0 +1,33 @@
+"""rtc (Pallas runtime-compile facade) tests (reference: test_rtc.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, rtc
+
+
+def test_pallas_module_from_kernels():
+    mod = rtc.PallasModule(kernels={"axpy": lambda a, x, y: a * x + y})
+    k = mod.get_kernel("axpy")
+    out = k.launch([nd.full((4,), 2.0), nd.ones((4,)), nd.ones((4,))])
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_pallas_module_from_source():
+    src = "def scale2(x):\n    return x * 2\n"
+    mod = rtc.PallasModule(source=src)
+    out = mod.get_kernel("scale2").launch([nd.ones((3,))])
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_cuda_source_rejected():
+    with pytest.raises(ValueError, match="CUDA source is not supported"):
+        rtc.PallasModule(source="__global__ void k(float* x) {}")
+    with pytest.raises(NotImplementedError):
+        rtc.CudaModule("anything")
+
+
+def test_missing_kernel_raises():
+    mod = rtc.PallasModule(kernels={"f": lambda x: x})
+    with pytest.raises(KeyError):
+        mod.get_kernel("g")

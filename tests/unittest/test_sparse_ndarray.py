@@ -1,0 +1,178 @@
+"""Sparse NDArray tests (reference: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(*shape) < density
+    return x * mask
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((6, 4), np.float32)
+    dense[1] = 1.5
+    dense[4] = -2.0
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    np.testing.assert_array_equal(np.asarray(rsp.indices.asnumpy()), [1, 4])
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_row_sparse_from_tuple_sorts_indices():
+    rsp = sparse.row_sparse_array(
+        (np.array([[3.0, 3], [1, 1]], np.float32), np.array([5, 2])),
+        shape=(7, 2))
+    np.testing.assert_array_equal(np.asarray(rsp.indices.asnumpy()), [2, 5])
+    assert rsp.asnumpy()[5, 0] == 3.0 and rsp.asnumpy()[2, 0] == 1.0
+
+
+def test_csr_roundtrip_and_indexing():
+    dense = _rand_dense((5, 7))
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    np.testing.assert_allclose(csr[2].asnumpy(), dense[2], rtol=1e-6)
+    sl = csr[1:4]
+    assert sl.shape == (3, 7)
+    np.testing.assert_allclose(sl.asnumpy(), dense[1:4], rtol=1e-6)
+
+
+def test_csr_scipy_interop():
+    import scipy.sparse as sp
+    dense = _rand_dense((4, 6), seed=3)
+    csr = sparse.csr_matrix(sp.csr_matrix(dense))
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    back = csr.asscipy()
+    np.testing.assert_allclose(back.toarray(), dense, rtol=1e-6)
+
+
+def test_cast_storage_both_ways():
+    dense = nd.array(_rand_dense((6, 3), seed=1))
+    assert dense.stype == "default"
+    rsp = dense.tostype("row_sparse")
+    csr = dense.tostype("csr")
+    np.testing.assert_allclose(rsp.asnumpy(), dense.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(csr.asnumpy(), dense.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(rsp.tostype("default").asnumpy(),
+                               dense.asnumpy(), rtol=1e-6)
+
+
+def test_sparse_dot_csr_dense():
+    a = _rand_dense((5, 8), seed=2)
+    b = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5, atol=1e-5)
+    out_t = sparse.dot(csr, nd.array(np.random.RandomState(1)
+                                     .randn(5, 3).astype(np.float32)),
+                       transpose_a=True)
+    assert out_t.shape == (8, 3)
+
+
+def test_sparse_add_union_of_rows():
+    a = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 2])), shape=(5, 3))
+    b = sparse.row_sparse_array(
+        (2 * np.ones((2, 3), np.float32), np.array([2, 4])), shape=(5, 3))
+    c = a + b
+    assert c.stype == "row_sparse"
+    expect = np.zeros((5, 3), np.float32)
+    expect[0] = 1
+    expect[2] = 3
+    expect[4] = 2
+    np.testing.assert_allclose(c.asnumpy(), expect)
+
+
+def test_retain():
+    dense = np.arange(12, dtype=np.float32).reshape(6, 2)
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, nd.array([1, 3]))
+    expect = np.zeros_like(dense)
+    expect[[1, 3]] = dense[[1, 3]]
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.nnz == 0 and z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 3))
+    assert zc.asnumpy().sum() == 0
+
+
+def test_lazy_sgd_update_touches_only_grad_rows():
+    from mxnet_tpu import optimizer as opt
+    w = nd.ones((6, 3))
+    g = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([1, 4])), shape=(6, 3))
+    sgd = opt.SGD(learning_rate=0.5, momentum=0.9)
+    state = sgd.create_state(0, w)
+    sgd.update(0, w, g, state)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[[0, 2, 3, 5]], 1.0)
+    np.testing.assert_allclose(out[[1, 4]], 0.5)
+    # second step applies momentum on touched rows only
+    sgd.update(0, w, g, state)
+    out2 = w.asnumpy()
+    np.testing.assert_allclose(out2[[0, 2, 3, 5]], 1.0)
+    assert np.all(out2[[1, 4]] < 0.5)
+
+
+def test_lazy_adam_update():
+    from mxnet_tpu import optimizer as opt
+    w = nd.ones((5, 2))
+    g = sparse.row_sparse_array(
+        (np.full((1, 2), 3.0, np.float32), np.array([2])), shape=(5, 2))
+    adam = opt.Adam(learning_rate=0.1)
+    state = adam.create_state(0, w)
+    adam.update(0, w, g, state)
+    out = w.asnumpy()
+    np.testing.assert_allclose(out[[0, 1, 3, 4]], 1.0)
+    assert np.all(out[2] < 1.0)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.array(np.arange(20, dtype=np.float32).reshape(10, 2)))
+    out = kv.row_sparse_pull("emb", row_ids=nd.array([3, 7, 3]))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(np.asarray(out.indices.asnumpy()), [3, 7])
+    np.testing.assert_allclose(out.asnumpy()[3], [6, 7])
+    np.testing.assert_allclose(out.asnumpy()[0], [0, 0])
+
+
+def test_kvstore_sparse_push():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([1])), shape=(6, 2))
+    g2 = sparse.row_sparse_array(
+        (2 * np.ones((1, 2), np.float32), np.array([4])), shape=(6, 2))
+    kv.push("w", [g1, g2])
+    out = kv.pull("w")
+    expect = np.zeros((6, 2), np.float32)
+    expect[1] = 1
+    expect[4] = 2
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_sgd_lazy_update_false_decays_all_rows():
+    from mxnet_tpu import optimizer as opt
+    w = nd.ones((4, 2))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([2])), shape=(4, 2))
+    sgd = opt.SGD(learning_rate=0.1, wd=0.5, lazy_update=False)
+    sgd.update(0, w, g, None)
+    out = w.asnumpy()
+    # non-lazy: weight decay applies to EVERY row, not just row 2
+    np.testing.assert_allclose(out[0], 1.0 - 0.1 * 0.5, rtol=1e-5)
+    np.testing.assert_allclose(out[2], 1.0 - 0.1 * 1.5, rtol=1e-5)

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory or list file into RecordIO
+(reference: `tools/im2rec.py`).
+
+Two modes, like the reference:
+  1. --list: walk a directory, emit a .lst file (index \t label \t relpath)
+  2. pack:   read a .lst file, encode/resize images, write .rec + .idx
+
+Usage:
+  python tools/im2rec.py --list prefix image_root
+  python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png"}
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    entries = []
+    i = 0
+    walker = os.walk(root, followlinks=True) if recursive else \
+        [(root, [], os.listdir(root))]
+    for path, dirs, files in walker:
+        dirs.sort()
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            rel = os.path.relpath(os.path.join(path, fname), root)
+            label_name = os.path.dirname(rel) or "."
+            if label_name not in cat:
+                cat[label_name] = len(cat)
+            entries.append((i, cat[label_name], rel))
+            i += 1
+    return entries
+
+
+def write_list(prefix, entries, shuffle=False, train_ratio=1.0):
+    if shuffle:
+        random.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    chunks = [("", entries)] if train_ratio >= 1.0 else \
+        [("_train", entries[:n_train]), ("_val", entries[n_train:])]
+    for suffix, chunk in chunks:
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, label, rel in chunk:
+                f.write(f"{i}\t{label}\t{rel}\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def encode_image(path, resize=0, quality=95, color=1):
+    from PIL import Image
+    import io as _io
+    img = Image.open(path).convert("RGB" if color else "L")
+    if resize:
+        w, h = img.size
+        if w < h:
+            img = img.resize((resize, int(h * resize / w)), Image.BILINEAR)
+        else:
+            img = img.resize((int(w * resize / h), resize), Image.BILINEAR)
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def make_rec(prefix, root, lst_path, resize=0, quality=95, color=1):
+    record = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(lst_path):
+        img_bytes = encode_image(os.path.join(root, rel), resize, quality,
+                                 color)
+        label = labels[0] if len(labels) == 1 else labels
+        record.write_idx(idx, pack(IRHeader(0, label, idx, 0), img_bytes))
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images")
+    record.close()
+    print(f"wrote {n} records to {prefix}.rec")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="prefix for .lst/.rec/.idx output")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true", help="generate .lst only")
+    p.add_argument("--shuffle", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--gray", action="store_true")
+    p.add_argument("--recursive", action="store_true", default=True)
+    args = p.parse_args(argv)
+
+    if args.list:
+        entries = list_images(args.root, args.recursive)
+        write_list(args.prefix, entries, args.shuffle, args.train_ratio)
+        print(f"wrote {len(entries)} entries")
+    else:
+        lst = args.prefix + ".lst"
+        if not os.path.exists(lst):
+            entries = list_images(args.root, args.recursive)
+            write_list(args.prefix, entries, args.shuffle)
+        make_rec(args.prefix, args.root, lst, args.resize, args.quality,
+                 0 if args.gray else 1)
+
+
+if __name__ == "__main__":
+    main()
