@@ -18,11 +18,13 @@ from . import random
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
+from . import attribute
+from .attribute import AttrScope
 
 __all__ = [
-    "nd", "ndarray", "autograd", "random", "context",
-    "Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus",
-    "MXNetError",
+    "nd", "ndarray", "autograd", "random", "context", "attribute",
+    "AttrScope", "Context", "cpu", "gpu", "tpu", "current_context",
+    "num_gpus", "num_tpus", "MXNetError",
 ]
 
 # Subpackages filled in over the build; imported lazily to keep import light
@@ -50,6 +52,8 @@ _LAZY = {
     "contrib": ".contrib",
     "recordio": ".io.recordio",
     "rtc": ".rtc",
+    "visualization": ".visualization",
+    "viz": ".visualization",
 }
 
 

@@ -127,8 +127,10 @@ def _collect_activations(block, x, collector, prefix):
         if isinstance(child, _nn.Dense):
             collector.collect(f"{prefix}{name}", x)
             x = child(x)
-        else:
+        elif getattr(child, "_children", None):
             x = _collect_activations(child, x, collector, f"{prefix}{name}.")
+        else:  # leaf non-Dense layer (Activation, Dropout, ...): apply it
+            x = child(x)
     return x
 
 

@@ -20,7 +20,7 @@ from . import recordio
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "ImageRecordIter", "MNISTIter", "CSVIter",
-           "recordio"]
+           "LibSVMIter", "recordio"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
@@ -215,11 +215,35 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
-                 std_b=1.0, preprocess_threads=4, round_batch=True, **kwargs):
+                 std_b=1.0, preprocess_threads=4, round_batch=True,
+                 use_native=None, seed=0, **kwargs):
         super().__init__(batch_size)
         self._data_shape = tuple(data_shape)  # (C, H, W)
         idx_path = path_imgidx or path_imgrec.rsplit(".", 1)[0] + ".idx"
         self._record = recordio.IndexedRecordIO(idx_path, path_imgrec, "r")
+        self._native = None
+        if use_native is not False and self._record.keys:
+            # C++ decode/augment/prefetch pipeline (native/), the analog of
+            # the reference's ImageRecordIOParser2 fast path; JPEG-only —
+            # sniff the first payload before committing to it.
+            _, payload = recordio.unpack(
+                self._record.read_idx(self._record.keys[0]))
+            if payload[:2] == b"\xff\xd8":
+                from . import native as _native_mod
+                if _native_mod.available():
+                    try:
+                        self._native = _native_mod.NativeImagePipeline(
+                            path_imgrec, idx_path, batch_size,
+                            self._data_shape,
+                            num_threads=preprocess_threads, shuffle=shuffle,
+                            rand_crop=rand_crop, rand_mirror=rand_mirror,
+                            mean=[mean_r, mean_g, mean_b],
+                            std=[std_r, std_g, std_b], seed=seed)
+                    except RuntimeError:
+                        self._native = None
+        if use_native and self._native is None:
+            raise RuntimeError("use_native=True but native pipeline "
+                               "could not be initialized")
         self._shuffle = shuffle
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
@@ -228,8 +252,10 @@ class ImageRecordIter(DataIter):
         self._pool = ThreadPoolExecutor(max_workers=preprocess_threads)
         self.reset()
 
-    def _decode_one(self, key):
-        header, payload = recordio.unpack(self._record.read_idx(key))
+    def _decode_one(self, raw):
+        # raw record bytes are read serially in next() — the shared file
+        # handle's seek/read is not thread-safe; only decode fans out.
+        header, payload = recordio.unpack(raw)
         img = recordio.imdecode(payload, 1).astype(np.float32)  # HWC
         C, H, W = self._data_shape
         ih, iw = img.shape[:2]
@@ -253,6 +279,10 @@ class ImageRecordIter(DataIter):
         return chw, np.float32(label)
 
     def reset(self):
+        if self._native is not None:
+            if getattr(self, "_started", False):
+                self._native.reset()
+            self._started = True
         keys = list(self._record.keys)
         if self._shuffle:
             np.random.shuffle(keys)
@@ -268,6 +298,13 @@ class ImageRecordIter(DataIter):
         return [DataDesc("softmax_label", (self.batch_size,))]
 
     def next(self):
+        if self._native is not None:
+            out = self._native.next()
+            if out is None:
+                raise StopIteration
+            data, label, pad = out
+            return DataBatch([_nd.array(data)],
+                             [_nd.array(label[:, 0])], pad=pad)
         if self._cursor >= len(self._keys):
             raise StopIteration
         keys = self._keys[self._cursor:self._cursor + self.batch_size]
@@ -275,7 +312,8 @@ class ImageRecordIter(DataIter):
         pad = self.batch_size - len(keys)
         if pad:
             keys = keys + self._keys[:pad]
-        results = list(self._pool.map(self._decode_one, keys))
+        raws = [self._record.read_idx(k) for k in keys]
+        results = list(self._pool.map(self._decode_one, raws))
         data = np.stack([r[0] for r in results])
         label = np.asarray([r[1] for r in results], np.float32)
         return DataBatch([_nd.array(data)], [_nd.array(label)], pad=pad)
@@ -296,6 +334,69 @@ class MNISTIter(NDArrayIter):
             np.transpose(data, (0, 3, 1, 2))
         super().__init__(data, ds._label.astype(np.float32),
                          batch_size=batch_size, shuffle=shuffle)
+
+
+class LibSVMIter(DataIter):
+    """Sparse libsvm-format iterator yielding CSR batches
+    (reference: `src/io/iter_libsvm.cc`)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size, label_libsvm=None,
+                 label_shape=None, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[0] if isinstance(
+            data_shape, (tuple, list)) else data_shape)
+        self._labels, self._rows = self._parse(data_libsvm)
+        self._cursor = 0
+
+    def _parse(self, path):
+        labels, rows = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(p.split(":")[0]), float(p.split(":")[1]))
+                             for p in parts[1:]])
+        return np.asarray(labels, np.float32), rows
+
+    def reset(self):
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def next(self):
+        from ..ndarray.sparse import CSRNDArray
+        import jax.numpy as jnp
+        if self._cursor >= len(self._rows):
+            raise StopIteration
+        rows = self._rows[self._cursor:self._cursor + self.batch_size]
+        labels = list(self._labels[self._cursor:self._cursor + self.batch_size])
+        self._cursor += self.batch_size
+        pad = self.batch_size - len(rows)
+        while len(rows) < self.batch_size:  # wrap-around padding (round_batch)
+            take = min(self.batch_size - len(rows), len(self._rows))
+            rows = rows + self._rows[:take]
+            labels.extend(self._labels[:take])
+        labels = np.asarray(labels, np.float32)
+        values, indices, indptr = [], [], [0]
+        for r in rows:
+            for idx, val in r:
+                indices.append(idx)
+                values.append(val)
+            indptr.append(len(values))
+        data = CSRNDArray(
+            jnp.asarray(np.asarray(values, np.float32)),
+            jnp.asarray(np.asarray(indices, np.int32)),
+            jnp.asarray(np.asarray(indptr, np.int32)),
+            (len(rows), self._num_features))
+        return DataBatch([data], [_nd.array(labels)], pad=pad)
 
 
 class CSVIter(DataIter):

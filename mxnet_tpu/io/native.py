@@ -1,0 +1,139 @@
+"""ctypes bindings for the native C++ data pipeline (native/
+recordio_pipeline.cc — the equivalent of the reference's C++
+`src/io/iter_image_recordio_2.cc` decode/augment/prefetch stack).
+
+Loads `native/libmxtpu_io.so`, building it with `make` on first use when a
+toolchain is present. All entry points degrade gracefully: callers check
+`available()` and fall back to the Python thread-pool path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "NativeImagePipeline"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmxtpu_io.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               capture_output=True, check=True, timeout=120)
+            except Exception:
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.mxtpu_pipe_create.restype = ctypes.c_void_p
+        lib.mxtpu_pipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_int]
+        lib.mxtpu_pipe_next.restype = ctypes.c_int
+        lib.mxtpu_pipe_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.mxtpu_pipe_num_batches.restype = ctypes.c_int
+        lib.mxtpu_pipe_num_batches.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_num_samples.restype = ctypes.c_int
+        lib.mxtpu_pipe_num_samples.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_decode_failures.restype = ctypes.c_int
+        lib.mxtpu_pipe_decode_failures.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_reset.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_pipe_destroy.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_last_error.restype = ctypes.c_char_p
+        _lib = lib
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+class NativeImagePipeline:
+    """Owns one native pipeline handle; yields (data, label, pad) batches."""
+
+    def __init__(self, rec_path, idx_path, batch_size, data_shape,
+                 num_threads=4, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None, seed=0,
+                 label_width=1):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native pipeline unavailable")
+        self._lib = lib
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*(list(mean or [0, 0, 0])[:3]))
+        std_arr = (ctypes.c_float * 3)(*(list(std or [1, 1, 1])[:3]))
+        self._handle = lib.mxtpu_pipe_create(
+            rec_path.encode(), (idx_path or "").encode(), batch_size, c, h, w,
+            num_threads, int(shuffle), int(rand_crop), int(rand_mirror),
+            mean_arr, std_arr, seed, label_width)
+        if not self._handle:
+            raise RuntimeError("native pipeline create failed: %s"
+                               % lib.mxtpu_last_error().decode())
+        self.batch_size = batch_size
+        self.data_shape = (c, h, w)
+        self.label_width = label_width
+        self._data_buf = np.empty((batch_size, c, h, w), np.float32)
+        self._label_buf = np.empty((batch_size, label_width), np.float32)
+
+    @property
+    def num_batches(self):
+        return self._lib.mxtpu_pipe_num_batches(self._handle)
+
+    @property
+    def num_samples(self):
+        return self._lib.mxtpu_pipe_num_samples(self._handle)
+
+    @property
+    def decode_failures(self):
+        return self._lib.mxtpu_pipe_decode_failures(self._handle)
+
+    def next(self):
+        """Returns (data NCHW f32, label f32, pad) or None at epoch end."""
+        n = self._lib.mxtpu_pipe_next(
+            self._handle,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n <= 0:
+            if n < 0:
+                raise RuntimeError("native pipeline error: %s"
+                                   % self._lib.mxtpu_last_error().decode())
+            return None
+        # copy out: the ring slot behind the buffer is recycled immediately
+        return (self._data_buf.copy(), self._label_buf.copy(),
+                self.batch_size - n)
+
+    def reset(self):
+        self._lib.mxtpu_pipe_reset(self._handle)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.mxtpu_pipe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -11,9 +11,14 @@ from .functional_opt import FunctionalOptimizer
 from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, ring_self_attention
 from .pipeline import pipeline_apply, pipeline_shard_map
+from .distributed import init_distributed, is_distributed
+from .ulysses import ulysses_attention, ulysses_self_attention
+from .moe import moe_apply, moe_ffn
 
 __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding",
            "PartitionSpec", "specs", "batch_spec", "param_spec", "fsdp_spec",
            "replicated", "apply_tp_rules", "FunctionalOptimizer",
            "ShardedTrainer", "ring_attention", "ring_self_attention",
-           "pipeline_apply", "pipeline_shard_map"]
+           "pipeline_apply", "pipeline_shard_map", "init_distributed",
+           "is_distributed", "ulysses_attention", "ulysses_self_attention",
+           "moe_apply", "moe_ffn"]

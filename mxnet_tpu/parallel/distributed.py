@@ -1,0 +1,56 @@
+"""Multi-host initialization (reference: ps-lite Postoffice::Start +
+`tools/launch.py` env wiring — here it is one jax.distributed handshake).
+
+`tools/launch.py` spawns one process per host with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID set; `init_distributed()` reads them and
+brings the process into the global SPMD job. After it returns,
+`jax.devices()` spans every host and a `parallel.make_mesh()` covers the
+full ICI/DCN topology — collectives ride the fabric with no further setup.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["init_distributed", "rank", "num_workers", "is_distributed"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Join the multi-host job described by the launcher env (no-op for
+    single-process runs)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or \
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single-host run; nothing to do
+    num_processes = int(num_processes if num_processes is not None
+                        else os.environ.get("JAX_NUM_PROCESSES", "1"))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def is_distributed():
+    return _initialized
+
+
+def rank():
+    import jax
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+    return jax.process_count()

@@ -13,6 +13,7 @@ Axis vocabulary (used across parallel/ and models/):
   tp   — tensor (Megatron) parallel
   sp   — sequence/context parallel (ring attention)
   pp   — pipeline stages
+  ep   — expert parallel (MoE expert sharding + all_to_all dispatch)
 """
 from __future__ import annotations
 
@@ -32,8 +33,9 @@ class MeshPlan:
     """A named parallelism plan: axis name → size. Size -1 means 'absorb the
     remaining devices' (at most one axis may be -1)."""
 
-    def __init__(self, dp=1, fsdp=1, tp=1, sp=1, pp=1):
-        self.axes = {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp, "pp": pp}
+    def __init__(self, dp=1, fsdp=1, tp=1, sp=1, pp=1, ep=1):
+        self.axes = {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp,
+                     "pp": pp, "ep": ep}
 
     def resolve(self, n_devices):
         sizes = dict(self.axes)
@@ -64,12 +66,12 @@ def make_mesh(plan=None, devices=None, **axis_sizes):
     """
     if plan is None:
         plan = MeshPlan(**{k: axis_sizes.get(k, 1) for k in
-                           ("dp", "fsdp", "tp", "sp", "pp")}) \
+                           ("dp", "fsdp", "tp", "sp", "pp", "ep")}) \
             if axis_sizes else MeshPlan(dp=-1)
     devices = devices or jax.devices()
     sizes = plan.resolve(len(devices))
-    # order: pp outermost (cross-slice ok), then dp, fsdp, sp, tp innermost
-    order = ["pp", "dp", "fsdp", "sp", "tp"]
+    # order: pp outermost (cross-slice ok), then dp, fsdp, ep, sp, tp innermost
+    order = ["pp", "dp", "fsdp", "ep", "sp", "tp"]
     shape = [sizes[a] for a in order]
     arr = np.asarray(devices[:math.prod(shape)]).reshape(shape)
     mesh = Mesh(arr, axis_names=tuple(order))

@@ -1,0 +1,114 @@
+"""Expert parallelism: Switch-style mixture-of-experts over an `ep` mesh axis.
+
+Net-new vs the reference (SURVEY.md §2.4 lists expert parallel as absent).
+Mesh-TensorFlow-style dense dispatch: top-1 routing builds a one-hot
+dispatch tensor, tokens travel to their expert's device via `lax.all_to_all`
+(ICI), experts run batched FFN einsums on the MXU, results return through
+the inverse all_to_all weighted by the router gate. Capacity-bounded so
+every shape is static (XLA requirement); overflow tokens are dropped and
+pass through the residual, exactly as in Switch Transformer.
+
+Layout contract (inside shard_map over `ep`, n = axis size):
+  x       (N_local, D)            tokens on this device
+  router  (D, E)                  replicated
+  w1      (E_local, D, F)         this device's experts
+  w2      (E_local, F, D)
+  E = n * E_local total experts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+__all__ = ["moe_dispatch", "moe_ffn", "moe_apply"]
+
+
+def moe_dispatch(x, router_w, num_experts, capacity, axis_name=None):
+    """Top-1 routing: returns (dispatch, combine, aux_loss).
+
+    dispatch (N, E, C) one-hot send tensor; combine = dispatch * gate.
+    aux_loss is the Switch load-balancing loss (mean_frac · mean_prob · E).
+    """
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)  # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                            # (N,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    one_hot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0              # (N, E)
+    in_cap = (pos < capacity) & (one_hot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)                     # (N, E, C)
+    dispatch = pos_oh * in_cap[..., None]
+    combine = dispatch * gate[:, None, None]
+
+    # load-balancing aux loss (Switch eq. 4): fraction of tokens per expert
+    # times mean router prob per expert, summed, scaled by E
+    frac = one_hot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(x, router_w, w1, w2, axis_name, capacity_factor=1.25,
+            activation=jax.nn.gelu):
+    """Expert-parallel Switch FFN. Call INSIDE shard_map over `axis_name`.
+
+    Shapes per the module docstring. Returns (out (N,D), aux_loss scalar —
+    already psum-averaged over the axis).
+    """
+    n = lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    num_experts = n * e_local
+    n_tokens, d_model = x.shape
+    capacity = max(int(n_tokens * capacity_factor / num_experts), 1)
+
+    dispatch, combine, aux = moe_dispatch(x, router_w, num_experts, capacity)
+
+    # gather tokens into expert buffers: (E, C, D)
+    buf = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+    # send each expert-shard to its owner: (E, C, D) -> (n, E_local, C, D)
+    buf = buf.reshape(n, e_local, capacity, d_model)
+    # all_to_all over leading dim: afterwards dim 0 indexes SOURCE device,
+    # and this device holds only its local experts' tokens from every peer
+    buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    # (n, E_local, C, D): fold sources into the capacity dim for the FFN
+    buf = buf.transpose(1, 0, 2, 3).reshape(e_local, n * capacity, d_model)
+
+    h = jnp.einsum("ecd,edf->ecf", buf, w1.astype(jnp.float32))
+    h = activation(h)
+    out = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+
+    # route back: inverse reshape + all_to_all
+    out = out.reshape(e_local, n, capacity, d_model).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)
+    out = out.reshape(num_experts, capacity, d_model)
+
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    aux = lax.pmean(aux, axis_name)
+    return y.astype(x.dtype), aux
+
+
+def moe_apply(x, router_w, w1, w2, mesh=None, axis_name="ep",
+              capacity_factor=1.25, activation=jax.nn.gelu):
+    """shard_map wrapper: x (N, D) sharded on tokens, experts sharded on
+    `axis_name`; router replicated. Returns (y, aux_loss)."""
+    from jax import shard_map
+
+    mesh = mesh or current_mesh()
+    fn = shard_map(
+        lambda x_, r_, w1_, w2_: moe_ffn(
+            x_, r_, w1_, w2_, axis_name, capacity_factor, activation),
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(None, None),
+                  P(axis_name, None, None), P(axis_name, None, None)),
+        out_specs=(P(axis_name, None), P()),
+        check_vma=False)
+    return fn(x, router_w, w1, w2)

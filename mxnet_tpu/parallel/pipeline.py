@@ -63,7 +63,7 @@ def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
                        axis_name="pp"):
     """Top-level helper: stacked_params pytree with leading stage dim sharded
     over `pp`; microbatches (M, mb, ...) replicated."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = mesh or current_mesh()
     pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
@@ -73,4 +73,4 @@ def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
         return pipeline_apply(stage_fn, params_local, mb, axis_name)
 
     return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
-                     check_rep=False)(stacked_params, microbatches)
+                     check_vma=False)(stacked_params, microbatches)

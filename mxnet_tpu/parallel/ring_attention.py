@@ -112,7 +112,7 @@ def ring_self_attention(q, k, v, mask=None, causal=False, mesh=None,
                         axis_name="sp"):
     """Convenience wrapper: shard_map over the mesh's `sp` axis with
     (B, H, L, D) global tensors; L is sharded."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = mesh or current_mesh()
     qspec = P(None, None, axis_name, None)
@@ -123,10 +123,10 @@ def ring_self_attention(q, k, v, mask=None, causal=False, mesh=None,
             lambda q_, k_, v_, m_: ring_attention(
                 q_, k_, v_, axis_name, mask=m_, causal=causal),
             mesh=mesh, in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
-            check_rep=False)
+            check_vma=False)
         return fn(q, k, v, mask)
     fn = shard_map(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name, causal=causal),
         mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)

@@ -1,0 +1,104 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence reshard.
+
+Net-new vs the reference (SURVEY.md §5.7). Complementary to ring attention:
+instead of rotating K/V around the ring, two `lax.all_to_all`s reshard the
+activations so each device sees the FULL sequence for a SUBSET of heads —
+then any local attention kernel (the Pallas flash kernel included) runs
+unchanged. Cost: 2 all-to-alls of the qkv/out activations; wins over ring
+when head count ≥ devices and the per-device sequence is short enough that
+ring latency dominates.
+
+Layout contract (inside shard_map over `sp`):
+  in:  q,k,v (B, H, L/n, D)  — all heads, local sequence shard
+  mid: (B, H/n, L, D)        — local heads, full sequence
+  out: (B, H, L/n, D)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import current_mesh
+
+__all__ = ["ulysses_attention", "ulysses_self_attention", "seq_to_heads",
+           "heads_to_seq"]
+
+
+def seq_to_heads(x, axis_name):
+    """(B, H, L/n, D) → (B, H/n, L, D): split heads across the axis, gather
+    the sequence (one all_to_all on ICI)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name):
+    """(B, H/n, L, D) → (B, H, L/n, D): inverse reshard."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _local_attention(q, k, v, mask, causal, sm_scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :].astype(bool), s, -1e30)
+    if causal:
+        L = q.shape[2]
+        idx = jnp.arange(L)
+        s = jnp.where(idx[None, None, :, None] >= idx[None, None, None, :],
+                      s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, mask=None, causal=False,
+                      sm_scale=None, attn_fn=None):
+    """Call INSIDE shard_map with sequence sharded on `axis_name`.
+
+    q,k,v: (B, H, L_local, D); H must be divisible by the axis size.
+    mask: (B, L_local) padding mask (True = attend). `attn_fn` overrides the
+    local kernel (signature (q,k,v,mask,causal,sm_scale) on full-seq blocks),
+    e.g. to drop in the Pallas flash kernel.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.psum(1, axis_name)
+    if q.shape[1] % n:
+        raise ValueError(f"num_heads {q.shape[1]} not divisible by "
+                         f"axis size {n}")
+    q_f = seq_to_heads(q, axis_name)
+    k_f = seq_to_heads(k, axis_name)
+    v_f = seq_to_heads(v, axis_name)
+    full_mask = None
+    if mask is not None:
+        # (B, L/n) -> (B, L): every device needs the whole padding mask
+        full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    fn = attn_fn or _local_attention
+    out = fn(q_f, k_f, v_f, full_mask, causal, sm_scale)
+    return heads_to_seq(out, axis_name)
+
+
+def ulysses_self_attention(q, k, v, mask=None, causal=False, mesh=None,
+                           axis_name="sp"):
+    """shard_map wrapper over global (B, H, L, D) tensors, L sharded on
+    `axis_name` (mirror of ring_self_attention)."""
+    from jax import shard_map
+
+    mesh = mesh or current_mesh()
+    qspec = P(None, None, axis_name, None)
+    mspec = P(None, axis_name)
+    if mask is not None:
+        fn = shard_map(
+            lambda q_, k_, v_, m_: ulysses_attention(
+                q_, k_, v_, axis_name, mask=m_, causal=causal),
+            mesh=mesh, in_specs=(qspec, qspec, qspec, mspec),
+            out_specs=qspec, check_vma=False)
+        return fn(q, k, v, mask)
+    fn = shard_map(
+        lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis_name,
+                                             causal=causal),
+        mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+        check_vma=False)
+    return fn(q, k, v)

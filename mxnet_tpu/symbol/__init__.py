@@ -157,7 +157,8 @@ def _auto_name(op):
 # --------------------------------------------------------------------------
 
 class _Node:
-    __slots__ = ("op", "name", "inputs", "attrs", "_shape", "_dtype")
+    __slots__ = ("op", "name", "inputs", "attrs", "_shape", "_dtype",
+                 "scope_attrs")
 
     def __init__(self, op, name, inputs=(), attrs=None,
                  shape=None, dtype=None):
@@ -167,6 +168,10 @@ class _Node:
         self.attrs = dict(attrs or {})    # static op params
         self._shape = shape               # variables only (user hint)
         self._dtype = dtype
+        # user attrs from `with mx.AttrScope(...)` (reference: kept in the
+        # same nnvm attr map; split here so op params stay clean)
+        from ..attribute import current_attrs
+        self.scope_attrs = current_attrs()
 
     @property
     def is_var(self):
@@ -192,6 +197,23 @@ class Symbol:
         if len(self._heads) > 1:
             return "group"
         return node.name
+
+    def attr(self, key):
+        """User attribute of this symbol's node (reference: Symbol.attr)."""
+        node, _ = self._heads[0]
+        return node.scope_attrs.get(key)
+
+    def list_attr(self):
+        node, _ = self._heads[0]
+        return dict(node.scope_attrs)
+
+    def attr_dict(self):
+        """name -> attrs for every node (reference: Symbol.attr_dict)."""
+        out = {}
+        for n in self._topo_nodes():
+            if n.scope_attrs:
+                out[n.name] = dict(n.scope_attrs)
+        return out
 
     def _topo_nodes(self):
         """Post-order DFS (the reference argument ordering)."""
@@ -409,6 +431,8 @@ class Symbol:
                 "attrs": {k: repr(v) for k, v in n.attrs.items()},
                 "inputs": [[index[id(src)], idx, 0] for src, idx in n.inputs],
                 **({"shape": list(n._shape)} if n._shape else {}),
+                **({"scope_attrs": dict(n.scope_attrs)}
+                   if n.scope_attrs else {}),
             })
         return json.dumps({
             "nodes": nodes,
@@ -544,6 +568,8 @@ def load_json(json_str):
         node = _Node(None if nd_["op"] == "null" else nd_["op"],
                      nd_["name"], attrs=attrs,
                      shape=tuple(nd_["shape"]) if nd_.get("shape") else None)
+        # restore the graph's own attrs; never the ambient AttrScope
+        node.scope_attrs = dict(nd_.get("scope_attrs", {}))
         node.inputs = [(nodes[i], oi) for i, oi, _ in nd_["inputs"]]
         nodes.append(node)
     return Symbol([(nodes[i], oi) for i, oi, _ in d["heads"]])

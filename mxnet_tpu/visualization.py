@@ -1,0 +1,92 @@
+"""Network visualization (reference: `python/mxnet/visualization.py` —
+print_summary tables and graphviz plot_network).
+
+`print_summary` walks a Symbol's DAG and prints the reference-style layer
+table (name, output shape, params, connections). `plot_network` emits a
+graphviz Digraph when the optional `graphviz` package is installed and
+raises a clear ImportError otherwise (it is not baked into this image).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer-by-layer summary table (reference: print_summary)."""
+    from .symbol import Symbol
+    if not isinstance(symbol, Symbol):
+        raise TypeError("print_summary expects a Symbol (use net(sym_var) "
+                        "or block.summary for gluon blocks)")
+    shapes = {}
+    if shape is not None:
+        try:
+            arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+            shapes = dict(zip(symbol.list_arguments(), arg_shapes))
+        except Exception:
+            shapes = {}
+
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def _row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos - 1].ljust(pos)
+        print(line)
+
+    print("=" * line_length)
+    _row(headers)
+    print("=" * line_length)
+
+    nodes = symbol._topo_nodes()
+    arg_names = set(symbol.list_arguments())
+    total_params = 0
+    for node in nodes:
+        if node.op is None:
+            continue  # variables are summarized with their consumer
+        ins = [inp.name for inp, _ in node.inputs]
+        param_ins = [shapes.get(n) for n in ins if n in arg_names
+                     and n != "data"]
+        n_params = sum(int(np.prod(s)) for s in param_ins if s)
+        total_params += n_params
+        out_shape = ""
+        _row([f"{node.name} ({node.op})", out_shape, n_params,
+              ", ".join(i for i in ins if i not in arg_names) or "-"])
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("=" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the Symbol DAG (reference: plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network needs the optional 'graphviz' package, which is "
+            "not installed in this environment; use print_summary instead"
+        ) from e
+    from .symbol import Symbol
+    if not isinstance(symbol, Symbol):
+        raise TypeError("plot_network expects a Symbol")
+    dot = Digraph(name=title, format=save_format)
+    arg_names = set(symbol.list_arguments())
+    for node in symbol._topo_nodes():
+        if node.op is None:
+            if hide_weights and node.name in arg_names and \
+                    node.name != "data":
+                continue
+            dot.node(node.name, node.name, shape="oval")
+        else:
+            dot.node(node.name, f"{node.name}\n{node.op}", shape="box")
+            for inp, _ in node.inputs:
+                if hide_weights and inp.op is None and \
+                        inp.name in arg_names and inp.name != "data":
+                    continue
+                dot.edge(inp.name, node.name)
+    return dot

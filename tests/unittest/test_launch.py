@@ -1,0 +1,42 @@
+"""Launcher tests (reference: tests/nightly dist launch via
+tools/launch.py --launcher local)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+def test_local_launch_spawns_all_ranks(tmp_path):
+    out_dir = str(tmp_path)
+    script = (
+        "import os,sys;"
+        "open(os.path.join(%r, os.environ['JAX_PROCESS_ID']), 'w')"
+        ".write(os.environ['JAX_NUM_PROCESSES'] + ' ' "
+        "+ os.environ['DMLC_WORKER_ID'])" % out_dir)
+    rc = subprocess.call([sys.executable, LAUNCH, "-n", "3",
+                          "--launcher", "local", sys.executable, "-c", script])
+    assert rc == 0
+    for rank in range(3):
+        content = open(os.path.join(out_dir, str(rank))).read().split()
+        assert content == ["3", str(rank)]
+
+
+def test_worker_failure_propagates():
+    rc = subprocess.call([sys.executable, LAUNCH, "-n", "2",
+                          "--launcher", "local", sys.executable, "-c",
+                          "import os,sys;"
+                          "sys.exit(int(os.environ['JAX_PROCESS_ID']))"])
+    assert rc == 1  # rank 1 exits non-zero
+
+
+def test_servers_flag_warns(capfd=None):
+    proc = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "1", "-s", "2", "--launcher", "local",
+         sys.executable, "-c", "pass"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "no parameter servers" in proc.stderr.lower() or \
+        "ignored" in proc.stderr.lower()

@@ -1,0 +1,99 @@
+"""AttrScope / visualization / LibSVMIter surface tests
+(reference: test_symbol.py attr tests, test_io.py LibSVMIter cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_attr_scope_stamps_symbols():
+    with mx.AttrScope(ctx_group="dev1", __shard__="tp"):
+        a = sym.var("a")
+        with mx.AttrScope(ctx_group="dev2"):
+            b = sym.var("b")
+    c = sym.var("c")
+    assert a.attr("ctx_group") == "dev1"
+    assert a.attr("__shard__") == "tp"
+    assert b.attr("ctx_group") == "dev2"
+    assert b.attr("__shard__") == "tp"  # inherited from outer scope
+    assert c.attr("ctx_group") is None
+    with pytest.raises(ValueError):
+        mx.AttrScope(bad=123)
+
+
+def test_attr_dict_covers_ops():
+    with mx.AttrScope(ctx_group="dev1"):
+        x = sym.var("x")
+        y = sym.FullyConnected(x, num_hidden=4, name="fc")
+    d = y.attr_dict()
+    assert d.get("fc", {}).get("ctx_group") == "dev1"
+
+
+def test_print_summary(capsys):
+    x = sym.var("data")
+    net = sym.FullyConnected(x, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    total = mx.visualization.print_summary(net, shape={"data": (1, 4)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out and "Total params" in out
+    # fc1: 4*8 weight + 8 bias; fc2: 8*2 + 2
+    assert total == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_plot_network_gated():
+    x = sym.var("data")
+    net = sym.FullyConnected(x, num_hidden=2, name="fc")
+    try:
+        import graphviz  # noqa: F401
+        g = mx.visualization.plot_network(net)
+        assert "fc" in g.source
+    except ImportError:
+        with pytest.raises(ImportError, match="graphviz"):
+            mx.visualization.plot_network(net)
+
+
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "data.libsvm"
+    path.write_text(
+        "1 0:1.5 3:2.0\n"
+        "0 1:1.0\n"
+        "2 0:0.5 2:3.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(5,),
+                          batch_size=2)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    dense = b1.data[0].asnumpy()
+    np.testing.assert_allclose(dense[0], [1.5, 0, 0, 2.0, 0])
+    np.testing.assert_allclose(dense[1], [0, 1.0, 0, 0, 0])
+    np.testing.assert_array_equal(b1.label[0].asnumpy(), [1.0, 0.0])
+    b2 = it.next()
+    assert b2.pad == 1  # wrap-around
+    np.testing.assert_allclose(b2.data[0].asnumpy()[0],
+                               [0.5, 0, 3.0, 0, 1.0])
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+
+
+def test_attr_scope_survives_json_roundtrip():
+    with mx.AttrScope(ctx_group="dev1"):
+        x = sym.var("x")
+        y = sym.FullyConnected(x, num_hidden=4, name="fc")
+    with mx.AttrScope(ctx_group="dev9"):  # ambient scope must NOT leak in
+        z = sym.load_json(y.tojson())
+    d = z.attr_dict()
+    assert d.get("fc", {}).get("ctx_group") == "dev1"
+    assert d.get("x", {}).get("ctx_group") == "dev1"
+
+
+def test_libsvm_tiny_dataset_padding(tmp_path):
+    path = tmp_path / "tiny.libsvm"
+    path.write_text("1 0:1.0\n0 2:2.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(4,),
+                          batch_size=8)
+    b = it.next()
+    assert b.data[0].shape == (8, 4)
+    assert b.pad == 6

@@ -1,0 +1,121 @@
+"""Native C++ data pipeline tests (reference: tests for
+src/io/iter_image_recordio_2.cc via test_io.py ImageRecordIter cases)."""
+import io as _io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import ImageRecordIter, native
+from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native pipeline not built")
+
+
+def _jpeg_bytes(arr):
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def _make_rec(tmp_path, n=10, h=24, w=24, seed=0):
+    rng = np.random.RandomState(seed)
+    prefix = str(tmp_path / "data")
+    rec = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    images = []
+    for i in range(n):
+        arr = rng.randint(0, 255, (h, w, 3), np.uint8)
+        images.append(arr)
+        rec.write_idx(i, pack(IRHeader(0, float(i % 3), i, 0),
+                              _jpeg_bytes(arr)))
+    rec.close()
+    return prefix, images
+
+
+def test_native_matches_python_fallback(tmp_path):
+    prefix, _ = _make_rec(tmp_path, n=8, h=24, w=24)
+    kw = dict(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+              batch_size=4, mean_r=10.0, mean_g=20.0, mean_b=30.0,
+              std_r=2.0, std_g=3.0, std_b=4.0)
+    it_native = ImageRecordIter(use_native=True, **kw)
+    it_py = ImageRecordIter(use_native=False, **kw)
+    assert it_native._native is not None
+    assert it_py._native is None
+    for _ in range(2):
+        b_n = it_native.next()
+        b_p = it_py.next()
+        # identical decode (both libjpeg) + identical normalize, no resize
+        np.testing.assert_allclose(b_n.data[0].asnumpy(),
+                                   b_p.data[0].asnumpy(), atol=1e-4)
+        np.testing.assert_array_equal(b_n.label[0].asnumpy(),
+                                      b_p.label[0].asnumpy())
+
+
+def test_native_epoch_iteration_and_reset(tmp_path):
+    prefix, _ = _make_rec(tmp_path, n=10)
+    it = ImageRecordIter(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+                         batch_size=4, use_native=True)
+    batches = list(it)
+    assert len(batches) == 3  # ceil(10/4)
+    assert batches[-1].pad == 2
+    it.reset()
+    batches2 = list(it)
+    assert len(batches2) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               batches2[0].data[0].asnumpy())
+
+
+def test_native_shuffle_changes_order(tmp_path):
+    prefix, _ = _make_rec(tmp_path, n=16)
+    it = ImageRecordIter(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+                         batch_size=16, use_native=True, shuffle=True, seed=7)
+    labels1 = it.next().label[0].asnumpy().copy()
+    it.reset()
+    labels2 = it.next().label[0].asnumpy().copy()
+    # same multiset of samples, epoch-dependent order
+    np.testing.assert_array_equal(np.sort(labels1), np.sort(labels2))
+    assert not np.array_equal(labels1, labels2)
+
+
+def test_native_resize_small_images(tmp_path):
+    # images smaller than the crop window go through the C++ bilinear resize
+    prefix, _ = _make_rec(tmp_path, n=4, h=16, w=16)
+    it = ImageRecordIter(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+                         batch_size=4, use_native=True)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 24, 24)
+    assert it._native.decode_failures == 0
+    # resized content is non-degenerate
+    assert float(b.data[0].asnumpy().std()) > 1.0
+
+
+def test_native_rand_crop_mirror_shapes(tmp_path):
+    prefix, _ = _make_rec(tmp_path, n=6, h=32, w=32)
+    it = ImageRecordIter(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+                         batch_size=6, use_native=True, rand_crop=True,
+                         rand_mirror=True, seed=3)
+    b = it.next()
+    assert b.data[0].shape == (6, 3, 24, 24)
+    assert it._native.decode_failures == 0
+
+
+def test_npy_payload_falls_back_to_python(tmp_path):
+    prefix = str(tmp_path / "npy")
+    rec = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        buf = _io.BytesIO()
+        np.save(buf, rng.randint(0, 255, (24, 24, 3), np.uint8),
+                allow_pickle=False)
+        rec.write_idx(i, pack(IRHeader(0, float(i), i, 0), buf.getvalue()))
+    rec.close()
+    it = ImageRecordIter(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+                         batch_size=2)
+    assert it._native is None  # sniffed non-JPEG payload
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 24, 24)

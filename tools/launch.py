@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: `tools/launch.py` +
+`3rdparty/dmlc-core/tracker/` ssh/local launchers).
+
+The reference spawns scheduler + server + worker processes and wires them
+with DMLC_* env vars for the ps-lite transport. The TPU-native cluster model
+is SPMD under a single controller per host: every process runs the SAME
+training script, jax.distributed connects them through a coordinator, and
+XLA collectives replace the parameter server. So this launcher:
+
+  * spawns `-n` worker processes (locally or over ssh to `-H` hosts),
+  * wires them with JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID (read by `jax.distributed.initialize()` and by
+    `mxnet_tpu.parallel.init_distributed()`),
+  * also exports the DMLC_* names so reference scripts that inspect
+    `kv.rank` / `kv.num_workers` keep working.
+
+`-s` (servers) is accepted and ignored with a warning: there are no
+parameter servers on TPU (SURVEY.md §2.5).
+
+Usage:
+  python tools/launch.py -n 4 --launcher local python train.py
+  python tools/launch.py -n 2 -H hosts.txt --launcher ssh python train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def build_env(rank, num_workers, coordinator):
+    if ":" not in coordinator:
+        coordinator = coordinator + ":9876"  # default coordination port
+    env = dict(os.environ)
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(num_workers),
+        "JAX_PROCESS_ID": str(rank),
+        # reference-compat names (read by kvstore facade / user scripts)
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(num_workers),
+        "DMLC_NUM_SERVER": "0",
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_PS_ROOT_URI": coordinator.split(":")[0],
+        "DMLC_PS_ROOT_PORT": coordinator.split(":")[1],
+    })
+    return env
+
+
+def launch_local(num_workers, command, coordinator):
+    procs = []
+    for rank in range(num_workers):
+        env = build_env(rank, num_workers, coordinator)
+        procs.append(subprocess.Popen(command, env=env))
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    codes = [p.wait() for p in procs]
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        for i, c in bad:
+            print(f"worker {i} exited with code {c}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def launch_ssh(hosts, num_workers, command, coordinator, username=None):
+    procs = []
+    for rank in range(num_workers):
+        host = hosts[rank % len(hosts)]
+        target = f"{username}@{host}" if username else host
+        env = build_env(rank, num_workers, coordinator)
+        exports = " ".join(
+            f"{k}={v!r}" for k, v in env.items()
+            if k.startswith(("JAX_", "DMLC_")))
+        remote_cmd = f"cd {os.getcwd()!r} && env {exports} " + \
+            " ".join(command)
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))
+    codes = [p.wait() for p in procs]
+    return 1 if any(codes) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-s", "--num-servers", type=int, default=0,
+                   help="ignored: no parameter servers on TPU")
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="file with one host per line (ssh launcher)")
+    p.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    p.add_argument("--coordinator", default="127.0.0.1:9876",
+                   help="host:port for jax.distributed coordination")
+    p.add_argument("--username", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    if not args.command:
+        p.error("no command given")
+    if args.num_servers:
+        print("warning: -s/--num-servers ignored — TPU SPMD has no "
+              "parameter servers; gradients reduce via XLA collectives",
+              file=sys.stderr)
+
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            p.error("ssh launcher needs -H hostfile")
+        with open(args.hostfile) as f:
+            hosts = [line.strip() for line in f if line.strip()]
+        return launch_ssh(hosts, args.num_workers, args.command,
+                          args.coordinator, args.username)
+    return launch_local(args.num_workers, args.command, args.coordinator)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
