@@ -316,11 +316,16 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    # Mixed-precision norm: f32 statistics, but the output stays in the
+    # input dtype even when gamma/beta are f32 masters — otherwise one
+    # norm silently promotes every downstream matmul to f32 (half MXU
+    # rate, double HBM traffic).
     x32 = data.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axis, keepdims=True)
     var = jnp.var(x32, axis=axis, keepdims=True)
     out = (x32 - mean) * lax.rsqrt(var + eps)
-    out = out.astype(data.dtype) * gamma + beta
+    out = out.astype(data.dtype) * gamma.astype(data.dtype) \
+        + beta.astype(data.dtype)
     if output_mean_var:
         return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
     return out
@@ -337,7 +342,8 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     x = (x - mean) * lax.rsqrt(var + eps)
     x = x.reshape(data.shape).astype(data.dtype)
     bshape = (1, C) + (1,) * len(rest)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    return x * gamma.reshape(bshape).astype(data.dtype) \
+        + beta.reshape(bshape).astype(data.dtype)
 
 
 @register("InstanceNorm")
@@ -347,7 +353,8 @@ def instance_norm(data, gamma, beta, eps=1e-3):
     var = jnp.var(data, axis=axes, keepdims=True)
     x = (data - mean) * lax.rsqrt(var + eps)
     bshape = (1, -1) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+    return x * gamma.reshape(bshape).astype(data.dtype) \
+        + beta.reshape(bshape).astype(data.dtype)
 
 
 @register("L2Normalization")
