@@ -7,6 +7,7 @@ from . import data
 from . import utils
 from .trainer import Trainer
 from . import rnn
+from . import model_zoo
 
 __all__ = ["Parameter", "ParameterDict", "Constant", "Block", "HybridBlock",
            "Sequential", "HybridSequential", "nn", "loss", "data", "utils",

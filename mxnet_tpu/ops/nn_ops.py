@@ -150,6 +150,7 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 def activation(data, act_type="relu"):
     return {
         "relu": jax.nn.relu,
+        "relu6": jax.nn.relu6,
         "sigmoid": jax.nn.sigmoid,
         "tanh": jnp.tanh,
         "softrelu": jax.nn.softplus,

@@ -1,0 +1,51 @@
+"""gluon.model_zoo tests (reference: tests/python/unittest/test_gluon_model_zoo.py
+— instantiate each zoo model, run a forward pass, check output shape)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import get_model, vision
+
+
+@pytest.mark.parametrize("name,size", [
+    ("alexnet", 224),
+    ("vgg11", 32),            # small spatial keeps CPU tests fast
+    ("vgg11_bn", 32),
+    ("squeezenet1.0", 224),
+    ("squeezenet1.1", 224),
+    ("mobilenet0.25", 224),
+    ("mobilenetv2_0.5", 224),
+    ("resnet18_v1", 32),
+])
+def test_zoo_forward_shapes(name, size):
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0)
+                 .randn(2, 3, size, size).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("resnext500")
+
+
+def test_pretrained_missing_weights_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="pretrained weights"):
+        vision.mobilenet0_25(pretrained=True, root=str(tmp_path))
+
+
+def test_zoo_model_save_load_roundtrip(tmp_path):
+    net = get_model("mobilenet0.25", classes=4)
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randn(1, 3, 64, 64)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "m.params")
+    net.save_parameters(path)
+    net2 = get_model("mobilenet0.25", classes=4)
+    net2.load_parameters(path)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5, atol=1e-5)
