@@ -276,6 +276,8 @@ def _make_mobilenet(multiplier, v2=False):
                 f"mobilenet{multiplier}"
             _load_pretrained(net, tag, root)
         return net
+    factory.__name__ = (f"mobilenet_v2_{multiplier}" if v2
+                        else f"mobilenet_{multiplier}").replace(".", "_")
     return factory
 
 

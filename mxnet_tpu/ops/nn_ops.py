@@ -48,6 +48,8 @@ def _pair(v, n=2):
 def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False, layout=None):
     n = data.ndim - 2
+    if data.dtype != weight.dtype:
+        data = data.astype(weight.dtype)  # follow the layer's declared dtype
     stride = _pair(stride or 1, n)
     dilate = _pair(dilate or 1, n)
     pad = _pair(pad or 0, n)
@@ -55,6 +57,9 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         data.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if n == 2 else ("NCW", "OIW", "NCW") if n == 1
         else ("NCDHW", "OIDHW", "NCDHW"))
+    # No preferred_element_type here: f32 output from bf16 inputs breaks
+    # jax's conv transpose-rhs rule (mixed-dtype conv in backward), and the
+    # MXU already accumulates bf16 convs in f32 internally.
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -62,10 +67,7 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out

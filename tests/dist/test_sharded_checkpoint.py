@@ -1,0 +1,69 @@
+"""Sharded checkpoint/resume on the virtual mesh (reference: §5.4 —
+Module.save_checkpoint + optimizer states; here orbax sharded state)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon import nn, loss as gloss
+
+pytest.importorskip("orbax.checkpoint")
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _make_trainer(seed=0, mode="replicate"):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=8))
+    net.add(nn.Dense(4, in_units=32))
+    net.initialize()
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: lfn(o, l), "adam", {"learning_rate": 1e-3},
+        param_mode=mode)
+
+
+def test_save_restore_resumes_identically(tmp_path):
+    parallel.make_mesh(dp=4, fsdp=2)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(16, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 16).astype(np.float32))
+
+    tr = _make_trainer(seed=0)
+    for _ in range(3):
+        tr.step([x], [y])
+    tr.save_states(tmp_path / "ckpt")
+    loss_next = float(tr.step([x], [y]).asscalar())
+
+    # fresh trainer, different init → restore → must continue identically
+    tr2 = _make_trainer(seed=123)
+    tr2.step([x], [y])  # build step fn + state structure
+    tr2.load_states(tmp_path / "ckpt")
+    assert tr2.num_update == 3
+    loss_next2 = float(tr2.step([x], [y]).asscalar())
+    np.testing.assert_allclose(loss_next2, loss_next, rtol=1e-5)
+
+
+def test_save_restore_across_param_modes(tmp_path):
+    """Resharding: checkpoint written replicated restores onto fsdp."""
+    parallel.make_mesh(dp=-1)
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(16, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 16).astype(np.float32))
+    tr = _make_trainer(seed=0, mode="replicate")
+    tr.step([x], [y])
+    tr.save_states(tmp_path / "ck2")
+
+    parallel.make_mesh(dp=4, fsdp=2)
+    tr2 = _make_trainer(seed=5, mode="fsdp")
+    tr2.step([x], [y])
+    tr2.load_states(tmp_path / "ck2")
+    # params equal after restore despite different sharding layout
+    p0 = np.asarray(tr.params[0])
+    p1 = np.asarray(tr2.params[0])
+    np.testing.assert_allclose(p0, p1, rtol=1e-6)

@@ -38,8 +38,9 @@ def test_mlp_trains_to_high_accuracy():
     trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 5e-3})
     lfn = gloss.SoftmaxCrossEntropyLoss()
     batch = 64
+    shuffle_rng = np.random.RandomState(7)
     for epoch in range(15):
-        perm = np.random.permutation(len(x))
+        perm = shuffle_rng.permutation(len(x))
         for i in range(0, len(x), batch):
             idx = perm[i:i + batch]
             data, label = nd.array(x[idx]), nd.array(y[idx])
