@@ -1,0 +1,5 @@
+"""gluon.contrib (reference: `python/mxnet/gluon/contrib/`)."""
+from . import nn
+from . import rnn
+
+__all__ = ["nn", "rnn"]

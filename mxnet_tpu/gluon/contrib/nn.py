@@ -1,0 +1,108 @@
+"""gluon.contrib.nn (reference: `python/mxnet/gluon/contrib/nn/basic_layers.py`).
+
+Concurrent/HybridConcurrent (parallel branches concatenated), Identity,
+SparseEmbedding (row_sparse-gradient embedding), SyncBatchNorm (on TPU a
+mesh-wide BatchNorm: inside a jitted sharded step XLA computes the batch
+statistics with a psum over the data axis, so plain BatchNorm already IS
+sync — kept as a named subclass for API parity), PixelShuffle1D/2D/3D.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ndarray import NDArray
+from .. import nn as _nn
+from ..block import HybridBlock, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Run children on the same input, concat outputs along `axis`."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import nd
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding whose gradient is row_sparse (reference: contrib
+    SparseEmbedding with sparse_grad=True). The lazy sparse optimizer
+    paths (`mxnet_tpu.optimizer` SGD/Adam row_sparse branches) then touch
+    only the rows present in the batch."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype, **kwargs)
+        self._sparse_grad = True
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device BatchNorm. Under a jitted sharded step, the batch axis
+    is sharded over the mesh and XLA inserts the cross-replica reduction
+    for the mean/var computation automatically — matching the reference's
+    NCCL-based SyncBatchNorm without a dedicated kernel. `num_devices` is
+    accepted for API parity and ignored."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+def _pixel_shuffle(data, factors, ndim):
+    x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    N, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    f = factors if isinstance(factors, (list, tuple)) else (factors,) * ndim
+    new_c = C // int(jnp.prod(jnp.asarray(f)))
+    # (N, C', f1..fn, d1..dn) -> interleave factor dims after each spatial
+    x = x.reshape((N, new_c) + tuple(f) + spatial)
+    perm = [0, 1]
+    for i in range(ndim):
+        perm += [2 + ndim + i, 2 + i]
+    x = x.transpose(perm)
+    out_spatial = tuple(d * fi for d, fi in zip(spatial, f))
+    out = x.reshape((N, new_c) + out_spatial)
+    return NDArray(out) if isinstance(data, NDArray) else out
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = factor
+        self._ndim = ndim
+
+    def forward(self, x):
+        return _pixel_shuffle(x, self._factor, self._ndim)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

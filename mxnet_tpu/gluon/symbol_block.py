@@ -1,0 +1,81 @@
+"""SymbolBlock: run a symbolic graph as a gluon Block (reference:
+`python/mxnet/gluon/block.py` SymbolBlock — the bridge that loads
+`net.export()`ed symbol-JSON + params back into the imperative API).
+"""
+from __future__ import annotations
+
+from .. import ndarray as nd_mod
+from ..ndarray import NDArray
+from .block import HybridBlock
+from .parameter import Parameter
+
+__all__ = ["SymbolBlock"]
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap `outputs` (a Symbol) with free `inputs` (list of Symbols made by
+    `sym.var`) into a callable Block whose non-input arguments become
+    gluon Parameters."""
+
+    def __init__(self, outputs, inputs, params=None, **kwargs):
+        super().__init__(**kwargs)
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs) if hasattr(sym_mod, "Group") \
+                else outputs[0]
+        self._symbol = outputs
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._input_names = [i.name if hasattr(i, "name") else str(i)
+                             for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states() \
+            if hasattr(outputs, "list_auxiliary_states") else []
+        self._param_names = [n for n in arg_names
+                             if n not in self._input_names]
+        self._aux_names = list(aux_names)
+        params = params or {}
+        self._reg_name_map = {}
+        for name in self._param_names + self._aux_names:
+            src = params.get(name)
+            p = Parameter(name, shape=getattr(src, "shape", None),
+                          allow_deferred_init=True)
+            if src is not None:
+                p.set_data(src if isinstance(src, NDArray) else NDArray(src))
+            # attribute name must be attribute-safe
+            safe = name.replace(".", "_").replace(":", "_")
+            setattr(self, safe, p)
+            self._reg_name_map[name] = safe
+
+    @classmethod
+    def imports(cls, symbol_file, input_names, param_file=None, ctx=None):
+        """Load an exported model: symbol JSON + optional .params
+        (reference: SymbolBlock.imports)."""
+        from .. import symbol as sym_mod
+        outputs = sym_mod.load(symbol_file)
+        input_names = input_names if isinstance(input_names, (list, tuple)) \
+            else [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        params = {}
+        if param_file:
+            loaded = nd_mod.load(param_file)
+            for k, v in loaded.items():
+                params[k.split(":", 1)[-1]] = v  # strip arg:/aux: prefixes
+        return cls(outputs, inputs, params=params)
+
+    def forward(self, *args):
+        values = {}
+        for name, arr in zip(self._input_names, args):
+            values[name] = arr if isinstance(arr, NDArray) else NDArray(arr)
+        for name in self._param_names + self._aux_names:
+            p = getattr(self, self._reg_name_map[name])
+            values[name] = p.data()
+        from ..symbol.executor import _eval_graph
+        from .. import _engine
+        outs, aux_updates = _eval_graph(
+            self._symbol, {k: v._data for k, v in values.items()},
+            _engine.is_training())
+        for name, val in aux_updates.items():
+            if name in self._reg_name_map:
+                getattr(self, self._reg_name_map[name]).data()._data = val
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
