@@ -70,6 +70,7 @@ class Embedding(HybridBlock):
         self._output_dim = output_dim
         self.weight = Parameter("weight", shape=(input_dim, output_dim),
                                 dtype=dtype, init=weight_initializer)
+        self.weight.shard_hint = "embedding"
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,

@@ -34,6 +34,10 @@ class Parameter:
         self._data = None            # NDArray once initialized
         self._init_requested = None  # (initializer,) once initialize() called
         self._sharding = None        # optional jax NamedSharding / PartitionSpec
+        self.shard_hint = None       # e.g. 'embedding': looked up by gather —
+        #                              auto-sharding policies must keep dim 0
+        #                              (the indexed dim) unsharded or GSPMD
+        #                              falls back to full rematerialization
         self.wd_mult = 1.0
         self.lr_mult = 1.0
 

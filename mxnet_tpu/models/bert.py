@@ -105,6 +105,8 @@ class BERTModel(HybridBlock):
                                              weight_initializer="xavier")
         self.position_embed = Parameter("position_weight", shape=(max_length, units),
                                         dtype=dtype, init="xavier")
+        # sliced [:L] along dim 0 each step — keep that dim unsharded
+        self.position_embed.shard_hint = "embedding"
         self.embed_ln = nn.LayerNorm(in_channels=units)
         self.embed_dropout = nn.Dropout(dropout) if dropout else None
         self.layers = nn.HybridSequential()
@@ -136,6 +138,13 @@ class BERTModel(HybridBlock):
             mask = NDArray(jnp.arange(L)[None, :] < vl[:, None].astype(jnp.int32))
         for layer in self.layers:
             x = layer(x, mask)
+        # pin the encoder output (and via transpose its cotangent) to batch
+        # sharding: the MLM gather and pooler-slice backward paths otherwise
+        # propagate conflicting feature shardings from fsdp-sharded head
+        # weights onto d(seq), which GSPMD resolves by full remat
+        from ..ndarray import apply_op
+        from ..parallel import specs as _specs
+        x = apply_op(_specs.constrain_batch, x)
         pooled = self.pooler(F.slice_axis(x, axis=1, begin=0, end=1).squeeze(axis=1))
         return x, pooled
 
@@ -161,10 +170,15 @@ class BERTForPretraining(HybridBlock):
         """Returns (mlm_scores (B,P,V), nsp_scores (B,2))."""
         import jax.numpy as jnp
         from ..ndarray import apply_op
+        from ..parallel import specs as _specs
         seq, pooled = self.bert(inputs, token_types, valid_length)
-        # gather masked positions before the vocab matmul: (B, P, E)
+        # gather masked positions before the vocab matmul: (B, P, E).
+        # constrain_batch pins the gather output (and, via transpose, the
+        # scatter cotangent into seq) to batch sharding so fsdp weight
+        # shardings downstream can't force a GSPMD full-remat reshard.
         gathered = apply_op(
-            lambda s, p: jnp.take_along_axis(s, p.astype(jnp.int32)[..., None], 1),
+            lambda s, p: _specs.constrain_batch(
+                jnp.take_along_axis(s, p.astype(jnp.int32)[..., None], 1)),
             seq, masked_positions)
         h = self.mlm_transform(gathered)
         h = F.Activation(h, act_type="gelu")
@@ -201,7 +215,10 @@ def bert_pretrain_loss(mlm_scores, nsp_scores, mlm_labels, mlm_weights, nsp_labe
 def tp_rules(tp_axis="tp"):
     """Megatron sharding for BERT params (apply via parallel.apply_tp_rules):
     QKV and FFN-in split over heads/hidden (dim 0 of (out,in) weights),
-    proj and FFN-out split on input dim; embeddings sharded over vocab."""
+    proj and FFN-out split on input dim; word embedding split on the FEATURE
+    dim (not vocab: a vocab-sharded gather forces GSPMD full
+    rematerialization; feature sharding partitions the gather trivially and
+    the tied MLM decoder contracts over the sharded dim with a psum)."""
     from jax.sharding import PartitionSpec as P
     return [
         (r"\.qkv\.weight$", P(tp_axis, None)),
@@ -210,7 +227,7 @@ def tp_rules(tp_axis="tp"):
         (r"\.ffn_in\.bias$", P(tp_axis)),
         (r"\.proj\.weight$", P(None, tp_axis)),
         (r"\.ffn_out\.weight$", P(None, tp_axis)),
-        (r"word_embed\.weight$", P(tp_axis, None)),
+        (r"word_embed\.weight$", P(None, tp_axis)),
     ]
 
 

@@ -6,13 +6,15 @@ GSPMD sharding annotations replace the reference's per-tensor kvstore traffic
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .mesh import current_mesh
 
 __all__ = ["param_spec", "batch_spec", "replicated", "fsdp_spec",
-           "apply_tp_rules", "DATA_AXES"]
+           "apply_tp_rules", "constrain_batch", "DATA_AXES"]
 
 # both dp and fsdp are "data" axes from the batch's point of view
 DATA_AXES = ("dp", "fsdp")
@@ -34,20 +36,63 @@ def batch_spec(ndim, mesh=None, extra=None):
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
-def fsdp_spec(shape, mesh=None):
+# Only shard params with at least this many elements over fsdp (reference:
+# MXNET_KVSTORE_BIGARRAY_BOUND — small arrays are not worth distributing).
+# Small 1D params (LayerNorm gamma/beta, biases) otherwise force a constant
+# stream of GSPMD reshards around their broadcasts/reductions.
+FSDP_MIN_SIZE = int(os.environ.get("MXNET_TPU_FSDP_MIN_SIZE", 1024))
+
+
+def fsdp_spec(shape, mesh=None, hint=None):
     """ZeRO-style: shard the largest divisible dim over 'fsdp' (TPU analog of
-    the reference's big-array round-robin across PS servers)."""
+    the reference's big-array round-robin across PS servers). Arrays smaller
+    than FSDP_MIN_SIZE elements stay replicated.
+
+    hint='embedding' (gather tables): replicate. GSPMD cannot partition a
+    gather over the indexed dim (vocab-sharded → involuntary full
+    rematerialization of the table), and feature-dim sharding forces the
+    scatter-grad to reshard batch-sharded (B,L,E) updates onto the feature
+    axis — another involuntary-remat pattern. Replication costs a little
+    ZeRO memory on one table; explicit tp rules (e.g. BERT's feature-dim
+    vocab projection sharding) still apply via set_sharding."""
     mesh = mesh or current_mesh()
     size = mesh.shape.get("fsdp", 1)
     if size <= 1 or not shape:
         return replicated(mesh)
-    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    if hint == "embedding" or int(np.prod(shape)) < FSDP_MIN_SIZE:
+        return replicated(mesh)
+    if len(shape) == 2:
+        # (out, in) Dense weights: prefer the contraction (input) dim — the
+        # partitioned matmul then psums partial products and activations
+        # stay batch-sharded. Output-dim sharding pushes feature shardings
+        # onto activations, which GSPMD can only undo next to a gather by
+        # involuntary full rematerialization.
+        order = [1, 0]
+    else:
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
     for dim in order:
         if shape[dim] % size == 0 and shape[dim] >= size:
             spec = [None] * len(shape)
             spec[dim] = "fsdp"
             return NamedSharding(mesh, PartitionSpec(*spec))
     return replicated(mesh)
+
+
+def constrain_batch(x, mesh=None):
+    """Pin an activation (jax array) to batch sharding over the data axes.
+
+    Use after ops whose transpose is a scatter (gather/take_along_axis):
+    without the pin, sharding propagation from a downstream fsdp-sharded
+    weight can make the scatter's updates feature-sharded, which GSPMD can
+    only reach from batch-sharded via involuntary full rematerialization.
+    `with_sharding_constraint` transposes to itself, so the pin holds for
+    the cotangent too. No-op when no data axis is sharded."""
+    import jax
+
+    mesh = mesh or current_mesh()
+    if all(mesh.shape.get(a, 1) <= 1 for a in DATA_AXES):
+        return x
+    return jax.lax.with_sharding_constraint(x, batch_spec(x.ndim, mesh))
 
 
 def param_spec(param, mesh=None, mode="replicate"):
@@ -59,7 +104,7 @@ def param_spec(param, mesh=None, mode="replicate"):
             return NamedSharding(mesh, s)
         return s
     if mode == "fsdp":
-        return fsdp_spec(param.shape, mesh)
+        return fsdp_spec(param.shape, mesh, getattr(param, "shard_hint", None))
     return replicated(mesh)
 
 

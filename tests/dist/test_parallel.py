@@ -81,8 +81,10 @@ def test_sharded_trainer_dp_matches_single_device():
 
 def test_sharded_trainer_fsdp():
     parallel.make_mesh(dp=2, fsdp=4)
+    # hidden width large enough that the Dense weights clear FSDP_MIN_SIZE
+    # (the MXNET_KVSTORE_BIGARRAY_BOUND analog); its biases stay under it
     net = nn.HybridSequential()
-    net.add(nn.Dense(32, activation="relu", in_units=16), nn.Dense(8, in_units=32))
+    net.add(nn.Dense(128, activation="relu", in_units=16), nn.Dense(8, in_units=128))
     net.initialize()
     tr = parallel.ShardedTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                                  "adam", {"learning_rate": 0.01},
@@ -93,9 +95,11 @@ def test_sharded_trainer_fsdp():
     for _ in range(5):
         loss = tr.step(X, y)
     assert float(loss.asscalar()) < l0
-    # fsdp: at least one param actually sharded over the fsdp axis
-    shardings = [p.sharding.spec for p in tr.params]
-    assert any("fsdp" in str(s) for s in shardings)
+    # fsdp: big params sharded over the fsdp axis, small ones replicated
+    big = [p for p in tr.params if p.ndim == 2]
+    small = [p for p in tr.params if p.ndim == 1]
+    assert big and all("fsdp" in str(p.sharding.spec) for p in big)
+    assert small and all("fsdp" not in str(p.sharding.spec) for p in small)
 
 
 def test_sharded_trainer_lamb_and_scheduler():
