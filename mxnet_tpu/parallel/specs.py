@@ -86,11 +86,17 @@ def constrain_batch(x, mesh=None):
     weight can make the scatter's updates feature-sharded, which GSPMD can
     only reach from batch-sharded via involuntary full rematerialization.
     `with_sharding_constraint` transposes to itself, so the pin holds for
-    the cotangent too. No-op when no data axis is sharded."""
+    the cotangent too. No-op when no data axis is sharded, or when the
+    batch dim isn't divisible by the sharded data-axis product (e.g. eager
+    small-batch inference with a big mesh active)."""
     import jax
 
     mesh = mesh or current_mesh()
-    if all(mesh.shape.get(a, 1) <= 1 for a in DATA_AXES):
+    sharded = [a for a in DATA_AXES if mesh.shape.get(a, 1) > 1]
+    if not sharded:
+        return x
+    total = int(np.prod([mesh.shape[a] for a in sharded]))
+    if x.ndim == 0 or x.shape[0] % total != 0:
         return x
     return jax.lax.with_sharding_constraint(x, batch_spec(x.ndim, mesh))
 
