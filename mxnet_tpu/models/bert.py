@@ -62,7 +62,8 @@ class BERTAttention(HybridBlock):
     def forward(self, x, mask=None):
         # x: (B, L, E); mask: (B, L) 1=valid
         qkv = self.qkv(x)  # (B, L, 3E)
-        out = F.fused_self_attention(qkv, mask, num_heads=self._num_heads)
+        out = F.fused_self_attention(qkv, mask, num_heads=self._num_heads,
+                                     dropout=self._dropout)
         return self.proj(out)
 
 

@@ -53,4 +53,5 @@ from . import control_flow  # noqa: E402,F401  (foreach/while_loop/cond)
 
 RNG_OPS.update(name for name in OPS
                if name.startswith("_random_") or name.startswith("_sample_"))
-RNG_OPS.update({"Dropout", "shuffle", "RNN"})
+RNG_OPS.update({"Dropout", "shuffle", "RNN",
+                "flash_attention", "fused_self_attention"})

@@ -421,19 +421,27 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
 
 
 @register("flash_attention")
-def flash_attention_op(q, k, v, mask=None, causal=False, sm_scale=None):
+def flash_attention_op(q, k, v, mask=None, causal=False, sm_scale=None,
+                       dropout=0.0, _training=None):
     """Fused attention on (B, H, L, D); Pallas kernel on TPU, XLA fallback on
-    CPU meshes. mask: (B, Lk) padding mask, True = attendable."""
+    CPU meshes. mask: (B, Lk) padding mask, True = attendable. dropout is
+    attention-probability dropout, active only in training mode (reference:
+    the dropout_ratio of `_contrib_interleaved_matmul_selfatt_*` consumers)."""
+    from .. import _engine
     from ..pallas_ops import flash_attention
-    return flash_attention(q, k, v, mask=mask, causal=causal, sm_scale=sm_scale)
+    training = _engine.is_training() if _training is None else _training
+    key = _random.next_key() if (dropout > 0.0 and training) else None
+    return flash_attention(q, k, v, mask=mask, causal=causal,
+                           sm_scale=sm_scale, dropout=dropout,
+                           dropout_key=key)
 
 
 @register("fused_self_attention")
-def fused_self_attention(qkv, mask=None, num_heads=1, causal=False):
+def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
+                         dropout=0.0, _training=None):
     """Self-attention from a fused QKV projection (B, L, 3E) → (B, L, E).
     The model-facing fused path (replaces the reference's interleaved-matmul
     attention ops for new code)."""
-    from ..pallas_ops import flash_attention
     B, L, E3 = qkv.shape
     H = num_heads
     D = E3 // 3 // H
@@ -441,5 +449,6 @@ def fused_self_attention(qkv, mask=None, num_heads=1, causal=False):
     q = x[:, :, 0].transpose(0, 2, 1, 3)
     k = x[:, :, 1].transpose(0, 2, 1, 3)
     v = x[:, :, 2].transpose(0, 2, 1, 3)
-    out = flash_attention(q, k, v, mask=mask, causal=causal)
+    out = flash_attention_op(q, k, v, mask=mask, causal=causal,
+                             dropout=dropout, _training=_training)
     return out.transpose(0, 2, 1, 3).reshape(B, L, H * D)

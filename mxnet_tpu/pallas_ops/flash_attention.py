@@ -25,14 +25,21 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 _NEG = -1e30
 
 
-def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
-    """Pure-XLA multi-head attention. q,k,v: (B, H, L, D); bias: (B, 1|H, 1|Lq, Lk)."""
+def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
+                  dropout=0.0, dropout_key=None):
+    """Pure-XLA multi-head attention. q,k,v: (B, H, L, D); bias: (B, 1|H, 1|Lq, Lk).
+
+    dropout is applied to the attention probabilities (inverted scaling),
+    matching the reference's attention-dropout in
+    `src/operator/contrib/transformer.cc` consumers (gluonnlp BERT)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * sm_scale
@@ -44,6 +51,9 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         col = jnp.arange(Lk)[None, :]
         s = jnp.where(col <= row, s, _NEG)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), jnp.zeros((), p.dtype))
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -79,12 +89,27 @@ def _score_block(q32, k32, bias_row, qi, kb, causal, causal_off, block_q,
     return s
 
 
+def _keep_tile(seed_ref, b, qi, kb, num_qb, num_kb, block_q, block_k, dropout):
+    """Attention-dropout keep mask for score tile (b, qi, kb).
+
+    The per-core PRNG is re-seeded from (step seed, flat tile id) before
+    every tile, so the forward, dq, and dkv kernels regenerate bit-identical
+    masks regardless of their different grid/loop iteration orders. Mosaic
+    caps prng_seed at two values, hence the flat id."""
+    tile = (b * num_qb + qi) * num_kb + kb
+    pltpu.prng_seed(seed_ref[0], tile)
+    bits = pltpu.bitcast(pltpu.prng_random_bits((block_q, block_k)),
+                         jnp.uint32)
+    cutoff = np.uint32(min(int(round(dropout * 2.0 ** 32)), 0xFFFFFFFF))
+    return bits >= cutoff
+
+
 # --------------------------------------------------------------------------
 # pallas forward (emits out + row LSE)
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                sm_scale, causal, block_q, block_k, kv_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_q, block_k, kv_len, dropout):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                     # (block_q, D)
     num_kb = kv_len // block_k
@@ -111,7 +136,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # l (the softmax denominator) sums the UNDROPPED p; dropout only
+        # thins what reaches the value accumulation
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout > 0.0:
+            keep = _keep_tile(seed_ref, pl.program_id(0), qi, kb,
+                              pl.num_programs(1), num_kb, block_q, block_k,
+                              dropout)
+            p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
         acc = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return m_new, l, acc
@@ -129,7 +161,8 @@ def _row8(x):
     return jnp.broadcast_to(x[:, None, :], (x.shape[0], 8, x.shape[1]))
 
 
-def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
+def _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
+                      dropout):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     qr = q.reshape(B * H, Lq, D)
@@ -140,13 +173,14 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, kv_len=Lk),
+            block_q=block_q, block_k=block_k, kv_len=Lk, dropout=dropout),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, 8, Lk), lambda b, i, H=H: (b // H, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -158,7 +192,7 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-    )(qr, kr, vr, bias8)
+    )(qr, kr, vr, bias8, seed)
     return out.reshape(B, H, Lq, D), lse
 
 
@@ -167,7 +201,8 @@ def _flash_fwd_pallas(q, k, v, bias, causal, sm_scale, block_q, block_k):
 # --------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
-               dq_ref, *, sm_scale, causal, block_q, block_k, kv_len):
+               seed_ref, dq_ref, *, sm_scale, causal, block_q, block_k,
+               kv_len, dropout):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
@@ -195,6 +230,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_c)
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            keep = _keep_tile(seed_ref, pl.program_id(0), qi, kb,
+                              pl.num_programs(1), num_kb, block_q, block_k,
+                              dropout)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
         ds = p * (dp - delta_c) * sm_scale
         return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -203,8 +243,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
-                q_len, kv_len):
+                seed_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q,
+                block_k, q_len, kv_len, dropout):
     kb = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                       # (block_k, D)
     v = v_ref[0].astype(jnp.float32)
@@ -231,10 +271,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
         s = _score_block(q, k, bias_row, qi, kb, causal, causal_off,
                          block_q, block_k, sm_scale)
         p = jnp.exp(s - lse)                               # (bq, bk)
-        dv = dv + jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        pv = p
+        if dropout > 0.0:
+            keep = _keep_tile(seed_ref, pl.program_id(0), qi, kb,
+                              q_len // block_q, pl.num_programs(1),
+                              block_q, block_k, dropout)
+            inv = 1.0 / (1.0 - dropout)
+            pv = jnp.where(keep, p, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        dv = dv + jax.lax.dot_general(pv, g, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -245,8 +293,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal, sm_scale,
-                      block_q, block_k):
+def _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g, causal, sm_scale,
+                      block_q, block_k, dropout):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     qr = q.reshape(B * H, Lq, D)
@@ -261,9 +309,11 @@ def _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal, sm_scale,
     # lse already arrives in (BH, 8, Lq) carrier layout from the forward
 
     bias_spec = pl.BlockSpec((1, 8, Lk), lambda b, i, H=H: (b // H, 0, 0))
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=Lk),
+                          block_q=block_q, block_k=block_k, kv_len=Lk,
+                          dropout=dropout),
         grid=(B * H, Lq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -273,17 +323,18 @@ def _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal, sm_scale,
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
             pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+            seed_spec,
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-    )(qr, kr, vr, bias8, gr, lse, delta8)
+    )(qr, kr, vr, bias8, gr, lse, delta8, seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          q_len=Lq, kv_len=Lk),
+                          q_len=Lq, kv_len=Lk, dropout=dropout),
         grid=(B * H, Lk // block_k),
         in_specs=[
             pl.BlockSpec((1, Lq, D), lambda b, j: (b, 0, 0)),
@@ -293,6 +344,7 @@ def _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal, sm_scale,
             pl.BlockSpec((1, Lq, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, 8, Lq), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, 8, Lq), lambda b, j: (b, 0, 0)),
+            seed_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
@@ -304,7 +356,7 @@ def _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal, sm_scale,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-    )(qr, kr, vr, bias8, gr, lse, delta8)
+    )(qr, kr, vr, bias8, gr, lse, delta8, seed)
 
     return (dq.reshape(B, H, Lq, D), dk.reshape(B, H, Lk, D),
             dv.reshape(B, H, Lk, D))
@@ -339,32 +391,36 @@ def _flash_bwd_xla(q, k, v, bias, out, lse, g, causal, sm_scale):
 
 # Above this many kv positions the blockwise Pallas backward wins (memory
 # first, then bandwidth; measured 1.56x at L=4096 causal); below it XLA's
-# fused L×L backward is faster.
+# fused L×L backward is faster. With attention dropout the Pallas backward
+# is used at every length: only it can regenerate the kernel-PRNG masks.
 _PALLAS_BWD_MIN_LEN = 1024
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k):
-    out, _ = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale,
-                               block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, seed, causal, sm_scale, block_q, block_k, dropout):
+    out, _ = _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale,
+                               block_q, block_k, dropout)
     return out
 
 
-def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_fwd_pallas(q, k, v, bias, causal, sm_scale,
-                                 block_q, block_k)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
+               dropout):
+    out, lse = _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale,
+                                 block_q, block_k, dropout)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v, bias, out, lse = res
-    if k.shape[2] >= _PALLAS_BWD_MIN_LEN:
-        dq, dk, dv = _flash_bwd_pallas(q, k, v, bias, out, lse, g, causal,
-                                       sm_scale, block_q, block_k)
+def _flash_bwd(causal, sm_scale, block_q, block_k, dropout, res, g):
+    q, k, v, bias, seed, out, lse = res
+    if dropout > 0.0 or k.shape[2] >= _PALLAS_BWD_MIN_LEN:
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g,
+                                       causal, sm_scale, block_q, block_k,
+                                       dropout)
     else:
         dq, dk, dv = _flash_bwd_xla(q, k, v, bias, out, lse, g, causal,
                                     sm_scale)
-    return dq, dk, dv, jnp.zeros_like(bias)
+    return (dq, dk, dv, jnp.zeros_like(bias),
+            np.zeros(seed.shape, jax.dtypes.float0))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -375,17 +431,22 @@ def _round_up(x, m):
 
 
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
-                    block_q=256, block_k=256):
+                    block_q=256, block_k=256, dropout=0.0, dropout_key=None):
     """Multi-head attention, flash-style.
 
     Args:
       q, k, v: (batch, heads, seq, head_dim). bf16 or f32.
       mask: optional (batch, kv_seq) — True/1 where attendable (padding mask).
       causal: apply causal masking.
+      dropout: attention-probability dropout rate (training). Requires
+        dropout_key (a jax PRNG key); silently 0 when the key is absent so
+        inference code never pays for RNG plumbing.
     Returns (batch, heads, q_seq, head_dim), q.dtype.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if dropout_key is None:
+        dropout = 0.0
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
 
@@ -394,7 +455,9 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
         bias = None
         if mask is not None:
             bias = jnp.where(mask.astype(bool), 0.0, _NEG)[:, None, None, :]
-        return mha_reference(q, k, v, bias=bias, causal=causal, sm_scale=sm_scale)
+        return mha_reference(q, k, v, bias=bias, causal=causal,
+                             sm_scale=sm_scale, dropout=dropout,
+                             dropout_key=dropout_key)
 
     block_q = min(block_q, _round_up(Lq, 128))
     block_k = min(block_k, _round_up(Lk, 128))
@@ -409,7 +472,13 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
         v = jnp.pad(v, ((0, 0), (0, 0), (0, Lk_p - Lk), (0, 0)))
     if Lq_p != Lq:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, Lq_p - Lq), (0, 0)))
-    out = _flash(q, k, v, bias, causal, sm_scale, block_q, block_k)
+    if dropout > 0.0:
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.bits(dropout_key, (1,), jnp.uint32), jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    out = _flash(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
+                 float(dropout))
     if Lq_p != Lq:
         out = out[:, :, :Lq]
     return out

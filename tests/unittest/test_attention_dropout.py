@@ -1,0 +1,109 @@
+"""Attention-probability dropout (reference: gluonnlp BERT's 0.1 attention
+dropout over `_contrib_interleaved_matmul_selfatt_*` outputs).
+
+These run the XLA fallback path on the CPU mesh; the Pallas kernel path is
+validated on the real chip by `tools/tpu_validate.py` (explicit-mask oracle).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import ndarray as F
+from mxnet_tpu.pallas_ops import flash_attention
+
+
+def _qkv(B=2, H=2, L=32, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(B, H, L, D), jnp.float32) for _ in range(3)]
+
+
+def test_dropout_changes_output_and_preserves_mean():
+    q, k, v = _qkv(L=64)
+    key = jax.random.key(5)
+    clean = flash_attention(q, k, v)
+    dropped = flash_attention(q, k, v, dropout=0.5, dropout_key=key)
+    assert bool(jnp.any(clean != dropped))
+    # inverted scaling keeps the expectation: means agree loosely
+    assert abs(float(dropped.mean() - clean.mean())) < 0.05
+
+
+def test_dropout_zero_and_keyless_are_noops():
+    q, k, v = _qkv()
+    clean = flash_attention(q, k, v)
+    assert bool(jnp.all(flash_attention(q, k, v, dropout=0.0) == clean))
+    assert bool(jnp.all(flash_attention(q, k, v, dropout=0.5) == clean))
+
+
+def test_dropout_grads_flow():
+    q, k, v = _qkv()
+    key = jax.random.key(7)
+    for i in range(3):
+        g = jax.grad(lambda *a: flash_attention(
+            *a, dropout=0.3, dropout_key=key).sum(), argnums=i)(q, k, v)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        assert float(jnp.abs(g).max()) > 0
+
+
+def test_fused_self_attention_dropout_training_only():
+    rng = np.random.RandomState(1)
+    qkv = nd.array(rng.randn(2, 16, 3 * 32).astype(np.float32))
+    mx.random.seed(0)
+    # inference (default): dropout is inert
+    a = F.fused_self_attention(qkv, num_heads=4, dropout=0.5)
+    b = F.fused_self_attention(qkv, num_heads=4, dropout=0.5)
+    assert bool((a == b).asnumpy().all())
+    # training mode: masks sampled, so two calls differ
+    c = F.fused_self_attention(qkv, num_heads=4, dropout=0.5, _training=True)
+    d = F.fused_self_attention(qkv, num_heads=4, dropout=0.5, _training=True)
+    assert bool((c != d).asnumpy().any())
+
+
+def test_eager_backward_replays_forward_mask():
+    """The vjp replay must regenerate the SAME dropout mask the recorded
+    forward drew (RNG_OPS key pinning). Attention is linear in v, so with
+    v=I the forward output IS the dropped attention matrix A, and
+    d(sum out)/dv[j] must equal colsum_j(A) — any mask drift between
+    forward and replay breaks this identity."""
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(3)
+    L = 32
+    q = nd.array(rng.randn(1, 1, L, L).astype(np.float32))
+    k = nd.array(rng.randn(1, 1, L, L).astype(np.float32))
+    v = nd.array(np.eye(L, dtype=np.float32)[None, None])
+    v.attach_grad()
+    mx.random.seed(4)
+    with autograd.record():
+        out = F.flash_attention(q, k, v, dropout=0.5, _training=True)
+        loss = out.sum()
+    loss.backward()
+    A = out.asnumpy()[0, 0]           # (L, L) dropped attention matrix
+    colsum = A.sum(axis=0)
+    gv = v.grad.asnumpy()[0, 0]
+    # row j of dv is colsum_j(A) broadcast over the feature dim
+    np.testing.assert_allclose(gv, np.tile(colsum[:, None], (1, L)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bert_attention_dropout_active_in_training():
+    from mxnet_tpu.models import bert as bm
+    from mxnet_tpu import autograd
+
+    cfg = bm.bert_tiny_config(dropout=0.4)
+    m = bm.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    b = bm.make_synthetic_batch(cfg, 2, 32, 5)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    with autograd.record():
+        s1, _ = m(*data)
+    with autograd.record():
+        s2, _ = m(*data)
+    assert bool((s1 != s2).asnumpy().any())
+    # predict mode is deterministic
+    p1, _ = m(*data)
+    p2, _ = m(*data)
+    assert bool((p1 == p2).asnumpy().all())
