@@ -49,6 +49,8 @@ class ShardedTrainer:
             pass
 
     def _setup(self):
+        import os
+
         self._fn, self._grad_params, self._aux_params = functional_call(
             self.block, train=True)
         self._names = [name for name, _ in self._grad_params]
@@ -60,17 +62,38 @@ class ShardedTrainer:
             for _, p in self._grad_params]
         self._aux_shard = [_specs.replicated(self.mesh) for _ in self._aux_params]
         rep = _specs.replicated(self.mesh)
+        self._rep = rep
 
-        # device-resident state
-        self.params = [jax.device_put(p.data()._data, s)
-                       for (_, p), s in zip(self._grad_params, self._pshard)]
+        # Fused multi-tensor LAMB + f32 flat master weights (reference
+        # multi_mp_lamb_update): replicate mode only — under fsdp/tp the
+        # per-parameter path shards cleanly, the flat concat would not.
+        self._fused = (
+            self.fopt.kind == "lamb" and self.param_mode == "replicate"
+            and os.environ.get("MXNET_TPU_FUSED_LAMB", "1") == "1")
+        if self._fused:
+            from .fused_lamb import FusedLamb
+            o = self.fopt.opt
+            datas = [p.data()._data for _, p in self._grad_params]
+            self._fl = FusedLamb(
+                [d.shape for d in datas], [d.dtype for d in datas],
+                [self.fopt._wd_for(i) for i in range(len(datas))],
+                o.beta1, o.beta2, o.epsilon, o.bias_correction,
+                o.rescale_grad, o.clip_gradient or -1.0,
+                o.lower_bound or -1.0, o.upper_bound or -1.0)
+            master = self._fl.flatten(datas)
+            self.params = jax.device_put(master, rep)
+            self.opt_state = (
+                jax.device_put(jnp.zeros_like(master), rep),
+                jax.device_put(jnp.zeros_like(master), rep))
+        else:
+            self.params = [jax.device_put(p.data()._data, s)
+                           for (_, p), s in zip(self._grad_params, self._pshard)]
+            # optimizer state shards like its parameter (weight-update sharding)
+            self.opt_state = [
+                tuple(jax.device_put(z, s) for z in st)
+                for st, s in zip(self.fopt.init(self.params), self._pshard)]
         self.aux = [jax.device_put(p.data()._data, s)
                     for (_, p), s in zip(self._aux_params, self._aux_shard)]
-        # optimizer state shards like its parameter (weight-update sharding)
-        self.opt_state = [
-            tuple(jax.device_put(z, s) for z in st)
-            for st, s in zip(self.fopt.init(self.params), self._pshard)]
-        self._rep = rep
         self._ready = True
 
     # ------------------------------------------------------------------
@@ -78,11 +101,17 @@ class ShardedTrainer:
         fn = self._fn
         loss_fn = self.loss_fn
         fopt = self.fopt
+        fused = self._fused
+        fl = self._fl if fused else None
 
         def step(params, aux, opt_state, t, lr, rng, *batch):
             data, labels = batch[:n_data], batch[n_data:]
 
             def loss_of(ps):
+                if fused:
+                    # per-tensor model-dtype views of the flat f32 master;
+                    # the vjp of this unflatten returns the gradient FLAT
+                    ps = fl.unflatten(ps)
                 outs, new_aux = fn(ps, aux, rng, *data)
                 prev_r = _engine.set_recording(False)
                 prev_t = _engine.set_training(True)
@@ -98,19 +127,27 @@ class ShardedTrainer:
 
             (loss, (outs, new_aux)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            new_params, new_opt = fopt.apply(params, grads, opt_state, t, lr)
+            if fused:
+                new_params, new_m, new_v = fl.apply_flat(
+                    params, grads, opt_state[0], opt_state[1], t, lr)
+                new_opt = (new_m, new_v)
+            else:
+                new_params, new_opt = fopt.apply(params, grads, opt_state, t, lr)
             return loss, new_params, new_aux, new_opt
 
         donate = (0, 1, 2) if self._donate else ()
+        if fused:
+            pshard = self._rep
+            oshard = (self._rep, self._rep)
+        else:
+            pshard = self._pshard
+            oshard = [tuple(s for _ in st)
+                      for st, s in zip(self.opt_state, self._pshard)]
         in_shardings = (
-            self._pshard, self._aux_shard,
-            [tuple(s for _ in st) for st, s in zip(self.opt_state, self._pshard)],
+            pshard, self._aux_shard, oshard,
             self._rep, self._rep, self._rep,
         ) + tuple(_specs.batch_spec(len(shape), self.mesh) for shape in batch_shapes)
-        out_shardings = (
-            self._rep, self._pshard, self._aux_shard,
-            [tuple(s for _ in st) for st, s in zip(self.opt_state, self._pshard)],
-        )
+        out_shardings = (self._rep, pshard, self._aux_shard, oshard)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings, out_shardings=out_shardings)
 
@@ -147,7 +184,8 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def sync_to_block(self):
         """Write device state back into the gluon Parameters (checkpointing)."""
-        for (_, p), v in zip(self._grad_params, self.params):
+        params = self._fl.unflatten(self.params) if self._fused else self.params
+        for (_, p), v in zip(self._grad_params, params):
             p.data()._data = v
         for (_, p), v in zip(self._aux_params, self.aux):
             p.data()._data = v
@@ -162,6 +200,17 @@ class ShardedTrainer:
     def _state_pytree(self):
         """The checkpointed state, used by BOTH save and restore so the
         two can never drift apart."""
+        if self._fused:
+            # canonical per-tensor layout so fused-LAMB checkpoints stay
+            # portable across param modes (f32: master precision preserved)
+            m = self._fl.unflatten_master(self.opt_state[0])
+            v = self._fl.unflatten_master(self.opt_state[1])
+            return {
+                "params": self._fl.unflatten_master(self.params),
+                "aux": list(self.aux),
+                "opt_state": [[mi, vi] for mi, vi in zip(m, v)],
+                "num_update": jnp.asarray(self.num_update),
+            }
         return {
             "params": list(self.params),
             "aux": list(self.aux),
@@ -191,11 +240,22 @@ class ShardedTrainer:
         ckptr = ocp.StandardCheckpointer()
         state = ckptr.restore(
             os.path.abspath(os.path.join(str(directory), "state")), target)
-        self.params = list(state["params"])
+        if self._fused:
+            self.params = jax.device_put(
+                self._fl.flatten(state["params"]), self._rep)
+            self.opt_state = (
+                jax.device_put(self._fl.flatten(
+                    [st[0] for st in state["opt_state"]]), self._rep),
+                jax.device_put(self._fl.flatten(
+                    [st[1] for st in state["opt_state"]]), self._rep))
+        else:
+            self.params = list(state["params"])
+            self.opt_state = [tuple(st) for st in state["opt_state"]]
         self.aux = list(state["aux"])
-        self.opt_state = [tuple(st) for st in state["opt_state"]]
         self.num_update = int(state["num_update"])
 
     @property
     def param_count(self):
+        if self._fused:
+            return sum(self._fl.sizes)
         return sum(int(jnp.size(p)) for p in self.params)

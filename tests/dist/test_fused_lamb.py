@@ -1,0 +1,88 @@
+"""Fused multi-tensor LAMB (reference: `src/operator/optimizer_op.cc`
+multi_lamb_update / multi_mp_lamb_update): the flat-master path must match
+the per-parameter path step for step."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon import nn, loss as gloss
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=8),
+            nn.LayerNorm(in_channels=32), nn.Dense(4, in_units=32))
+    net.initialize()
+    return net
+
+
+def _run(monkeypatch, fused, steps=5):
+    monkeypatch.setenv("MXNET_TPU_FUSED_LAMB", "1" if fused else "0")
+    parallel.make_mesh(dp=-1)
+    net = _net(seed=7)
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    tr = parallel.ShardedTrainer(
+        net, lambda o, l: lfn(o, l), "lamb",
+        {"learning_rate": 0.02, "wd": 0.01}, param_mode="replicate")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(16, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 16).astype(np.float32))
+    losses = [float(tr.step([x], [y]).asscalar()) for _ in range(steps)]
+    tr.sync_to_block()
+    params = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    return losses, params, tr
+
+
+def test_fused_matches_per_param(monkeypatch):
+    l_fused, p_fused, tr = _run(monkeypatch, fused=True)
+    assert tr._fused
+    l_ref, p_ref, tr2 = _run(monkeypatch, fused=False)
+    assert not tr2._fused
+    np.testing.assert_allclose(l_fused, l_ref, rtol=2e-5)
+    for k in p_ref:
+        np.testing.assert_allclose(p_fused[k], p_ref[k], rtol=2e-4, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_fused_lamb_no_wd_on_norm_params(monkeypatch):
+    _, _, tr = _run(monkeypatch, fused=True, steps=1)
+    names = tr._names
+    wds = tr._fl._wd_seg
+    for n, w in zip(names, np.asarray(wds)):
+        if n.endswith(("bias", "beta", "gamma")):
+            assert w == 0.0, n
+        else:
+            assert w > 0.0, n
+
+
+def test_fused_checkpoint_roundtrip(monkeypatch, tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    l1, p1, tr = _run(monkeypatch, fused=True, steps=3)
+    tr.save_states(tmp_path / "ck")
+    rng0 = np.random.RandomState(0)
+    x0 = nd.array(rng0.randn(16, 8).astype(np.float32))
+    y0 = nd.array(rng0.randint(0, 4, 16).astype(np.float32))
+    loss_next = float(tr.step([x0], [y0]).asscalar())
+
+    parallel.make_mesh(dp=-1)
+    net2 = _net(seed=99)
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    tr2 = parallel.ShardedTrainer(
+        net2, lambda o, l: lfn(o, l), "lamb",
+        {"learning_rate": 0.02, "wd": 0.01}, param_mode="replicate")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(16, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 16).astype(np.float32))
+    tr2.step([x], [y])
+    tr2.load_states(tmp_path / "ck")
+    assert tr2.num_update == 3
+    loss_next2 = float(tr2.step([x], [y]).asscalar())
+    np.testing.assert_allclose(loss_next2, loss_next, rtol=1e-5)

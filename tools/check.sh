@@ -9,9 +9,11 @@ echo "== dryrun_multichip(8) =="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 echo "== entry() compile check =="
-python -c "
-import __graft_entry__ as g
+# pin CPU: this must not depend on the TPU tunnel being up
+JAX_PLATFORMS=cpu python -c "
 import jax
+jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
 fn, args = g.entry()
 jax.jit(fn).lower(*args)
 print('entry() lowers OK')
