@@ -475,9 +475,30 @@ def waitall():
     (jnp.zeros(()) + 0).block_until_ready()
 
 
-def save(fname, data):
-    """Save NDArray / list / dict of NDArrays (reference `.params` role; the
-    container here is numpy .npz rather than dmlc::Stream binary)."""
+def save(fname, data, format="npz"):
+    """Save NDArray / list / dict of NDArrays.
+
+    format='npz' (default, fast path) writes a numpy archive;
+    format='params' writes the reference's dmlc::Stream binary container
+    (`src/ndarray/ndarray.cc` NDArray::Save + MXNDArraySave list layout, see
+    ndarray/params_io.py) so checkpoints interoperate with the reference
+    ecosystem. `load` sniffs the container magic, so either format loads
+    transparently."""
+    if format == "params":
+        from . import params_io
+        if isinstance(data, NDArray):
+            arrays, names = [data.asnumpy()], []
+        elif isinstance(data, (list, tuple)):
+            arrays, names = [a.asnumpy() for a in data], []
+        elif isinstance(data, dict):
+            names = list(data.keys())
+            arrays = [data[k].asnumpy() for k in names]
+        else:
+            raise TypeError(type(data))
+        params_io.save_params(fname, arrays, names)
+        return
+    if format != "npz":
+        raise ValueError(f"unknown format '{format}' (npz|params)")
     if isinstance(data, NDArray):
         payload, meta = {"arr_0": data.asnumpy()}, "single"
     elif isinstance(data, (list, tuple)):
@@ -498,6 +519,14 @@ def load(fname):
     import os
     if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
         fname = fname + ".npz"
+    from . import params_io
+    if params_io.is_params_file(fname):
+        arrays, names = params_io.load_params(fname)
+        if names:
+            return {k: array(a) for k, a in zip(names, arrays)}
+        if len(arrays) == 1:
+            return array(arrays[0])
+        return [array(a) for a in arrays]
     with _np.load(fname, allow_pickle=False) as z:
         meta = str(z["__mx_meta__"])
         items = {k: array(z[k]) for k in z.files if k != "__mx_meta__"}
