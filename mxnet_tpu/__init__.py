@@ -15,16 +15,18 @@ from .base import MXNetError
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import random
+from . import config
 from . import ndarray
 from . import ndarray as nd
 from . import autograd
 from . import attribute
 from .attribute import AttrScope
+from .debug import debug
 
 __all__ = [
     "nd", "ndarray", "autograd", "random", "context", "attribute",
     "AttrScope", "Context", "cpu", "gpu", "tpu", "current_context",
-    "num_gpus", "num_tpus", "MXNetError",
+    "num_gpus", "num_tpus", "MXNetError", "config", "debug",
 ]
 
 # Subpackages filled in over the build; imported lazily to keep import light

@@ -1,0 +1,128 @@
+"""Typed runtime configuration (SURVEY §5.6).
+
+The reference scatters behavior knobs across ~100 `MXNET_*` environment
+variables read ad-hoc through `dmlc::GetEnv` (upstream
+`docs/faq/env_var.md`); parameter structs are declared with
+`dmlc::Parameter` (`3rdparty/dmlc-core/include/dmlc/parameter.h`). This
+module is the TPU-native consolidation of both roles: every knob is
+DECLARED once with a type, default, env var, and docstring; reads are
+typed and validated; `describe()` enumerates the whole surface.
+
+Precedence: programmatic `set()` > environment variable > declared default.
+Call sites read through `config.get()` at use time, so `set()` takes
+effect without process restart (module-import-time env snapshots are the
+bug class this replaces).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["register_option", "get", "set", "reset", "describe", "option"]
+
+_lock = threading.Lock()
+_options = {}
+_overrides = {}
+
+
+class _Option:
+    __slots__ = ("name", "default", "typ", "env", "doc", "choices")
+
+    def __init__(self, name, default, typ, env, doc, choices):
+        self.name = name
+        self.default = default
+        self.typ = typ
+        self.env = env
+        self.doc = doc
+        self.choices = choices
+
+
+def _coerce(opt, raw):
+    if opt.typ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    val = opt.typ(raw)
+    if opt.choices and val not in opt.choices:
+        raise ValueError(
+            f"config '{opt.name}' must be one of {opt.choices}, got {val!r}")
+    return val
+
+
+def register_option(name, default, doc, typ=None, env=None, choices=None):
+    """Declare a knob. env defaults to MXNET_TPU_<NAME>."""
+    typ = typ or (type(default) if default is not None else str)
+    env = env or ("MXNET_TPU_" + name.upper())
+    with _lock:
+        if name in _options:
+            raise ValueError(f"config option '{name}' already registered")
+        _options[name] = _Option(name, default, typ, env, doc, choices)
+    return name
+
+
+def get(name):
+    opt = _options[name]
+    with _lock:
+        if name in _overrides:
+            return _overrides[name]
+    raw = os.environ.get(opt.env)
+    if raw is None:
+        return opt.default
+    return _coerce(opt, raw)
+
+
+def set(name, value):  # noqa: A001 - mirrors mx.config.set
+    opt = _options[name]
+    with _lock:
+        _overrides[name] = _coerce(opt, value)
+
+
+def reset(name=None):
+    with _lock:
+        if name is None:
+            _overrides.clear()
+        else:
+            _overrides.pop(name, None)
+
+
+def describe():
+    """All options with their current value and provenance."""
+    out = {}
+    for name, opt in sorted(_options.items()):
+        source = ("set" if name in _overrides
+                  else "env" if os.environ.get(opt.env) is not None
+                  else "default")
+        out[name] = {"value": get(name), "default": opt.default,
+                     "env": opt.env, "doc": opt.doc, "source": source}
+    return out
+
+
+def option(name):
+    """The declaration record (for tooling/tests)."""
+    return _options[name]
+
+
+# ---------------------------------------------------------------------------
+# framework knobs (each call site reads through get() at use time)
+# ---------------------------------------------------------------------------
+register_option(
+    "fsdp_min_size", 1024,
+    "Smallest parameter (elements) sharded over the fsdp axis; smaller ones "
+    "stay replicated (reference: MXNET_KVSTORE_BIGARRAY_BOUND).")
+register_option(
+    "fused_lamb", True,
+    "Use the fused multi-tensor LAMB path (flat f32 master weights) when "
+    "params are replicated.")
+register_option(
+    "prng", "auto", choices=("auto", "rbg", "threefry2x32"),
+    doc="PRNG implementation: 'rbg' (TPU hardware generator, fast), "
+        "'threefry2x32' (counter-exact), or 'auto' (rbg on TPU).")
+register_option(
+    "pallas_bwd_min_len", 1024,
+    "KV length at or above which flash-attention backward uses the "
+    "blockwise Pallas kernels instead of XLA's fused LxL formulation "
+    "(measured crossover; dropout>0 always uses Pallas).")
+register_option(
+    "debug", False,
+    "Debug mode: op-by-op execution (no jit) + NaN checks. Usually set via "
+    "mxnet_tpu.debug() rather than this knob.")

@@ -1,0 +1,72 @@
+"""Debug mode (SURVEY §5.2).
+
+The reference's race/debug answer is `MXNET_ENGINE_TYPE=NaiveEngine`
+(synchronous single-threaded execution so errors surface at the faulting
+op, `src/engine/naive_engine.cc`). The functional TPU analog: run op-by-op
+(jax.disable_jit — every op executes eagerly, Python stack traces point at
+the failing op) and make NaNs/Infs raise at the op that produced them
+(jax_debug_nans). Purity makes data races inexpressible, so "race
+detection" reduces to this determinism/visibility mode.
+
+Usage::
+
+    with mxnet_tpu.debug():
+        trainer.step(...)          # errors point at the exact op
+
+    mxnet_tpu.debug(enable=True)   # process-global until debug(enable=False)
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["debug"]
+
+_state = {"global": False}
+
+
+def _apply(active, nan_check, disable_jit):
+    if nan_check:
+        jax.config.update("jax_debug_nans", active)
+    if disable_jit:
+        jax.config.update("jax_disable_jit", active)
+
+
+class _DebugCtx(contextlib.AbstractContextManager):
+    def __init__(self, nan_check, disable_jit):
+        self.nan_check = nan_check
+        self.disable_jit = disable_jit
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (jax.config.jax_debug_nans, jax.config.jax_disable_jit)
+        _apply(True, self.nan_check, self.disable_jit)
+        return self
+
+    def __exit__(self, *exc):
+        jax.config.update("jax_debug_nans", self._prev[0])
+        jax.config.update("jax_disable_jit", self._prev[1])
+        return False
+
+
+def debug(enable=None, nan_check=True, disable_jit=True):
+    """Context manager (no args) or global toggle (enable=True/False)."""
+    from . import config
+    if enable is None:
+        return _DebugCtx(nan_check, disable_jit)
+    _state["global"] = bool(enable)
+    config.set("debug", bool(enable))   # describe() reflects the toggle
+    _apply(bool(enable), nan_check, disable_jit)
+    return None
+
+
+def _honor_env_knob():
+    """MXNET_TPU_DEBUG=1 turns debug mode on at import (config 'debug')."""
+    from . import config
+    if config.get("debug"):
+        _state["global"] = True
+        _apply(True, True, True)
+
+
+_honor_env_knob()

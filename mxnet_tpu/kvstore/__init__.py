@@ -26,6 +26,10 @@ from ..ndarray import NDArray
 __all__ = ["KVStore", "create"]
 
 
+def _nd_scalar(v):
+    return NDArray(jnp.asarray([v], jnp.int32))
+
+
 class KVStore:
     def __init__(self, kind):
         self.type = kind
@@ -150,14 +154,60 @@ class KVStore:
         for k, st in getattr(self, "_opt_states", {}).items():
             if st is None:
                 continue
-            sts = st if isinstance(st, tuple) else (st,)
-            for j, s in enumerate(sts):
-                if s is not None:
-                    flat[f"{k}.{j}"] = s
+            # 'i:'/'s:' key-type tag: flat names are strings, but kvstore
+            # keys may be ints — without the tag a resumed push(0, ...)
+            # would miss _opt_states['0'] and silently reset the moments
+            kk = f"{'i' if isinstance(k, int) else 's'}:{k}"
+            if isinstance(st, tuple):
+                # record tuple arity so None holes (e.g. multi-precision
+                # SGD's (None, w32)) survive the flat round-trip
+                flat[f"{kk}.__arity__"] = _nd_scalar(len(st))
+                for j, s in enumerate(st):
+                    if s is not None:
+                        flat[f"{kk}.{j}"] = s
+            else:
+                flat[f"{kk}.0"] = st
         _nd.save(fname, flat)
 
     def load_optimizer_states(self, fname):
-        pass
+        """Restore save_optimizer_states output (reference:
+        KVStore.load_optimizer_states / Module resume path). Flat
+        '{key}.{j}' entries are regrouped; '{key}.__arity__' restores
+        tuple structure including None holes; a lone '.0' without arity
+        restores a bare (non-tuple) state matching create_state's shape."""
+        from ..ndarray import ndarray as _nd
+        if self._optimizer is None:
+            raise RuntimeError(
+                "call set_optimizer before load_optimizer_states "
+                "(set_optimizer resets the state table)")
+        flat = _nd.load(fname)
+        if not isinstance(flat, dict):
+            raise ValueError(
+                f"{fname} is not an optimizer-state dict checkpoint")
+        grouped, arity = {}, {}
+        for fk, v in flat.items():
+            k, _, j = fk.rpartition(".")
+            if k[:2] == "i:":
+                k = int(k[2:])
+            elif k[:2] == "s:":
+                k = k[2:]
+            if j == "__arity__":
+                arity[k] = int(v.asnumpy())
+                continue
+            if k == "" or not j.isdigit():
+                raise ValueError(f"malformed optimizer-state key '{fk}'")
+            grouped.setdefault(k, {})[int(j)] = v
+        for k in set(grouped) | set(arity):
+            parts = grouped.get(k, {})
+            if k in arity:
+                self._opt_states[k] = tuple(
+                    parts.get(i) for i in range(arity[k]))
+            elif len(parts) == 1 and 0 in parts:
+                self._opt_states[k] = parts[0]
+            else:
+                raise ValueError(
+                    f"optimizer-state key '{k}' has indices "
+                    f"{sorted(parts)} but no arity record")
 
     # -- helpers ---------------------------------------------------------
     @staticmethod

@@ -393,7 +393,12 @@ def _flash_bwd_xla(q, k, v, bias, out, lse, g, causal, sm_scale):
 # first, then bandwidth; measured 1.56x at L=4096 causal); below it XLA's
 # fused L×L backward is faster. With attention dropout the Pallas backward
 # is used at every length: only it can regenerate the kernel-PRNG masks.
-_PALLAS_BWD_MIN_LEN = 1024
+# Knob: config 'pallas_bwd_min_len' / MXNET_TPU_PALLAS_BWD_MIN_LEN.
+
+
+def _pallas_bwd_min_len():
+    from .. import config
+    return config.get("pallas_bwd_min_len")
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
@@ -412,7 +417,7 @@ def _flash_fwd(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, dropout, res, g):
     q, k, v, bias, seed, out, lse = res
-    if dropout > 0.0 or k.shape[2] >= _PALLAS_BWD_MIN_LEN:
+    if dropout > 0.0 or k.shape[2] >= _pallas_bwd_min_len():
         dq, dk, dv = _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g,
                                        causal, sm_scale, block_q, block_k,
                                        dropout)

@@ -40,7 +40,12 @@ def batch_spec(ndim, mesh=None, extra=None):
 # MXNET_KVSTORE_BIGARRAY_BOUND — small arrays are not worth distributing).
 # Small 1D params (LayerNorm gamma/beta, biases) otherwise force a constant
 # stream of GSPMD reshards around their broadcasts/reductions.
-FSDP_MIN_SIZE = int(os.environ.get("MXNET_TPU_FSDP_MIN_SIZE", 1024))
+# Knob: config 'fsdp_min_size' / MXNET_TPU_FSDP_MIN_SIZE.
+
+
+def _fsdp_min_size():
+    from .. import config
+    return config.get("fsdp_min_size")
 
 
 def fsdp_spec(shape, mesh=None, hint=None):
@@ -59,7 +64,7 @@ def fsdp_spec(shape, mesh=None, hint=None):
     size = mesh.shape.get("fsdp", 1)
     if size <= 1 or not shape:
         return replicated(mesh)
-    if hint == "embedding" or int(np.prod(shape)) < FSDP_MIN_SIZE:
+    if hint == "embedding" or int(np.prod(shape)) < _fsdp_min_size():
         return replicated(mesh)
     if len(shape) == 2:
         # (out, in) Dense weights: prefer the contraction (input) dim — the

@@ -24,7 +24,24 @@ from . import specs as _specs
 from .functional_opt import FunctionalOptimizer
 from .mesh import current_mesh
 
-__all__ = ["ShardedTrainer"]
+__all__ = ["ShardedTrainer", "call_loss"]
+
+
+def call_loss(loss_fn, rng, outs, labels):
+    """Invoke a user loss_fn on raw arrays inside a traced train step:
+    recording off, training mode on, loss RNG pinned to fold_in(rng, 1).
+    Shared by ShardedTrainer and PipelineTrainer so the engine-flag and
+    rng conventions cannot drift between them."""
+    prev_r = _engine.set_recording(False)
+    prev_t = _engine.set_training(True)
+    try:
+        with _random.key_scope(jax.random.fold_in(rng, 1)):
+            loss_nd = loss_fn(*[NDArray(o) for o in outs],
+                              *[NDArray(l) for l in labels])
+    finally:
+        _engine.set_recording(prev_r)
+        _engine.set_training(prev_t)
+    return jnp.mean(loss_nd._data.astype(jnp.float32))
 
 
 class ShardedTrainer:
@@ -41,16 +58,15 @@ class ShardedTrainer:
         self.num_update = 0
         self._step_cache = {}
         self._ready = False
+        from ..gluon.parameter import DeferredInitializationError
         try:
             self._setup()
-        except Exception:
+        except DeferredInitializationError:
             # deferred parameter shapes: resolved by an eager probe pass on
             # the first step's batch (reference: deferred init on forward)
             pass
 
     def _setup(self):
-        import os
-
         self._fn, self._grad_params, self._aux_params = functional_call(
             self.block, train=True)
         self._names = [name for name, _ in self._grad_params]
@@ -67,9 +83,10 @@ class ShardedTrainer:
         # Fused multi-tensor LAMB + f32 flat master weights (reference
         # multi_mp_lamb_update): replicate mode only — under fsdp/tp the
         # per-parameter path shards cleanly, the flat concat would not.
+        from .. import config
         self._fused = (
             self.fopt.kind == "lamb" and self.param_mode == "replicate"
-            and os.environ.get("MXNET_TPU_FUSED_LAMB", "1") == "1")
+            and config.get("fused_lamb"))
         if self._fused:
             from .fused_lamb import FusedLamb
             o = self.fopt.opt
@@ -113,16 +130,7 @@ class ShardedTrainer:
                     # the vjp of this unflatten returns the gradient FLAT
                     ps = fl.unflatten(ps)
                 outs, new_aux = fn(ps, aux, rng, *data)
-                prev_r = _engine.set_recording(False)
-                prev_t = _engine.set_training(True)
-                try:
-                    with _random.key_scope(jax.random.fold_in(rng, 1)):
-                        loss_nd = loss_fn(*[NDArray(o) for o in outs],
-                                          *[NDArray(l) for l in labels])
-                finally:
-                    _engine.set_recording(prev_r)
-                    _engine.set_training(prev_t)
-                loss = jnp.mean(loss_nd._data.astype(jnp.float32))
+                loss = call_loss(loss_fn, rng, outs, labels)
                 return loss, (outs, new_aux)
 
             (loss, (outs, new_aux)), grads = jax.value_and_grad(

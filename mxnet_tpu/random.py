@@ -13,7 +13,6 @@ randomness through an ordinary argument.
 """
 from __future__ import annotations
 
-import os
 import threading
 
 import jax
@@ -27,10 +26,11 @@ def _impl():
     """PRNG implementation: threefry is counter-exact but slow on TPU's
     vector unit; the hardware `rbg` generator is ~25ms/step cheaper on a
     BERT-base train step (dropout masks dominate). Default: rbg on TPU,
-    threefry elsewhere; override with MXNET_TPU_PRNG."""
-    env = os.environ.get("MXNET_TPU_PRNG")
-    if env:
-        return env
+    threefry elsewhere; knob: config 'prng' / MXNET_TPU_PRNG."""
+    from . import config
+    choice = config.get("prng")
+    if choice != "auto":
+        return choice
     try:
         return "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
     except Exception:
