@@ -1,0 +1,83 @@
+"""tp-heavy mesh coverage (VERDICT r2 weak #7): the dryrun's axis factoring
+only reaches tp=2 at n=8, so the Megatron rules (embedding feature-dim
+sharding, vocab-projection psum) are pinned here at tp=4."""
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import bert as bert_mod
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _batch(cfg, batch=8):
+    b = bert_mod.make_synthetic_batch(cfg, batch_size=batch, seq_len=32,
+                                      num_masked=4, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k]) for k in
+              ("mlm_labels", "mlm_weights", "nsp_labels")]
+    return data, labels
+
+
+def _train(steps=3, tp=False):
+    cfg = bert_mod.bert_tiny_config(units=64, hidden_size=128, num_heads=4,
+                                    num_layers=2, vocab_size=128)
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    if tp:
+        parallel.make_mesh(dp=1, fsdp=2, tp=4)
+        parallel.apply_tp_rules(model, bert_mod.tp_rules("tp"))
+    else:
+        parallel.make_mesh(dp=-1)
+    tr = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "lamb", {"learning_rate": 1e-3},
+        param_mode="fsdp" if tp else "replicate")
+    data, labels = _batch(cfg)
+
+    with tempfile.TemporaryFile() as capture:
+        stderr_fd = os.dup(2)
+        try:
+            os.dup2(capture.fileno(), 2)
+            losses = [float(tr.step(data, labels).asscalar())
+                      for _ in range(steps)]
+        finally:
+            os.dup2(stderr_fd, 2)
+            os.close(stderr_fd)
+            capture.seek(0)
+            log = capture.read().decode(errors="replace")
+            if log:
+                print(log, end="", file=sys.stderr)
+    parallel.set_mesh(None)
+    return losses, log, tr
+
+
+def test_tp4_compiles_warning_free_and_matches_dp():
+    losses_tp, log, tr = _train(tp=True)
+    assert dict(tr.mesh.shape)["tp"] == 4
+    assert "Involuntary full rematerialization" not in log, (
+        "tp=4 sharding rules force SPMD full rematerialization")
+    losses_dp, _, _ = _train(tp=False)
+    # same model, same data, same optimizer -> same loss trajectory
+    np.testing.assert_allclose(losses_tp, losses_dp, rtol=2e-4)
+    assert losses_tp[-1] < losses_tp[0]
+
+
+def test_tp4_param_shardings_applied():
+    _, _, tr = _train(steps=1, tp=True)
+    by_name = dict(zip(tr._names, tr._pshard))
+    qkv = [s for n, s in by_name.items() if n.endswith("qkv.weight")]
+    emb = [s for n, s in by_name.items() if n.endswith("word_embed.weight")]
+    assert qkv and all("tp" in str(s.spec) for s in qkv)
+    # embedding sharded on the FEATURE dim (dim 1), never the vocab dim
+    assert emb and all(s.spec[1] == "tp" and s.spec[0] != "tp" for s in emb)
