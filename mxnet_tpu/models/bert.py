@@ -26,14 +26,18 @@ from ..ndarray import ndarray as F
 def bert_base_config(**overrides):
     cfg = dict(vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
                num_heads=12, max_length=512, type_vocab_size=2, dropout=0.1,
-               dtype="float32")
+               dtype="float32", remat=False)
     cfg.update(overrides)
     return cfg
 
 
 def bert_large_config(**overrides):
+    # remat by default at large depth: recompute each encoder layer in the
+    # backward pass (jax.checkpoint) so activation memory scales O(1) in
+    # depth instead of O(num_layers) — the FLOPs-for-HBM trade that makes
+    # BERT-large batch sizes fit (SURVEY §7.4 item 4)
     cfg = bert_base_config(units=1024, hidden_size=4096, num_layers=24,
-                           num_heads=16)
+                           num_heads=16, remat=True)
     cfg.update(overrides)
     return cfg
 
@@ -92,14 +96,30 @@ class BERTEncoderLayer(HybridBlock):
         return self.ffn_ln(x + h)
 
 
+def _remat_call(layer, x, mask):
+    """Apply one encoder layer under jax.checkpoint: the backward pass
+    recomputes the layer's internals from its (x, mask) boundary instead of
+    stashing every intermediate. Layer parameters ride in as closure
+    constants (under functional_call they are the substituted tracers)."""
+    import jax
+
+    def f(xd, *md):
+        out = layer(NDArray(xd), NDArray(md[0]) if md else None)
+        return out._data
+
+    args = (x._data,) + (() if mask is None else (mask._data,))
+    return NDArray(jax.checkpoint(f)(*args))
+
+
 class BERTModel(HybridBlock):
     """Embeddings + encoder stack + pooler (reference: gluonnlp BERTModel)."""
 
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
                  max_length=512, type_vocab_size=2, dropout=0.1,
-                 dtype="float32", **kwargs):
+                 dtype="float32", remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._remat = remat
         self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
                                        weight_initializer="xavier")
         self.token_type_embed = nn.Embedding(type_vocab_size, units, dtype=dtype,
@@ -137,8 +157,16 @@ class BERTModel(HybridBlock):
             import jax.numpy as jnp
             vl = valid_length._data if isinstance(valid_length, NDArray) else valid_length
             mask = NDArray(jnp.arange(L)[None, :] < vl[:, None].astype(jnp.int32))
+        from .. import _engine
+        # remat only where it means something: inside a jit trace (the
+        # eager tape stores activations per-op; jax.checkpoint there would
+        # just break recording)
+        use_remat = self._remat and not _engine.is_recording()
         for layer in self.layers:
-            x = layer(x, mask)
+            if use_remat:
+                x = _remat_call(layer, x, mask)
+            else:
+                x = layer(x, mask)
         # pin the encoder output (and via transpose its cotangent) to batch
         # sharding: the MLM gather and pooler-slice backward paths otherwise
         # propagate conflicting feature shardings from fsdp-sharded head

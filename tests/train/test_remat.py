@@ -1,0 +1,57 @@
+"""Rematerialization policy (SURVEY §7.4 item 4): jax.checkpoint per
+encoder layer trades recompute FLOPs for O(1)-in-depth activation memory."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import bert as bm
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _run(remat, steps=3):
+    parallel.make_mesh(dp=-1)
+    cfg = bm.bert_tiny_config(dropout=0.0, remat=remat)
+    m = bm.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    tr = parallel.ShardedTrainer(m, bm.bert_pretrain_loss, "lamb",
+                                 {"learning_rate": 1e-3})
+    b = bm.make_synthetic_batch(cfg, 8, 32, 5)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k]) for k in
+              ("mlm_labels", "mlm_weights", "nsp_labels")]
+    return [float(tr.step(data, labels).asscalar()) for _ in range(steps)]
+
+
+def test_remat_loss_parity():
+    np.testing.assert_allclose(_run(False), _run(True), rtol=1e-5)
+
+
+def test_bert_large_defaults_remat():
+    assert bm.bert_large_config()["remat"] is True
+    assert bm.bert_base_config()["remat"] is False
+
+
+def test_remat_skipped_on_eager_tape():
+    """remat is inert under autograd.record (tape stores per-op anyway)."""
+    from mxnet_tpu import autograd
+    cfg = bm.bert_tiny_config(dropout=0.0, remat=True)
+    m = bm.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    b = bm.make_synthetic_batch(cfg, 2, 16, 3)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    with autograd.record():
+        scores, nsp = m(*data)
+        loss = scores.sum() + nsp.sum()
+    loss.backward()
+    g = m.bert.word_embed.weight.grad()
+    assert g is not None and np.isfinite(g.asnumpy()).all()
