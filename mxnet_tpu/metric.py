@@ -11,7 +11,8 @@ from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
            "RMSE", "CrossEntropy", "Perplexity", "Loss", "PearsonCorrelation",
-           "CompositeEvalMetric", "CustomMetric", "create", "np_metric"]
+           "CompositeEvalMetric", "CustomMetric", "create", "np_metric",
+           "VOC07MApMetric"]
 
 _registry = Registry("metric")
 register = _registry.register
@@ -290,3 +291,92 @@ class CustomMetric(EvalMetric):
 
 def np_metric(numpy_feval, name="custom", allow_extra_outputs=False):
     return CustomMetric(numpy_feval, name, allow_extra_outputs)
+
+
+@register("voc_map")
+@register("voc07map")
+class VOC07MApMetric(EvalMetric):
+    """Pascal VOC 2007 11-point interpolated mean average precision
+    (reference: GluonCV `utils/metrics/voc_detection.py` VOC07MApMetric).
+
+    update(labels, preds):
+      preds:  (B, N, 6) rows [class_id, score, x1, y1, x2, y2]; rows with
+              score < 0 are ignored (box_nms suppression marker).
+      labels: (B, G, 5) rows [class_id, x1, y1, x2, y2]; class_id < 0 pads.
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP"):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        super().__init__(name)
+
+    def reset(self):
+        super().reset()
+        self._records = {}          # cid -> list of (score, is_tp)
+        self._npos = {}             # cid -> gt count
+
+    @staticmethod
+    def _iou(box, gts):
+        ix = np.maximum(0, np.minimum(box[2], gts[:, 2]) -
+                         np.maximum(box[0], gts[:, 0]))
+        iy = np.maximum(0, np.minimum(box[3], gts[:, 3]) -
+                         np.maximum(box[1], gts[:, 1]))
+        inter = ix * iy
+        a = max(0.0, (box[2] - box[0])) * max(0.0, (box[3] - box[1]))
+        b = np.maximum(0, gts[:, 2] - gts[:, 0]) * \
+            np.maximum(0, gts[:, 3] - gts[:, 1])
+        return inter / np.maximum(a + b - inter, 1e-12)
+
+    def update(self, labels, preds):
+        # list-of-NDArrays convention (Module.update_metric): consume pairs
+        if isinstance(labels, (list, tuple)) or isinstance(preds, (list, tuple)):
+            for lab, prd in zip(_as_list(labels), _as_list(preds)):
+                self.update(lab, prd)
+            return
+        labels = _as_np(labels)
+        preds = _as_np(preds)
+        for b in range(len(preds)):
+            gt = labels[b]
+            gt = gt[gt[:, 0] >= 0]
+            for cid in set(gt[:, 0].astype(int)):
+                self._npos[cid] = self._npos.get(cid, 0) + \
+                    int((gt[:, 0].astype(int) == cid).sum())
+            det = preds[b]
+            det = det[det[:, 1] >= 0]
+            det = det[np.argsort(-det[:, 1])]
+            used = np.zeros(len(gt), bool)
+            for row in det:
+                cid = int(row[0])
+                cls_mask = gt[:, 0].astype(int) == cid
+                tp = False
+                if cls_mask.any():
+                    ious = self._iou(row[2:6], gt[cls_mask, 1:5])
+                    j = int(np.argmax(ious))
+                    gidx = np.nonzero(cls_mask)[0][j]
+                    if ious[j] >= self.iou_thresh and not used[gidx]:
+                        used[gidx] = True
+                        tp = True
+                self._records.setdefault(cid, []).append((float(row[1]), tp))
+        self.num_inst = 1           # get() reports the computed mAP directly
+
+    def get(self):
+        aps = []
+        for cid, npos in self._npos.items():
+            recs = sorted(self._records.get(cid, []), key=lambda r: -r[0])
+            tps = np.asarray([tp for _, tp in recs], bool)
+            if len(tps) == 0:
+                aps.append(0.0)
+                continue
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(~tps)
+            recall = tp_cum / max(npos, 1)
+            precision = tp_cum / np.maximum(tp_cum + fp_cum, 1)
+            # VOC07 11-point interpolation
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+                ap += p / 11.0
+            aps.append(float(ap))
+        if not aps:
+            return self.name, float("nan")
+        return self.name, float(np.mean(aps))

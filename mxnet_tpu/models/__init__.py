@@ -9,11 +9,13 @@ from . import resnet
 from . import transformer
 from . import deepar
 from . import ssd
+from . import yolo
 
 from .bert import BERTModel, BERTForPretraining, bert_base_config, bert_large_config
 from .resnet import get_resnet, resnet18_v1, resnet50_v1, resnet101_v1
+from .yolo import YOLOv3Tiny
 
-__all__ = ["bert", "resnet", "transformer", "deepar", "ssd",
+__all__ = ["bert", "resnet", "transformer", "deepar", "ssd", "yolo",
            "BERTModel", "BERTForPretraining", "bert_base_config",
            "bert_large_config", "get_resnet", "resnet18_v1", "resnet50_v1",
-           "resnet101_v1"]
+           "resnet101_v1", "YOLOv3Tiny"]

@@ -1,0 +1,217 @@
+"""YOLOv3-tiny (BASELINE workload #4 family; reference: GluonCV
+`gluoncv/model_zoo/yolo/yolo3.py` + `src/operator/contrib/` detection ops).
+
+TPU-first choices:
+  * static shapes everywhere — gt boxes arrive padded to a fixed max count
+    (label -1 rows are padding), target assignment is a vmapped scatter,
+    NMS is the static-shape `_contrib_box_nms` registry op;
+  * the backbone is plain conv/bn/leaky stacks (MXU-friendly 3x3 convs);
+  * decode + loss are pure jax via nd.apply_op, so the whole train step
+    jits under ShardedTrainer.
+
+Anchors follow the upstream yolov3-tiny config scaled by `image_size/416`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn, HybridBlock
+from ..ndarray import NDArray, apply_op
+from ..ndarray import ndarray as F
+
+__all__ = ["YOLOv3Tiny", "yolo_targets", "yolo_loss", "decode_predictions"]
+
+
+def _conv_bn_leaky(channels, kernel=3, stride=1, pad=None):
+    pad = (kernel - 1) // 2 if pad is None else pad
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels, kernel, stride, pad, use_bias=False),
+            nn.BatchNorm(), nn.LeakyReLU(0.1))
+    return blk
+
+
+class YOLOv3Tiny(HybridBlock):
+    """Two-scale tiny YOLOv3. forward -> list of (B, H, W, A, 5+C) raw
+    heads, coarse scale first (strides image_size/8 apart by factor 2)."""
+
+    def __init__(self, num_classes=20, image_size=416, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.image_size = image_size
+        s = image_size / 416.0
+        self.anchors = [
+            np.asarray([[81, 82], [135, 169], [344, 319]], np.float32) * s,
+            np.asarray([[10, 14], [23, 27], [37, 58]], np.float32) * s,
+        ]
+        self.strides = [image_size // 13 if image_size % 13 == 0 else 32,
+                        image_size // 26 if image_size % 26 == 0 else 16]
+        self.na = 3
+        c = num_classes + 5
+
+        self.body = nn.HybridSequential()      # -> stride 16 feature
+        for ch in (16, 32, 64, 128, 256):
+            self.body.add(_conv_bn_leaky(ch))
+            if ch != 256:
+                self.body.add(nn.MaxPool2D(2, 2))
+        self.pool5 = nn.MaxPool2D(2, 2)        # -> stride 32
+        self.conv6 = _conv_bn_leaky(512)
+        self.conv7 = _conv_bn_leaky(256, kernel=1, pad=0)
+        self.head13 = nn.HybridSequential()
+        self.head13.add(_conv_bn_leaky(512), nn.Conv2D(self.na * c, 1))
+        self.up_conv = _conv_bn_leaky(128, kernel=1, pad=0)
+        self.head26 = nn.HybridSequential()
+        self.head26.add(_conv_bn_leaky(256), nn.Conv2D(self.na * c, 1))
+
+    def forward(self, x):
+        c = self.num_classes + 5
+        f16 = self.body(x)                     # (B, 256, H/16, W/16)
+        f32 = self.conv7(self.conv6(self.pool5(f16)))
+        p13 = self.head13(f32)
+        up = self.up_conv(f32)
+        up = apply_op(
+            lambda a: a.repeat(2, axis=2).repeat(2, axis=3), up)
+        p26 = self.head26(F.concat(up, f16, dim=1))
+
+        outs = []
+        for p in (p13, p26):
+            B, _, H, W = p.shape
+            outs.append(p.reshape(shape=(B, self.na, c, H, W))
+                        .transpose(axes=(0, 3, 4, 1, 2)))  # (B,H,W,A,5+C)
+        return outs
+
+
+def yolo_targets(model, gt_boxes, gt_labels):
+    """Static-shape target assignment. gt_boxes (B, G, 4) corner format in
+    image coords, gt_labels (B, G) with -1 padding. Each gt is assigned to
+    its best-IoU anchor (by wh overlap, upstream rule) at the cell holding
+    the box center. Returns per scale: dict of tobj (B,H,W,A),
+    txy (B,H,W,A,2) in-cell offsets, twh (B,H,W,A,2) log-scales,
+    tcls (B,H,W,A) int."""
+    import jax.numpy as jnp
+
+    sizes = [model.image_size // s for s in model.strides]
+    all_anchors = np.concatenate(model.anchors, 0)          # (S*A, 2)
+
+    def one(boxes, labels):
+        valid = labels >= 0
+        cx = (boxes[:, 0] + boxes[:, 2]) / 2
+        cy = (boxes[:, 1] + boxes[:, 3]) / 2
+        w = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1e-3)
+        h = jnp.maximum(boxes[:, 3] - boxes[:, 1], 1e-3)
+        # wh IoU against every anchor (both centered at origin)
+        aw, ah = all_anchors[:, 0], all_anchors[:, 1]
+        inter = jnp.minimum(w[:, None], aw[None, :]) * \
+            jnp.minimum(h[:, None], ah[None, :])
+        union = w[:, None] * h[:, None] + aw[None, :] * ah[None, :] - inter
+        best = jnp.argmax(inter / union, axis=1)            # (G,)
+        scale_of = best // model.na
+        anchor_of = best % model.na
+
+        outs = []
+        for si, S in enumerate(sizes):
+            stride = model.strides[si]
+            gx = jnp.clip((cx / stride).astype(jnp.int32), 0, S - 1)
+            gy = jnp.clip((cy / stride).astype(jnp.int32), 0, S - 1)
+            on = valid & (scale_of == si)
+            tobj = jnp.zeros((S, S, model.na))
+            txy = jnp.zeros((S, S, model.na, 2))
+            twh = jnp.zeros((S, S, model.na, 2))
+            tcls = jnp.zeros((S, S, model.na), jnp.int32)
+            anc = jnp.asarray(model.anchors[si])
+            offx = cx / stride - gx
+            offy = cy / stride - gy
+            lw = jnp.log(jnp.maximum(w / anc[anchor_of, 0], 1e-6))
+            lh = jnp.log(jnp.maximum(h / anc[anchor_of, 1], 1e-6))
+            # padded/other-scale gts scatter OUT OF BOUNDS (index S) so
+            # mode="drop" discards them (negative indices would wrap)
+            gyi = jnp.where(on, gy, S)
+            tobj = tobj.at[gyi, gx, anchor_of].set(jnp.where(on, 1.0, 0.0),
+                                                   mode="drop")
+            txy = txy.at[gyi, gx, anchor_of].set(
+                jnp.where(on[:, None], jnp.stack([offx, offy], -1), 0.0),
+                mode="drop")
+            twh = twh.at[gyi, gx, anchor_of].set(
+                jnp.where(on[:, None], jnp.stack([lw, lh], -1), 0.0),
+                mode="drop")
+            tcls = tcls.at[gyi, gx, anchor_of].set(
+                jnp.where(on, labels, 0).astype(jnp.int32), mode="drop")
+            outs += [tobj, txy, twh, tcls]
+        return tuple(outs)
+
+    import jax
+    flat = apply_op(
+        lambda b, l: jax.vmap(one)(b.astype(jnp.float32),
+                                   l.astype(jnp.int32)),
+        gt_boxes, gt_labels)
+    out = []
+    for si in range(len(sizes)):
+        out.append({"obj": flat[4 * si], "xy": flat[4 * si + 1],
+                    "wh": flat[4 * si + 2], "cls": flat[4 * si + 3]})
+    return out
+
+
+def yolo_loss(preds, targets, num_classes):
+    """GluonCV YOLOV3Loss shape: sigmoid-BCE for center + objectness +
+    class, L2 for log-scale wh, all masked to assigned anchors."""
+    import jax
+    import jax.numpy as jnp
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def one_scale(p, tobj, txy, twh, tcls):
+        p = p.astype(jnp.float32)
+        obj_logit = p[..., 4]
+        obj_loss = bce(obj_logit, tobj).mean()
+        mask = tobj[..., None]
+        denom = jnp.maximum(tobj.sum(), 1.0)
+        xy_loss = (bce(p[..., 0:2], txy) * mask).sum() / denom
+        wh_loss = (jnp.square(p[..., 2:4] - twh) * mask).sum() / denom
+        cls_1h = jax.nn.one_hot(tcls, num_classes)
+        cls_loss = (bce(p[..., 5:], cls_1h) * mask).sum() / denom
+        return obj_loss + xy_loss + 0.5 * wh_loss + cls_loss
+
+    total = None
+    for p, t in zip(preds, targets):
+        part = apply_op(one_scale, p, t["obj"], t["xy"], t["wh"], t["cls"])
+        total = part if total is None else total + part
+    return total
+
+
+def decode_predictions(model, preds, conf_thresh=0.1, nms_thresh=0.45,
+                       topk=100):
+    """Raw heads -> (B, N, 6) rows [class_id, score, x1, y1, x2, y2] after
+    per-class NMS (static shape; suppressed rows have score -1)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one_scale(p, anchors, stride):
+        B, H, W, A, _ = p.shape
+        p = p.astype(jnp.float32)
+        gx = jnp.arange(W)[None, None, :, None]
+        gy = jnp.arange(H)[None, :, None, None]
+        cx = (jax.nn.sigmoid(p[..., 0]) + gx) * stride
+        cy = (jax.nn.sigmoid(p[..., 1]) + gy) * stride
+        pw = jnp.exp(jnp.clip(p[..., 2], -8, 8)) * anchors[:, 0]
+        ph = jnp.exp(jnp.clip(p[..., 3], -8, 8)) * anchors[:, 1]
+        obj = jax.nn.sigmoid(p[..., 4])
+        cls = jax.nn.sigmoid(p[..., 5:])
+        score = obj[..., None] * cls                       # (B,H,W,A,C)
+        cid = jnp.argmax(score, -1).astype(jnp.float32)
+        sc = jnp.max(score, -1)
+        boxes = jnp.stack([cx - pw / 2, cy - ph / 2,
+                           cx + pw / 2, cy + ph / 2], -1)
+        rows = jnp.concatenate(
+            [cid[..., None], sc[..., None], boxes], -1)    # (B,H,W,A,6)
+        return rows.reshape(B, -1, 6)
+
+    parts = []
+    for p, anc, s in zip(preds, model.anchors, model.strides):
+        parts.append(apply_op(one_scale, p,
+                              NDArray(np.asarray(anc, np.float32)),
+                              NDArray(np.asarray(s, np.float32))))
+    rows = F.concat(*parts, dim=1)
+    return F._contrib_box_nms(rows, overlap_thresh=nms_thresh,
+                              valid_thresh=conf_thresh, topk=topk,
+                              coord_start=2, score_index=1, id_index=0)

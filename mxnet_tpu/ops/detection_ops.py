@@ -1,0 +1,145 @@
+"""Detection operators (reference: `src/operator/contrib/bounding_box.cc`
+box_nms/box_iou and `src/operator/contrib/roi_align.cc` ROIAlign).
+
+TPU-first: every op is static-shape. NMS marks suppressed entries with
+score -1 in place of compaction (the reference does the same), so the
+output shape never depends on the data; suppression runs as a fori_loop
+over the fixed candidate count with fully-vectorized IoU rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register
+
+__all__ = ["box_iou", "box_nms", "roi_align"]
+
+
+def _corner_iou(a, b):
+    """IoU of corner-format boxes. a (..., M, 4), b (..., N, 4) ->
+    (..., M, N)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)           # (..., M, 1)
+    bx1, by1, bx2, by2 = [jnp.moveaxis(x, -1, -2)
+                          for x in jnp.split(b, 4, axis=-1)]  # (..., 1, N)
+    ix = jnp.maximum(0.0, jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1))
+    iy = jnp.maximum(0.0, jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1))
+    inter = ix * iy
+    area_a = jnp.maximum(0.0, ax2 - ax1) * jnp.maximum(0.0, ay2 - ay1)
+    area_b = jnp.maximum(0.0, bx2 - bx1) * jnp.maximum(0.0, by2 - by1)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _to_corner(boxes, fmt):
+    if fmt == "corner":
+        return boxes
+    cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+@register("_contrib_box_iou")
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference `_contrib_box_iou`)."""
+    return _corner_iou(_to_corner(lhs.astype(jnp.float32), format),
+                       _to_corner(rhs.astype(jnp.float32), format))
+
+
+@register("_contrib_box_nms")
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference `_contrib_box_nms`).
+
+    data: (..., N, K) rows [.., score at score_index, coords at
+    coord_start:coord_start+4, optional class id at id_index]. Suppressed /
+    invalid rows keep their coords but get score -1 (reference semantics);
+    rows are returned sorted by descending score. topk limits how many
+    survivors keep a score."""
+    d = data.astype(jnp.float32)
+    batch_shape = d.shape[:-2]
+    N, K = d.shape[-2:]
+    d2 = d.reshape((-1, N, K))
+
+    def one(rows):
+        scores = rows[:, score_index]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        rows = rows[order]
+        scores = rows[:, score_index]
+        valid = scores > valid_thresh
+        boxes = _to_corner(rows[:, coord_start:coord_start + 4], in_format)
+        iou = _corner_iou(boxes, boxes)                     # (N, N)
+        if id_index >= 0 and not force_suppress:
+            same = rows[:, id_index][:, None] == rows[:, id_index][None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            alive = keep[i] & valid[i]
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(N) > i) & alive
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, N, body, valid)
+        if topk is not None and topk > 0:
+            rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            keep = keep & (rank < topk)
+        new_scores = jnp.where(keep, scores, -1.0)
+        return rows.at[:, score_index].set(new_scores)
+
+    out = jax.vmap(one)(d2).reshape(batch_shape + (N, K))
+    return out.astype(data.dtype)
+
+
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False):
+    """ROIAlign (reference `_contrib_ROIAlign`, Mask R-CNN style: NO pixel
+    shift, bilinear-sampled grid points averaged per output bin).
+
+    data: (B, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords. Returns (R, C, PH, PW). A negative batch_idx yields zeros
+    (the reference uses that for padded rois)."""
+    if position_sensitive:
+        raise NotImplementedError("position_sensitive ROIAlign")
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+    x = data.astype(jnp.float32)
+    r = rois.astype(jnp.float32)
+    S = int(sample_ratio) if sample_ratio and sample_ratio > 0 else 2
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / PW, rh / PH
+        # S x S sample points per bin, bilinear each, then averaged
+        sy = y1 + (jnp.arange(PH * S) + 0.5) * (bin_h / S)   # (PH*S,)
+        sx = x1 + (jnp.arange(PW * S) + 0.5) * (bin_w / S)   # (PW*S,)
+        sy = jnp.clip(sy, 0.0, H - 1.0)
+        sx = jnp.clip(sx, 0.0, W - 1.0)
+        y0 = jnp.floor(sy).astype(jnp.int32)
+        x0 = jnp.floor(sx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        wy = sy - y0
+        wx = sx - x0
+        img = x[jnp.maximum(bidx, 0)]                        # (C, H, W)
+        # gather 4 corners: (C, PH*S, PW*S)
+        v00 = img[:, y0[:, None], x0[None, :]]
+        v01 = img[:, y0[:, None], x1i[None, :]]
+        v10 = img[:, y1i[:, None], x0[None, :]]
+        v11 = img[:, y1i[:, None], x1i[None, :]]
+        wy_ = wy[:, None]
+        wx_ = wx[None, :]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+               v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        pooled = val.reshape(C, PH, S, PW, S).mean(axis=(2, 4))
+        return jnp.where(bidx >= 0, pooled, jnp.zeros_like(pooled))
+
+    out = jax.vmap(one)(r)
+    return out.astype(data.dtype)

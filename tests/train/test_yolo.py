@@ -1,0 +1,138 @@
+"""YOLOv3-tiny (BASELINE workload #4 family) + VOC mAP metric."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.metric import VOC07MApMetric
+from mxnet_tpu.models import yolo as Y
+
+IMG, C, MAXGT = 64, 3, 4
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _synthetic(rng, batch):
+    """Images with one bright square per image; the box is the label."""
+    imgs = np.zeros((batch, 3, IMG, IMG), np.float32)
+    boxes = np.full((batch, MAXGT, 4), 0.0, np.float32)
+    labels = np.full((batch, MAXGT), -1.0, np.float32)
+    for b in range(batch):
+        size = rng.randint(12, 28)
+        x = rng.randint(0, IMG - size)
+        y = rng.randint(0, IMG - size)
+        cls = rng.randint(0, C)
+        imgs[b, cls, y:y + size, x:x + size] = 1.0
+        boxes[b, 0] = (x, y, x + size, y + size)
+        labels[b, 0] = cls
+    return imgs, boxes, labels
+
+
+def test_forward_shapes():
+    m = Y.YOLOv3Tiny(num_classes=C, image_size=IMG)
+    mx.random.seed(0)
+    m.initialize()
+    outs = m(nd.array(np.zeros((2, 3, IMG, IMG), np.float32)))
+    assert outs[0].shape == (2, IMG // 32, IMG // 32, 3, 5 + C)
+    assert outs[1].shape == (2, IMG // 16, IMG // 16, 3, 5 + C)
+
+
+def test_targets_mark_correct_cell():
+    m = Y.YOLOv3Tiny(num_classes=C, image_size=IMG)
+    mx.random.seed(0)
+    m.initialize()
+    boxes = np.zeros((1, MAXGT, 4), np.float32)
+    labels = np.full((1, MAXGT), -1.0, np.float32)
+    boxes[0, 0] = (13, 14, 19, 22)               # 6x8 box, center (16, 18)
+    labels[0, 0] = 2
+    tgts = Y.yolo_targets(m, nd.array(boxes), nd.array(labels))
+    total_obj = sum(float(t["obj"].sum().asscalar()) for t in tgts)
+    assert total_obj == 1.0                      # exactly one anchor assigned
+    # a 6x8 box best matches the fine-scale anchors (stride 16 at IMG=64)
+    fine = tgts[1]
+    obj = fine["obj"].asnumpy()[0]
+    yx = np.argwhere(obj > 0)
+    assert len(yx) == 1
+    gy, gx, _ = yx[0]
+    assert (gy, gx) == (18 // 16, 16 // 16)
+    assert int(fine["cls"].asnumpy()[0, gy, gx].max()) == 2
+
+
+def test_yolo_trains_on_synthetic_boxes():
+    rng = np.random.RandomState(0)
+    m = Y.YOLOv3Tiny(num_classes=C, image_size=IMG)
+    mx.random.seed(1)
+    m.initialize()
+    parallel.make_mesh(dp=-1)
+
+    def loss_fn(p13, p26, boxes, labels):
+        tgts = Y.yolo_targets(m, boxes, labels)
+        return Y.yolo_loss([p13, p26], tgts, C)
+
+    tr = parallel.ShardedTrainer(m, loss_fn, "adam", {"learning_rate": 2e-3})
+    imgs, boxes, labels = _synthetic(rng, 16)
+    first = last = None
+    for i in range(12):
+        loss = tr.step([nd.array(imgs)], [nd.array(boxes), nd.array(labels)])
+        v = float(loss.asscalar())
+        first = v if first is None else first
+        last = v
+    assert np.isfinite(last)
+    assert last < 0.5 * first, (first, last)
+
+
+def test_decode_and_nms_shapes():
+    m = Y.YOLOv3Tiny(num_classes=C, image_size=IMG)
+    mx.random.seed(0)
+    m.initialize()
+    outs = m(nd.array(np.random.RandomState(0)
+                      .rand(2, 3, IMG, IMG).astype(np.float32)))
+    det = Y.decode_predictions(m, outs, conf_thresh=0.0, topk=10)
+    n_anchors = 3 * ((IMG // 32) ** 2 + (IMG // 16) ** 2)
+    assert det.shape == (2, n_anchors, 6)
+    d = det.asnumpy()
+    assert (d[:, :, 1] > 0).sum(axis=1).max() <= 10   # topk respected
+
+
+def test_voc_map_metric_hand_cases():
+    m = VOC07MApMetric(iou_thresh=0.5)
+    # one image: 2 gts of class 0; detections: one perfect match (tp), one
+    # duplicate on the same gt (fp), one miss (fp), second gt undetected
+    labels = np.asarray([[[0, 0, 0, 10, 10], [0, 20, 20, 30, 30],
+                          [-1, 0, 0, 0, 0]]], np.float32)
+    preds = np.asarray([[[0, 0.9, 0, 0, 10, 10],
+                         [0, 0.8, 1, 1, 10, 10],
+                         [0, 0.7, 50, 50, 60, 60]]], np.float32)
+    m.update(labels, preds)
+    name, val = m.get()
+    # recall reaches 0.5 with precision 1 -> 11-pt AP = 6/11
+    np.testing.assert_allclose(val, 6 / 11, atol=1e-6)
+    # perfect detector on a fresh metric
+    m2 = VOC07MApMetric()
+    preds2 = np.asarray([[[0, 0.9, 0, 0, 10, 10],
+                          [0, 0.8, 20, 20, 30, 30],
+                          [-1, -1, 0, 0, 0, 0]]], np.float32)
+    m2.update(labels, preds2)
+    assert m2.get()[1] == pytest.approx(1.0)
+
+
+def test_voc_map_accepts_ndarray_lists():
+    """Module.update_metric passes LISTS of NDArrays."""
+    m = VOC07MApMetric()
+    labels = nd.array(np.asarray([[[0, 0, 0, 10, 10]]], np.float32))
+    preds = nd.array(np.asarray([[[0, 0.9, 0, 0, 10, 10]]], np.float32))
+    m.update([labels], [preds])
+    assert m.get()[1] == pytest.approx(1.0)
+
+
+def test_voc_map_ignores_suppressed_rows():
+    m = VOC07MApMetric()
+    labels = np.asarray([[[1, 0, 0, 10, 10]]], np.float32)
+    preds = np.asarray([[[1, -1.0, 0, 0, 10, 10],     # nms-suppressed
+                         [1, 0.9, 0, 0, 10, 10]]], np.float32)
+    m.update(labels, preds)
+    assert m.get()[1] == pytest.approx(1.0)

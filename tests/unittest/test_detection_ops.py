@@ -1,0 +1,154 @@
+"""Detection op oracle tests (reference:
+tests/python/unittest/test_operator.py test_box_nms / test_roialign —
+checked against independent numpy implementations)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import ndarray as F
+
+
+def np_iou(a, b):
+    ix = np.maximum(0, np.minimum(a[:, None, 2], b[None, :, 2]) -
+                    np.maximum(a[:, None, 0], b[None, :, 0]))
+    iy = np.maximum(0, np.minimum(a[:, None, 3], b[None, :, 3]) -
+                    np.maximum(a[:, None, 1], b[None, :, 1]))
+    inter = ix * iy
+    aa = np.maximum(0, a[:, 2] - a[:, 0]) * np.maximum(0, a[:, 3] - a[:, 1])
+    ab = np.maximum(0, b[:, 2] - b[:, 0]) * np.maximum(0, b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa[:, None] + ab[None, :] - inter, 1e-12)
+
+
+def np_nms(rows, thresh, valid_thresh, topk, score_i, coord_s, id_i,
+           force):
+    order = np.argsort(-np.where(rows[:, score_i] > valid_thresh,
+                                 rows[:, score_i], -np.inf), kind="stable")
+    rows = rows[order].copy()
+    N = len(rows)
+    keep = rows[:, score_i] > valid_thresh
+    iou = np_iou(rows[:, coord_s:coord_s + 4], rows[:, coord_s:coord_s + 4])
+    for i in range(N):
+        if not keep[i]:
+            continue
+        for j in range(i + 1, N):
+            if not keep[j]:
+                continue
+            if iou[i, j] > thresh and (force or id_i < 0 or
+                                       rows[i, id_i] == rows[j, id_i]):
+                keep[j] = False
+    if topk > 0:
+        cnt = 0
+        for i in range(N):
+            if keep[i]:
+                cnt += 1
+                if cnt > topk:
+                    keep[i] = False
+    rows[:, score_i] = np.where(keep, rows[:, score_i], -1.0)
+    return rows
+
+
+@pytest.mark.parametrize("force", [True, False])
+@pytest.mark.parametrize("topk", [-1, 3])
+def test_box_nms_matches_numpy_oracle(force, topk):
+    rng = np.random.RandomState(0)
+    N = 24
+    for trial in range(3):
+        xy = rng.rand(N, 2) * 4
+        wh = rng.rand(N, 2) * 2 + 0.1
+        boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        scores = rng.rand(N).astype(np.float32)
+        ids = rng.randint(0, 3, N).astype(np.float32)
+        rows = np.concatenate(
+            [ids[:, None], scores[:, None], boxes], axis=1)
+        out = F._contrib_box_nms(
+            nd.array(rows), overlap_thresh=0.5, valid_thresh=0.1,
+            topk=topk, coord_start=2, score_index=1, id_index=0,
+            force_suppress=force).asnumpy()
+        ref = np_nms(rows, 0.5, 0.1, topk, 1, 2, 0, force)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_batched():
+    rng = np.random.RandomState(1)
+    rows = rng.rand(2, 8, 6).astype(np.float32)
+    out = F._contrib_box_nms(nd.array(rows), overlap_thresh=0.5,
+                             valid_thresh=0.0, id_index=-1).asnumpy()
+    assert out.shape == (2, 8, 6)
+    for b in range(2):
+        ref = np_nms(rows[b], 0.5, 0.0, -1, 1, 2, -1, False)
+        np.testing.assert_allclose(out[b], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_corner_and_center():
+    a = np.asarray([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.asarray([[0, 0, 2, 2], [10, 10, 11, 11]], np.float32)
+    out = F._contrib_box_iou(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np_iou(a, b), rtol=1e-6)
+    # center format: same boxes expressed as (cx, cy, w, h)
+    ac = np.asarray([[1, 1, 2, 2], [2, 2, 2, 2]], np.float32)
+    bc = np.asarray([[1, 1, 2, 2], [10.5, 10.5, 1, 1]], np.float32)
+    out_c = F._contrib_box_iou(nd.array(ac), nd.array(bc),
+                               format="center").asnumpy()
+    np.testing.assert_allclose(out_c, out, rtol=1e-6)
+
+
+def np_roi_align(data, rois, pooled, scale, S):
+    B, C, H, W = data.shape
+    PH, PW = pooled
+    R = len(rois)
+    out = np.zeros((R, C, PH, PW), np.float32)
+
+    def bilinear(img, y, x):
+        y = min(max(y, 0.0), H - 1.0)
+        x = min(max(x, 0.0), W - 1.0)
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        wy, wx = y - y0, x - x0
+        return (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+                img[:, y0, x1] * (1 - wy) * wx +
+                img[:, y1, x0] * wy * (1 - wx) +
+                img[:, y1, x1] * wy * wx)
+
+    for r in range(R):
+        bidx = int(rois[r, 0])
+        if bidx < 0:
+            continue
+        x1, y1, x2, y2 = rois[r, 1:] * scale
+        rw, rh = max(x2 - x1, 1.0), max(y2 - y1, 1.0)
+        bw, bh = rw / PW, rh / PH
+        for ph in range(PH):
+            for pw in range(PW):
+                acc = 0.0
+                for iy in range(S):
+                    for ix in range(S):
+                        sy = y1 + ph * bh + (iy + 0.5) * bh / S
+                        sx = x1 + pw * bw + (ix + 0.5) * bw / S
+                        acc += bilinear(data[bidx], sy, sx)
+                out[r, :, ph, pw] = acc / (S * S)
+    return out
+
+
+def test_roi_align_matches_numpy_oracle():
+    rng = np.random.RandomState(2)
+    data = rng.rand(2, 3, 16, 16).astype(np.float32)
+    rois = np.asarray([[0, 1.0, 1.0, 9.0, 13.0],
+                       [1, 0.0, 0.0, 15.0, 15.0],
+                       [0, 4.2, 3.7, 12.8, 9.1],
+                       [-1, 0, 0, 5, 5]], np.float32)
+    out = F._contrib_ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(4, 4), spatial_scale=1.0,
+                              sample_ratio=2).asnumpy()
+    ref = np_roi_align(data, rois, (4, 4), 1.0, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    assert np.all(out[3] == 0)                 # padded roi -> zeros
+
+
+def test_roi_align_spatial_scale():
+    rng = np.random.RandomState(3)
+    data = rng.rand(1, 2, 8, 8).astype(np.float32)
+    rois = np.asarray([[0, 8.0, 8.0, 56.0, 56.0]], np.float32)  # /8 scale
+    out = F._contrib_ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=0.125,
+                              sample_ratio=2).asnumpy()
+    ref = np_roi_align(data, rois, (2, 2), 0.125, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
