@@ -34,6 +34,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
     if num_processes <= 1:
         return
     import jax
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # CPU multi-process collectives need an explicit transport; gloo is
+        # compiled into stock jaxlib (used for the launcher test harness —
+        # the reference's "multi-node as multi-process on localhost"
+        # pattern, SURVEY §4)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,82 @@
+"""Worker for the localhost multi-process test (launched by launch.py via
+test_multiprocess.py — NOT collected by pytest directly).
+
+Each of 2 processes owns one CPU device and half the global batch; the
+trainer must produce the SAME loss trajectory as a single-process run on
+the full batch (the gradient-sum invariant the reference checks in
+tests/nightly/dist_sync_kvstore.py)."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""                 # exactly 1 device per process
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.parallel import specs
+
+STEPS = 3
+
+
+def make_batches():
+    rng = np.random.RandomState(0)
+    return [(rng.randn(8, 8).astype(np.float32),
+             rng.randint(0, 4, 8).astype(np.float32))
+            for _ in range(STEPS)]
+
+
+def main():
+    parallel.init_distributed()
+    assert parallel.is_distributed(), "distributed init did not run"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.devices()
+    rank = jax.process_index()
+
+    mesh = parallel.make_mesh(dp=-1)
+    assert dict(mesh.shape)["dp"] == 2
+
+    # raw psum sanity: 1 + 2 across ranks
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    local = np.full((1, 4), rank + 1.0, np.float32)
+    g = jax.make_array_from_process_local_data(
+        specs.batch_spec(2, mesh), local)
+    out = jax.jit(shard_map(lambda a: jax.lax.psum(a, ("dp", "fsdp")),
+                            mesh=mesh, in_specs=P(("dp", "fsdp")),
+                            out_specs=P(("dp", "fsdp"))))(g)
+    got = float(np.asarray(jax.device_get(out.addressable_shards[0].data))[0, 0])
+    assert got == 3.0, f"psum got {got}"
+
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                                 {"learning_rate": 0.1})
+
+    half = 8 // 2
+    for X, y in make_batches():
+        Xg = jax.make_array_from_process_local_data(
+            specs.batch_spec(2, mesh), X[rank * half:(rank + 1) * half])
+        yg = jax.make_array_from_process_local_data(
+            specs.batch_spec(1, mesh), y[rank * half:(rank + 1) * half])
+        loss = tr.step([NDArray(Xg)], [NDArray(yg)])
+        print(f"LOSS {float(loss.asscalar()):.6f}", flush=True)
+    print(f"WORKER_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
