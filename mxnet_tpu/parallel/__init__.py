@@ -4,21 +4,23 @@ See SURVEY.md §2.4/§2.5 — this package is the TPU-native replacement for the
 reference's KVStore transports and the home of the net-new parallelism the
 reference lacks (tensor, pipeline, sequence/ring)."""
 from .mesh import (make_mesh, MeshPlan, current_mesh, set_mesh, named_sharding,
-                   PartitionSpec)
+                   PartitionSpec, local_mesh_devices)
 from . import specs
 from .specs import batch_spec, param_spec, fsdp_spec, replicated, apply_tp_rules
 from .functional_opt import FunctionalOptimizer
 from .trainer import ShardedTrainer
 from .ring_attention import ring_attention, ring_self_attention
-from .pipeline import pipeline_apply, pipeline_shard_map
+from .pipeline import (pipeline_apply, pipeline_shard_map,
+                       pipeline_apply_hetero, PipelineTrainer)
 from .distributed import init_distributed, is_distributed
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .moe import moe_apply, moe_ffn
 
 __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding",
-           "PartitionSpec", "specs", "batch_spec", "param_spec", "fsdp_spec",
-           "replicated", "apply_tp_rules", "FunctionalOptimizer",
-           "ShardedTrainer", "ring_attention", "ring_self_attention",
-           "pipeline_apply", "pipeline_shard_map", "init_distributed",
+           "PartitionSpec", "local_mesh_devices", "specs", "batch_spec",
+           "param_spec", "fsdp_spec", "replicated", "apply_tp_rules",
+           "FunctionalOptimizer", "ShardedTrainer", "ring_attention",
+           "ring_self_attention", "pipeline_apply", "pipeline_shard_map",
+           "pipeline_apply_hetero", "PipelineTrainer", "init_distributed",
            "is_distributed", "ulysses_attention", "ulysses_self_attention",
            "moe_apply", "moe_ffn"]

@@ -1,17 +1,28 @@
 """Pipeline parallelism: stage-sharded execution with microbatching.
 
 Net-new vs the reference (SURVEY.md §2.4 — MXNet's only model parallelism is
-coarse `group2ctx` layer placement). GPipe-style schedule expressed the TPU
-way: stages live on the `pp` mesh axis, activations move stage-to-stage with
-`lax.ppermute` (ICI collective-permute), and the fill/drain bubble comes from
-a static fori_loop of length M + S - 1.
+coarse `group2ctx` layer placement). The schedule is expressed the TPU way:
+stages live on the `pp` mesh axis, activations move stage-to-stage with
+`lax.ppermute` (ICI collective-permute), and the fill/drain bubble comes
+from a static `lax.scan` of length M + S - 1 — scan, not fori_loop, so the
+WHOLE pipeline is differentiable and trains end-to-end under `jax.grad`.
 
-Constraint (standard for collective pipelines): every stage maps activations
-of one fixed shape to the same shape.
+Memory: each stage function is rematerialized (`jax.checkpoint`), so the
+backward pass recomputes stage activations per microbatch and only the
+stage-boundary activations are stashed — the 1F1B activation footprint
+(O(M) boundaries, not O(M x layers) full stashes); the fwd/bwd compute
+interleaving itself is left to XLA's scheduler.
+
+Two entry points:
+  * homogeneous (`pipeline_shard_map`): every stage runs the SAME function
+    with per-stage parameters STACKED over `pp` (weights sharded S-ways).
+  * heterogeneous (`pipeline_apply_hetero` / `PipelineTrainer`): per-stage
+    DIFFERENT functions (embed / encoder blocks / ...) selected by
+    `lax.switch` on the stage index. Parameters are replicated (compute
+    shards over stages, weight memory does not) — the standard trade for
+    branchy SPMD pipelines; use the homogeneous path when stages repeat.
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -20,49 +31,57 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import current_mesh
 
-__all__ = ["pipeline_apply", "pipeline_shard_map"]
+__all__ = ["pipeline_apply", "pipeline_shard_map", "pipeline_apply_hetero",
+           "PipelineTrainer"]
 
 
-def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
-    """Run inside shard_map. stage_params: this device's stage parameters;
-    microbatches: (M, mb, ...) the full input, replicated across stages.
+def _schedule(n, sid, M, axis_name, step_fn, state0):
+    """Shared fill/drain scan. step_fn(t, x_state) -> y; returns (M, ...)
+    last-stage outputs replicated across stages. Differentiable."""
+    steps = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(state, t):
+        y = step_fn(t, state)
+        state = lax.ppermute(y, axis_name, perm)
+        return state, y
+
+    _, ys = lax.scan(body, state0, jnp.arange(steps))
+    # microbatch m leaves the last stage at step m + n - 1
+    outs = ys[n - 1:]
+    # broadcast the last stage's outputs to every stage (differentiable:
+    # the transpose of this masked psum routes cotangents back to stage n-1)
+    outs = lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+    return outs
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp",
+                   remat=True):
+    """Homogeneous pipeline body (run inside shard_map). stage_params: this
+    device's stage parameters; microbatches: (M, mb, ...) replicated.
     Returns (M, mb, ...) outputs of the LAST stage, replicated."""
     n = lax.psum(1, axis_name)
     sid = lax.axis_index(axis_name)
     M = microbatches.shape[0]
-    steps = M + n - 1
-    mb_shape = microbatches.shape[1:]
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    state = jnp.zeros(mb_shape, microbatches.dtype)
-    outs = jnp.zeros((M,) + mb_shape, microbatches.dtype)
-
-    def body(t, carry):
-        state, outs = carry
+    def step(t, state):
         mb_idx = jnp.clip(t, 0, M - 1)
-        x_in = jnp.where(sid == 0,
-                         lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
-                                                  keepdims=False),
-                         state)
-        y = stage_fn(stage_params, x_in)
-        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
-        write = jnp.logical_and(sid == n - 1, t >= n - 1)
-        prev = lax.dynamic_index_in_dim(outs, out_idx, 0, keepdims=False)
-        outs = lax.dynamic_update_index_in_dim(
-            outs, jnp.where(write, y, prev), out_idx, 0)
-        state = lax.ppermute(y, axis_name, perm)
-        return state, outs
+        x_in = jnp.where(
+            sid == 0,
+            lax.dynamic_index_in_dim(microbatches, mb_idx, 0, keepdims=False),
+            state)
+        return fn(stage_params, x_in)
 
-    state, outs = lax.fori_loop(0, steps, body, (state, outs))
-    # broadcast the last stage's outputs to every stage
-    outs = lax.psum(jnp.where(sid == n - 1, outs, jnp.zeros_like(outs)), axis_name)
-    return outs
+    state0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    return _schedule(n, sid, M, axis_name, step, state0)
 
 
 def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
-                       axis_name="pp"):
-    """Top-level helper: stacked_params pytree with leading stage dim sharded
-    over `pp`; microbatches (M, mb, ...) replicated."""
+                       axis_name="pp", remat=True):
+    """Top-level homogeneous helper: stacked_params pytree with leading
+    stage dim sharded over `pp`; microbatches (M, mb, ...) replicated."""
     from jax import shard_map
 
     mesh = mesh or current_mesh()
@@ -70,7 +89,267 @@ def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
 
     def fn(params_local, mb):
         params_local = jax.tree.map(lambda a: a[0], params_local)  # drop stage dim
-        return pipeline_apply(stage_fn, params_local, mb, axis_name)
+        return pipeline_apply(stage_fn, params_local, mb, axis_name, remat)
 
     return shard_map(fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
                      check_vma=False)(stacked_params, microbatches)
+
+
+def pipeline_apply_hetero(stage_fns, stage_params, microbatch_inputs,
+                          act_shape_dtype, axis_name="pp", remat=True,
+                          rng=None):
+    """Heterogeneous pipeline body (run inside shard_map).
+
+    stage_fns: list of S callables. stage_fns[0](params[0], *mb_inputs) maps
+    one microbatch of RAW inputs (tokens etc.) to an activation; every later
+    stage_fns[i](params[i], act) maps activation -> activation of the SAME
+    shape (the ppermute carrier). stage_params: per-stage pytrees,
+    replicated on every device. microbatch_inputs: tuple of (M, mb, ...)
+    arrays. act_shape_dtype: (shape, dtype) of the carried activation.
+    rng: optional PRNG key; each stage call receives it folded with
+    (step, stage id) as RAW key data — typed-key avals cannot cross the
+    switch/remat boundary (they break scan partial-eval residual joining,
+    a verified jax limitation), so stage fns take
+    (params, rng_data, *inputs) and must rebuild the key themselves with
+    `jax.random.wrap_key_data(rng_data, impl=...)` INSIDE the function.
+    Returns (M,) + act_shape last-stage outputs, replicated."""
+    n = lax.psum(1, axis_name)
+    sid = lax.axis_index(axis_name)
+    M = microbatch_inputs[0].shape[0]
+    shape, dtype = act_shape_dtype
+    if rng is None:
+        rng = jax.random.key(0)
+
+    fns = [jax.checkpoint(f) if remat else f for f in stage_fns]
+
+    def step(t, state):
+        mb_idx = jnp.clip(t, 0, M - 1)
+        mb = [lax.dynamic_index_in_dim(x, mb_idx, 0, keepdims=False)
+              for x in microbatch_inputs]
+        rng_data = jax.random.key_data(
+            jax.random.fold_in(jax.random.fold_in(rng, t), sid))
+
+        branches = [
+            (lambda st, fn=fns[0], p=stage_params[0]:
+                fn(p, rng_data, *mb).astype(dtype))
+        ] + [
+            (lambda st, fn=f, p=p: fn(p, rng_data, st).astype(dtype))
+            for f, p in zip(fns[1:], stage_params[1:])
+        ]
+        return lax.switch(jnp.minimum(sid, len(branches) - 1), branches, state)
+
+    state0 = jnp.zeros(shape, dtype)
+    return _schedule(n, sid, M, axis_name, step, state0)
+
+
+class PipelineTrainer:
+    """Train a list of gluon stage blocks over the `pp` mesh axis.
+
+    stages[0] consumes the raw per-microbatch inputs and produces the
+    pipeline activation; stages[1:] map activation -> same-shape activation.
+    `head` (optional gluon block or callable over NDArrays) runs OUTSIDE
+    the pipeline on the last stage's full-batch output, followed by
+    loss_fn(head_out, *labels). One jitted step: forward pipeline, loss,
+    backward through the scan/ppermute schedule, optimizer.
+
+    Reference: net-new per SURVEY §2.4 (the reference has no pipeline
+    schedule; its Module/kvstore path cannot express one).
+    """
+
+    def __init__(self, stages, loss_fn, optimizer="sgd", optimizer_params=None,
+                 head=None, num_microbatches=4, mesh=None, axis_name="pp"):
+        from .. import optimizer as opt_mod
+        from .functional_opt import FunctionalOptimizer
+
+        self.stages = list(stages)
+        self.head = head
+        self.loss_fn = loss_fn
+        self.mesh = mesh or current_mesh()
+        self.axis = axis_name
+        self.M = num_microbatches
+        if self.mesh.shape.get(axis_name, 1) != len(self.stages):
+            raise ValueError(
+                f"pipeline axis '{axis_name}' has "
+                f"{self.mesh.shape.get(axis_name, 1)} devices but "
+                f"{len(self.stages)} stages were given; they must match "
+                "(extra stages would silently never run)")
+        self._opt = opt_mod.create(optimizer, **(optimizer_params or {})) \
+            if isinstance(optimizer, str) else optimizer
+        self._fopt_cls = FunctionalOptimizer
+        self.num_update = 0
+        self._step_cache = {}
+        self._ready = False
+
+    def _setup(self):
+        from ..gluon.block import functional_call
+
+        self._stage_fns = []
+        self._stage_params = []
+        names = []
+        for si, blk in enumerate(self.stages):
+            pure, gp, aux = functional_call(blk, train=True)
+            if aux:
+                raise NotImplementedError(
+                    "aux state (BatchNorm moving stats) inside pipeline "
+                    "stages is not supported; use LayerNorm")
+            self._stage_fns.append(pure)
+            self._stage_params.append(gp)
+            names += [f"stage{si}.{n}" for n, _ in gp]
+        head_gp = []
+        self._head_fn = None
+        self._head_plain = None
+        if self.head is not None:
+            if hasattr(self.head, "_param_lists"):
+                head_pure, head_gp, head_aux = functional_call(
+                    self.head, train=True)
+                if head_aux:
+                    raise NotImplementedError("aux state in pipeline head")
+                self._head_fn = head_pure
+            elif callable(self.head):
+                self._head_plain = self.head     # parameterless NDArray fn
+            else:
+                raise TypeError(
+                    f"head must be a gluon block or callable, got "
+                    f"{type(self.head).__name__}")
+        self._head_params = head_gp
+        names += [f"head.{n}" for n, _ in head_gp]
+        self.fopt = self._fopt_cls(self._opt, names)
+
+        flat = [p.data()._data for gp in self._stage_params for _, p in gp]
+        flat += [p.data()._data for _, p in head_gp]
+        from . import specs as _specs
+        rep = _specs.replicated(self.mesh)
+        self._rep = rep
+        self.params = [jax.device_put(d, rep) for d in flat]
+        self.opt_state = [tuple(jax.device_put(z, rep) for z in st)
+                          for st in self.fopt.init(self.params)]
+        self._ready = True
+
+    def _split_params(self, flat):
+        """flat list -> (per-stage lists, head list)."""
+        out, i = [], 0
+        for gp in self._stage_params:
+            out.append(list(flat[i:i + len(gp)]))
+            i += len(gp)
+        return out, list(flat[i:])
+
+    def _build_step(self, n_data, act_sd):
+        from jax import shard_map
+        from ..ndarray import NDArray
+        from .. import _engine
+        from .trainer import call_loss
+
+        M, axis = self.M, self.axis
+        stage_fns = self._stage_fns
+        head_fn = self._head_fn
+        head_plain = self._head_plain
+        loss_fn = self.loss_fn
+        fopt = self.fopt
+        mesh = self.mesh
+
+        from .. import random as _random
+        impl = jax.random.key_impl(_random.get_state())
+
+        def fwd_pipeline(stage_param_lists, mb_inputs, rng):
+            def make_stage(pure):
+                def f(params, rng_data, *xs):
+                    # rebuild the typed key INSIDE the (checkpointed) stage
+                    # so no key-typed aval becomes a switch-branch residual
+                    key = jax.random.wrap_key_data(rng_data, impl=impl)
+                    outs, _ = pure(params, [], key,
+                                   *[jnp.asarray(x) for x in xs])
+                    return outs[0]
+                return f
+
+            fns = [make_stage(p) for p in stage_fns]
+            return pipeline_apply_hetero(
+                fns, stage_param_lists, tuple(mb_inputs), act_sd, axis,
+                rng=rng)
+
+        sharded_fwd = shard_map(
+            fwd_pipeline, mesh=mesh,
+            in_specs=(P(), P(), P()), out_specs=P(), check_vma=False)
+
+        def step(params, opt_state, t, lr, rng, *batch):
+            data, labels = batch[:n_data], batch[n_data:]
+
+            def loss_of(flat):
+                stage_lists, head_list = self._split_params(flat)
+                # (B, ...) -> (M, mb, ...)
+                mbs = [d.reshape((M, d.shape[0] // M) + d.shape[1:])
+                       for d in data]
+                acts = sharded_fwd(stage_lists, mbs, rng)  # (M, mb, ...)
+                full = acts.reshape((-1,) + acts.shape[2:])
+                if head_fn is not None:
+                    outs, _ = head_fn(head_list, [], rng, full)
+                    out = outs[0]
+                elif head_plain is not None:
+                    prev = _engine.set_recording(False)
+                    try:
+                        out_nd = head_plain(NDArray(full))
+                    finally:
+                        _engine.set_recording(prev)
+                    out = out_nd._data if isinstance(out_nd, NDArray) else out_nd
+                else:
+                    out = full
+                return call_loss(loss_fn, rng, [out], labels)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(params))
+            new_params, new_opt = fopt.apply(params, grads, opt_state, t, lr)
+            return loss, new_params, new_opt
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _probe_act(self, data):
+        """Eager forward through the stages to learn the activation shape
+        for THIS input geometry (per-shape: seq-length changes change the
+        carrier shape, so one probe at init is not enough)."""
+        from .. import _engine
+        if self._ready:
+            # the blocks' own arrays were donated into the jitted step;
+            # refresh them from live device state before probing eagerly
+            self.sync_to_block()
+        prev = _engine.set_recording(False)
+        try:
+            x = self.stages[0](*data)
+            for s in self.stages[1:]:
+                x = s(x)
+        finally:
+            _engine.set_recording(prev)
+        return ((data[0].shape[0] // self.M,) + x.shape[1:], x._data.dtype)
+
+    def step(self, data, labels):
+        from ..ndarray import NDArray
+        from .. import random as _random
+
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        probed = None
+        if not self._ready:
+            probed = self._probe_act(data)  # resolves deferred param shapes
+            self._setup()
+        batch = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                 for b in list(data) + list(labels)]
+        if batch[0].shape[0] % self.M:
+            raise ValueError(
+                f"batch {batch[0].shape[0]} not divisible by "
+                f"num_microbatches={self.M}")
+        shapes = tuple(b.shape for b in batch)
+        key = (len(data), shapes)
+        if key not in self._step_cache:
+            act_sd = probed or self._probe_act(data)
+            self._step_cache[key] = self._build_step(len(data), act_sd)
+        self.num_update += 1
+        t = jnp.asarray(self.num_update, jnp.float32)
+        lr = jnp.asarray(self.fopt.lr_at(self.num_update), jnp.float32)
+        loss, self.params, self.opt_state = self._step_cache[key](
+            self.params, self.opt_state, t, lr, _random.next_key(), *batch)
+        return NDArray(loss)
+
+    def sync_to_block(self):
+        stage_lists, head_list = self._split_params(self.params)
+        for gp, vals in zip(self._stage_params, stage_lists):
+            for (_, p), v in zip(gp, vals):
+                p.data()._data = v
+        for (_, p), v in zip(self._head_params, head_list):
+            p.data()._data = v
