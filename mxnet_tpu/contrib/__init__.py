@@ -1,9 +1,10 @@
 """Contrib subpackage (reference: `python/mxnet/contrib/`).
 
 Provided: `amp` (automatic mixed precision — bf16-first on TPU),
-`quantization` (int8 post-training quantization). ONNX import/export is
-intentionally not provided in this build; `mxnet_tpu.symbol` JSON plus
-`.params` files are the interchange formats.
+`quantization` (int8 post-training quantization), `onnx` (export/import of
+Symbol graphs for the model_zoo vision op subset, serialized by an
+in-tree ONNX wire codec — the environment bakes no `onnx` package).
 """
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,313 @@
+"""ONNX export/import for Symbol graphs (reference:
+`python/mxnet/contrib/onnx/` mx2onnx + onnx2mx, ~10k LoC upstream).
+
+Subset scoped to the model_zoo vision family: Convolution, BatchNorm,
+Activation, Pooling (incl. global), FullyConnected, Flatten, elementwise
+add/mul, Concat, Dropout, softmax. Serialization is the in-tree wire
+codec (`_proto.py`) — the environment bakes no `onnx` package, but files
+written here follow the public ONNX IR (opset 13) byte for byte.
+
+API (mirrors mx.contrib.onnx):
+    export_model(sym, params, input_shapes, onnx_file, input_dtype)
+    import_model(onnx_file) -> (sym, arg_params, aux_params)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+__all__ = ["export_model", "import_model"]
+
+
+# -- export -----------------------------------------------------------------
+
+def _ints(v, n=None):
+    if v is None:
+        return [1] * (n or 2)
+    if np.isscalar(v):
+        return [int(v)] * (n or 2)
+    return [int(x) for x in v]
+
+
+def _attr(attrs, key, default=None):
+    v = attrs.get(key, default)
+    if isinstance(v, str):
+        try:
+            import ast
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _export_node(node, in_names, out_name):
+    """One Symbol _Node -> list of NodeProto bytes."""
+    op = node.op
+    a = node.attrs
+    nm = node.name
+
+    def n1(op_type, attrs=None, inputs=None, outputs=None):
+        return [P.node(op_type, inputs or in_names, outputs or [out_name],
+                       name=nm, attrs=attrs or {})]
+
+    if op == "Convolution":
+        kernel = _ints(_attr(a, "kernel"))
+        attrs = {"kernel_shape": kernel,
+                 "strides": _ints(_attr(a, "stride"), len(kernel)),
+                 "dilations": _ints(_attr(a, "dilate"), len(kernel)),
+                 "pads": _ints(_attr(a, "pad", 0), len(kernel)) * 2,
+                 "group": int(_attr(a, "num_group", 1))}
+        return n1("Conv", attrs)
+    if op == "BatchNorm":
+        attrs = {"epsilon": float(_attr(a, "eps", 1e-5)),
+                 "momentum": float(_attr(a, "momentum", 0.9))}
+        return n1("BatchNormalization", attrs)
+    if op == "Activation":
+        act = _attr(a, "act_type", "relu")
+        # Gelu only exists from opset 20; exporting it under 13 would
+        # produce a file stock runtimes reject, so it fails loudly
+        m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus"}
+        if act not in m:
+            raise NotImplementedError(
+                f"ONNX export: activation '{act}' not representable at "
+                "opset 13")
+        return n1(m[act])
+    if op == "LeakyReLU":
+        return n1("LeakyRelu", {"alpha": float(_attr(a, "slope", 0.25))})
+    if op == "Pooling":
+        ptype = _attr(a, "pool_type", "max")
+        if _attr(a, "global_pool", False):
+            return n1("GlobalMaxPool" if ptype == "max"
+                      else "GlobalAveragePool")
+        kernel = _ints(_attr(a, "kernel"))
+        stride = _attr(a, "stride")
+        attrs = {"kernel_shape": kernel,
+                 "strides": _ints(stride, len(kernel)) if stride is not None
+                 else kernel,
+                 "pads": _ints(_attr(a, "pad", 0), len(kernel)) * 2}
+        if _attr(a, "pooling_convention", "valid") == "full":
+            attrs["ceil_mode"] = 1          # 'full' == ceil output dims
+        if ptype == "avg":
+            attrs["count_include_pad"] = \
+                1 if _attr(a, "count_include_pad", True) else 0
+            return n1("AveragePool", attrs)
+        return n1("MaxPool", attrs)
+    if op == "FullyConnected":
+        no_bias = bool(_attr(a, "no_bias", False))
+        flatten = bool(_attr(a, "flatten", True))
+        nodes = []
+        data_in = in_names[0]
+        if flatten:
+            flat = f"{nm}_flat"
+            nodes.append(P.node("Flatten", [data_in], [flat],
+                                name=f"{nm}_flatten", attrs={"axis": 1}))
+            data_in = flat
+        gemm_in = [data_in, in_names[1]] + \
+            ([] if no_bias else [in_names[2]])
+        nodes.append(P.node("Gemm", gemm_in, [out_name], name=nm,
+                            attrs={"transB": 1, "alpha": 1.0, "beta": 1.0}))
+        return nodes
+    if op in ("Flatten", "flatten"):
+        return n1("Flatten", {"axis": 1})
+    if op in ("elemwise_add", "_plus", "broadcast_add", "_add"):
+        return n1("Add")
+    if op in ("elemwise_mul", "broadcast_mul", "_mul"):
+        return n1("Mul")
+    if op == "Concat":
+        return n1("Concat", {"axis": int(_attr(a, "dim", 1))})
+    if op in ("softmax", "SoftmaxActivation"):
+        return n1("Softmax", {"axis": int(_attr(a, "axis", -1))})
+    if op == "SoftmaxOutput":
+        # label input dropped: inference graph
+        return n1("Softmax", {"axis": -1}, inputs=[in_names[0]])
+    if op == "Dropout":
+        return n1("Dropout", inputs=[in_names[0]])
+    raise NotImplementedError(f"ONNX export: op '{op}' not in the "
+                              "supported subset")
+
+
+def export_model(sym, params, input_shapes, onnx_file,
+                 input_dtype="float32", opset=13):
+    """Write `sym` (single-output Symbol) + params to `onnx_file`.
+
+    params: dict name -> NDArray/ndarray covering every non-data argument
+    and aux state. input_shapes: dict input_name -> shape (or a single
+    shape for a single 'data' input)."""
+    heads = sym._heads
+    if len(heads) != 1:
+        raise NotImplementedError("ONNX export: single-output graphs only")
+    if not isinstance(input_shapes, dict):
+        input_shapes = {"data": tuple(input_shapes)}
+
+    def np_of(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    nodes_b, initializers, seen_init = [], [], set()
+    name_of = {}                       # (_Node, out_idx) -> onnx value name
+    for node in sym._topo_nodes():
+        if node.is_var:
+            if node.name in input_shapes:
+                name_of[(id(node), 0)] = node.name
+            elif node.name.endswith("_label"):
+                # auto-created loss labels (SoftmaxOutput): the inference
+                # graph drops them, so no value is required
+                name_of[(id(node), 0)] = node.name
+            else:
+                if node.name not in params:
+                    raise ValueError(
+                        f"ONNX export: no value for argument '{node.name}'")
+                if node.name not in seen_init:
+                    initializers.append(P.tensor(node.name,
+                                                 np_of(params[node.name])))
+                    seen_init.add(node.name)
+                name_of[(id(node), 0)] = node.name
+            continue
+        in_names = [name_of[(id(src), idx)] for src, idx in node.inputs]
+        out_name = f"{node.name}_output"
+        nodes_b += _export_node(node, in_names, out_name)
+        name_of[(id(node), 0)] = out_name
+
+    head_node, head_idx = heads[0]
+    out_val = name_of[(id(head_node), head_idx if not head_node.is_var else 0)]
+
+    dt = P.NP2ONNX[str(np.dtype(input_dtype))]
+    inputs_vi = [P.value_info(n, dt, s) for n, s in input_shapes.items()]
+    # output shape via symbol shape inference
+    try:
+        _, out_shapes, _ = sym.infer_shape(**input_shapes)
+        out_shape = out_shapes[0]
+    except Exception:
+        out_shape = ()
+    outputs_vi = [P.value_info(out_val, dt, out_shape)]
+    g = P.graph(nodes_b, "mxnet_tpu_graph", inputs_vi, outputs_vi,
+                initializers)
+    data = P.model(g, opset=opset)
+    with open(onnx_file, "wb") as f:
+        f.write(data)
+    return onnx_file
+
+
+# -- import -----------------------------------------------------------------
+
+def _sym_pads(attrs, ndim, op):
+    """ONNX pads [b1..bn, e1..en] -> symmetric mxnet pad tuple; asymmetric
+    padding (begin != end, e.g. resolved auto_pad) is rejected loudly
+    rather than silently truncated."""
+    pads = attrs.get("pads", [0] * (2 * ndim))
+    begin, end = tuple(pads[:ndim]), tuple(pads[ndim:])
+    if begin != end:
+        raise NotImplementedError(
+            f"ONNX import: asymmetric pads {pads} on {op} unsupported")
+    return begin
+
+
+def _import_node(n, sym_of, sym_mod):
+    op = n["op_type"]
+    a = n["attrs"]
+    ins = [sym_of[i] for i in n["inputs"]]
+    name = n["name"] or None
+
+    if op == "Conv":
+        k = a["kernel_shape"]
+        pads = _sym_pads(a, len(k), op)
+        return sym_mod.Convolution(
+            *ins, kernel=tuple(k), stride=tuple(a.get("strides", [1] * len(k))),
+            dilate=tuple(a.get("dilations", [1] * len(k))),
+            pad=pads, num_filter=None, num_group=a.get("group", 1),
+            no_bias=len(ins) == 2, name=name)
+    if op == "BatchNormalization":
+        # aux states go by keyword: positional args only bind schema inputs
+        return sym_mod.BatchNorm(ins[0], gamma=ins[1], beta=ins[2],
+                                 moving_mean=ins[3], moving_var=ins[4],
+                                 eps=a.get("epsilon", 1e-5),
+                                 momentum=a.get("momentum", 0.9), name=name)
+    if op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Gelu"):
+        act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+               "Softplus": "softrelu", "Gelu": "gelu"}[op]
+        return sym_mod.Activation(ins[0], act_type=act, name=name)
+    if op == "LeakyRelu":
+        return sym_mod.LeakyReLU(ins[0], act_type="leaky",
+                                 slope=a.get("alpha", 0.01), name=name)
+    if op in ("GlobalMaxPool", "GlobalAveragePool"):
+        return sym_mod.Pooling(
+            ins[0], pool_type="max" if op == "GlobalMaxPool" else "avg",
+            global_pool=True, name=name)
+    if op in ("MaxPool", "AveragePool"):
+        k = a["kernel_shape"]
+        pads = _sym_pads(a, len(k), op)
+        kw = {"pooling_convention": "full"} if a.get("ceil_mode") else {}
+        if op == "AveragePool":
+            # ONNX default count_include_pad=0 (exclude); the mxnet op
+            # default is include — map explicitly
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 0))
+        return sym_mod.Pooling(
+            ins[0], kernel=tuple(k), pool_type="max" if op == "MaxPool"
+            else "avg", stride=tuple(a.get("strides", [1] * len(k))),
+            pad=pads, name=name, **kw)
+    if op == "Gemm":
+        if a.get("transB", 0) != 1:
+            raise NotImplementedError("Gemm without transB=1")
+        return sym_mod.FullyConnected(
+            *ins, num_hidden=None, no_bias=len(ins) == 2, flatten=False,
+            name=name)
+    if op == "Flatten":
+        return sym_mod.flatten(ins[0], name=name)
+    if op == "Add":
+        return ins[0] + ins[1]
+    if op == "Mul":
+        return ins[0] * ins[1]
+    if op == "Concat":
+        return sym_mod.Concat(*ins, dim=a.get("axis", 1), name=name)
+    if op == "Softmax":
+        return sym_mod.softmax(ins[0], axis=a.get("axis", -1), name=name)
+    if op == "Dropout":
+        return ins[0]
+    raise NotImplementedError(f"ONNX import: op '{op}' not in the "
+                              "supported subset")
+
+
+def import_model(onnx_file):
+    """-> (sym, arg_params, aux_params): mirror of the reference
+    onnx.import_model. Initializer tensors become arg/aux params (aux =
+    BatchNormalization running stats)."""
+    from ... import symbol as sym_mod
+    from ... import nd
+
+    with open(onnx_file, "rb") as f:
+        m = P.parse_model(f.read())
+    g = m["graph"]
+    inits = g["initializers"]
+    aux_names = set()
+    for n in g["nodes"]:
+        if n["op_type"] == "BatchNormalization":
+            aux_names.update(n["inputs"][3:5])   # running mean, running var
+
+    sym_of = {}
+    for vi in g["inputs"]:
+        if vi["name"] not in inits:
+            sym_of[vi["name"]] = sym_mod.var(vi["name"],
+                                             shape=tuple(vi["shape"]) or None)
+    for name in inits:
+        sym_of[name] = sym_mod.var(name, shape=inits[name].shape)
+
+    out_sym = None
+    for n in g["nodes"]:
+        s = _import_node(n, sym_of, sym_mod)
+        for o in n["outputs"]:
+            sym_of[o] = s
+        out_sym = s
+    if g["outputs"]:
+        out_sym = sym_of[g["outputs"][0]["name"]]
+
+    def to_nd(x):
+        a = x
+        if a.dtype == np.int64:
+            a = a.astype(np.int32)
+        return nd.array(a)
+
+    arg_params = {k: to_nd(v) for k, v in inits.items()
+                  if k not in aux_names}
+    aux_params = {k: to_nd(v) for k, v in inits.items() if k in aux_names}
+    return out_sym, arg_params, aux_params

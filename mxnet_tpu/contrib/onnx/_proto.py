@@ -1,0 +1,379 @@
+"""Minimal ONNX protobuf wire codec — no `onnx`/`protobuf` dependency.
+
+The environment bakes neither package, so the subset of the ONNX IR needed
+for model exchange (ModelProto/GraphProto/NodeProto/TensorProto/
+AttributeProto/ValueInfoProto and friends) is serialized here directly in
+protobuf wire format (public spec: varints + length-delimited fields;
+field numbers from the public `onnx/onnx.proto`). Files written here load
+in stock `onnx`/onnxruntime, and files produced by them parse here, for
+the message subset listed.
+"""
+from __future__ import annotations
+
+import struct
+
+# -- wire primitives --------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field, value):
+    if value < 0:
+        value += 1 << 64
+    return _tag(field, 0) + _varint(value)
+
+
+def w_bytes(field, data):
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def w_string(field, s):
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_float(field, v):
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+class Reader:
+    def __init__(self, data):
+        self.d = data
+        self.i = 0
+
+    def eof(self):
+        return self.i >= len(self.d)
+
+    def varint(self):
+        n = shift = 0
+        while True:
+            b = self.d[self.i]
+            self.i += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return n
+            shift += 7
+
+    def field(self):
+        """-> (field_number, wire_type, value). value: int for varint/fixed,
+        bytes for length-delimited."""
+        key = self.varint()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            return field, wire, self.varint()
+        if wire == 2:
+            ln = self.varint()
+            v = self.d[self.i:self.i + ln]
+            self.i += ln
+            return field, wire, v
+        if wire == 5:
+            v = struct.unpack_from("<I", self.d, self.i)[0]
+            self.i += 4
+            return field, wire, v
+        if wire == 1:
+            v = struct.unpack_from("<Q", self.d, self.i)[0]
+            self.i += 8
+            return field, wire, v
+        raise ValueError(f"unsupported wire type {wire}")
+
+
+def signed(v):
+    """Decode a 64-bit two's-complement varint to a python int."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def unpack_varints(data):
+    """Packed repeated varint field (proto3 packs scalars by default)."""
+    r = Reader(data)
+    out = []
+    while not r.eof():
+        out.append(signed(r.varint()))
+    return out
+
+
+def unpack_floats(data):
+    """Packed repeated float field."""
+    return [struct.unpack_from("<f", data, i)[0]
+            for i in range(0, len(data), 4)]
+
+
+# -- ONNX message builders (writer side) ------------------------------------
+# field numbers: public onnx/onnx.proto
+
+TENSOR_FLOAT, TENSOR_UINT8, TENSOR_INT8 = 1, 2, 3
+TENSOR_INT32, TENSOR_INT64, TENSOR_BOOL = 6, 7, 9
+TENSOR_FLOAT16, TENSOR_DOUBLE = 10, 11
+
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+NP2ONNX = {"float32": TENSOR_FLOAT, "float64": TENSOR_DOUBLE,
+           "float16": TENSOR_FLOAT16, "uint8": TENSOR_UINT8,
+           "int8": TENSOR_INT8, "int32": TENSOR_INT32,
+           "int64": TENSOR_INT64, "bool": TENSOR_BOOL}
+ONNX2NP = {v: k for k, v in NP2ONNX.items()}
+
+
+def tensor(name, arr):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    import numpy as np
+    arr = np.ascontiguousarray(arr)
+    b = b""
+    for d in arr.shape:
+        b += w_varint(1, d)
+    b += w_varint(2, NP2ONNX[str(arr.dtype)])
+    b += w_string(8, name)
+    b += w_bytes(9, arr.tobytes())
+    return b
+
+
+def attribute(name, value):
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    strings=9, type=20."""
+    b = w_string(1, name)
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, float):
+        b += w_float(2, value) + w_varint(20, ATTR_FLOAT)
+    elif isinstance(value, int):
+        b += w_varint(3, value) + w_varint(20, ATTR_INT)
+    elif isinstance(value, str):
+        b += w_bytes(4, value.encode()) + w_varint(20, ATTR_STRING)
+    elif isinstance(value, bytes):
+        b += w_bytes(5, value) + w_varint(20, ATTR_TENSOR)  # pre-built tensor
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            for v in value:
+                b += w_varint(8, v)
+            b += w_varint(20, ATTR_INTS)
+        elif all(isinstance(v, float) for v in value):
+            for v in value:
+                b += w_float(7, v)
+            b += w_varint(20, ATTR_FLOATS)
+        else:
+            raise TypeError(f"attribute list {name}: {value}")
+    else:
+        raise TypeError(f"attribute {name}: {type(value)}")
+    return b
+
+
+def node(op_type, inputs, outputs, name="", attrs=None):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    b = b""
+    for i in inputs:
+        b += w_string(1, i)
+    for o in outputs:
+        b += w_string(2, o)
+    if name:
+        b += w_string(3, name)
+    b += w_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        b += w_bytes(5, attribute(k, v))
+    return b
+
+
+def value_info(name, dtype_enum, shape):
+    """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
+    Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
+    Dimension{dim_value=1}."""
+    dims = b""
+    for d in shape:
+        dims += w_bytes(1, w_varint(1, d))
+    tt = w_varint(1, dtype_enum) + w_bytes(2, dims)
+    tp = w_bytes(1, tt)
+    return w_string(1, name) + w_bytes(2, tp)
+
+
+def graph(nodes, name, inputs, outputs, initializers):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    b = b""
+    for n in nodes:
+        b += w_bytes(1, n)
+    b += w_string(2, name)
+    for t in initializers:
+        b += w_bytes(5, t)
+    for vi in inputs:
+        b += w_bytes(11, vi)
+    for vi in outputs:
+        b += w_bytes(12, vi)
+    return b
+
+
+def model(graph_bytes, opset=13, producer="mxnet_tpu"):
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8.
+    OperatorSetIdProto: domain=1, version=2."""
+    opset_b = w_string(1, "") + w_varint(2, opset)
+    return (w_varint(1, 8)                  # IR version 8
+            + w_string(2, producer)
+            + w_bytes(7, graph_bytes)
+            + w_bytes(8, opset_b))
+
+
+# -- reader side ------------------------------------------------------------
+
+
+def parse_model(data):
+    """-> dict with 'graph' (parsed GraphProto dict), 'opset', 'producer'."""
+    r = Reader(data)
+    out = {"opset": None, "producer": "", "graph": None}
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 7:
+            out["graph"] = parse_graph(v)
+        elif f == 8:
+            rr = Reader(v)
+            while not rr.eof():
+                f2, _, v2 = rr.field()
+                if f2 == 2:
+                    out["opset"] = v2
+        elif f == 2:
+            out["producer"] = v.decode()
+    return out
+
+
+def parse_graph(data):
+    r = Reader(data)
+    g = {"nodes": [], "initializers": {}, "inputs": [], "outputs": [],
+         "name": ""}
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 1:
+            g["nodes"].append(parse_node(v))
+        elif f == 2:
+            g["name"] = v.decode()
+        elif f == 5:
+            name, arr = parse_tensor(v)
+            g["initializers"][name] = arr
+        elif f == 11:
+            g["inputs"].append(parse_value_info(v))
+        elif f == 12:
+            g["outputs"].append(parse_value_info(v))
+    return g
+
+
+def parse_node(data):
+    r = Reader(data)
+    n = {"inputs": [], "outputs": [], "name": "", "op_type": "", "attrs": {}}
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 1:
+            n["inputs"].append(v.decode())
+        elif f == 2:
+            n["outputs"].append(v.decode())
+        elif f == 3:
+            n["name"] = v.decode()
+        elif f == 4:
+            n["op_type"] = v.decode()
+        elif f == 5:
+            k, val = parse_attribute(v)
+            n["attrs"][k] = val
+    return n
+
+
+def parse_attribute(data):
+    r = Reader(data)
+    name, val, ints, floats = "", None, [], []
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            val = struct.unpack("<f", struct.pack("<I", v))[0]
+        elif f == 3:
+            val = signed(v)
+        elif f == 4:
+            val = v.decode()
+        elif f == 5:
+            val = parse_tensor(v)[1]
+        elif f == 7:           # floats: packed (stock protobuf) or repeated
+            floats += unpack_floats(v) if w == 2 else \
+                [struct.unpack("<f", struct.pack("<I", v))[0]]
+        elif f == 8:           # ints: packed or repeated
+            ints += unpack_varints(v) if w == 2 else [signed(v)]
+    if ints:
+        val = ints
+    elif floats:
+        val = floats
+    return name, val
+
+
+def parse_tensor(data):
+    import numpy as np
+    r = Reader(data)
+    dims, dtype, raw, name = [], TENSOR_FLOAT, b"", ""
+    f32, i32, i64 = [], [], []
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 1:
+            dims += unpack_varints(v) if w == 2 else [v]
+        elif f == 2:
+            dtype = v
+        elif f == 4:
+            f32 += unpack_floats(v) if w == 2 else \
+                [struct.unpack("<f", struct.pack("<I", v))[0]]
+        elif f == 5:
+            i32 += unpack_varints(v) if w == 2 else [signed(v)]
+        elif f == 7:
+            i64 += unpack_varints(v) if w == 2 else [signed(v)]
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    np_dt = np.dtype(ONNX2NP[dtype])
+    if raw:
+        arr = np.frombuffer(raw, np_dt).reshape(dims)
+    elif f32:
+        arr = np.asarray(f32, np.float32).reshape(dims)
+    elif i64:
+        arr = np.asarray(i64, np.int64).reshape(dims)
+    elif i32:
+        arr = np.asarray(i32, np_dt).reshape(dims)
+    else:
+        arr = np.zeros(dims, np_dt)
+    return name, arr.copy()
+
+
+def parse_value_info(data):
+    r = Reader(data)
+    name, shape, elem = "", [], TENSOR_FLOAT
+    while not r.eof():
+        f, w, v = r.field()
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            rr = Reader(v)
+            while not rr.eof():
+                f2, _, v2 = rr.field()
+                if f2 == 1:                      # tensor_type
+                    r3 = Reader(v2)
+                    while not r3.eof():
+                        f3, _, v3 = r3.field()
+                        if f3 == 1:
+                            elem = v3
+                        elif f3 == 2:            # shape
+                            r4 = Reader(v3)
+                            while not r4.eof():
+                                f4, _, v4 = r4.field()
+                                if f4 == 1:      # dim
+                                    r5 = Reader(v4)
+                                    dim = 0
+                                    while not r5.eof():
+                                        f5, _, v5 = r5.field()
+                                        if f5 == 1:
+                                            dim = v5
+                                    shape.append(dim)
+    return {"name": name, "elem_type": elem, "shape": shape}
