@@ -46,6 +46,7 @@ _LAZY = {
     "sym": ".symbol",
     "symbol": ".symbol",
     "model": ".module",
+    "operator": ".operator",
     "profiler": ".profiler",
     "parallel": ".parallel",
     "test_utils": ".test_utils",

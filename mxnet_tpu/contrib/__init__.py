@@ -8,3 +8,4 @@ in-tree ONNX wire codec — the environment bakes no `onnx` package).
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401

@@ -1,0 +1,4 @@
+"""Text utilities: vocabulary + pretrained embeddings (reference:
+python/mxnet/contrib/text/ — vocab.py, embedding.py, utils.py)."""
+from . import embedding, utils, vocab          # noqa: F401
+from .vocab import Vocabulary                  # noqa: F401

@@ -20,7 +20,10 @@ __all__ = ["get_model", "alexnet", "vgg11", "vgg13", "vgg16", "vgg19",
            "squeezenet1_1", "mobilenet1_0", "mobilenet0_5", "mobilenet0_25",
            "mobilenet_v2_1_0", "mobilenet_v2_0_5", "resnet18_v1",
            "resnet34_v1", "resnet50_v1", "resnet101_v1", "resnet152_v1",
-           "AlexNet", "VGG", "SqueezeNet", "MobileNet", "MobileNetV2"]
+           "densenet121", "densenet161", "densenet169", "densenet201",
+           "inception_v3",
+           "AlexNet", "VGG", "SqueezeNet", "MobileNet", "MobileNetV2",
+           "DenseNet", "Inception3"]
 
 
 def _load_pretrained(net, name, root):
@@ -225,6 +228,172 @@ class MobileNetV2(HybridBlock):
         return self.output(self.features(x))
 
 
+class _DenseLayer(HybridBlock):
+    """BN-ReLU-Conv1x1(4k) -> BN-ReLU-Conv3x3(k), output concatenated onto
+    the input (reference model_zoo/vision/densenet.py _make_dense_layer)."""
+
+    def __init__(self, growth_rate, bn_size=4, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(bn_size * growth_rate, 1, use_bias=False),
+                      nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        self._dropout = dropout
+        if dropout:
+            self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        from ... import nd
+        out = self.body(x)
+        if self._dropout:
+            out = self.drop(out)
+        return nd.concat(x, out, dim=1)
+
+
+class _Transition(HybridBlock):
+    def __init__(self, out_channels, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(), nn.Activation("relu"),
+                      nn.Conv2D(out_channels, 1, use_bias=False),
+                      nn.AvgPool2D(2, 2))
+
+    def forward(self, x):
+        return self.body(x)
+
+
+_DENSENET_SPEC = {121: (64, 32, [6, 12, 24, 16]),
+                  161: (96, 48, [6, 12, 36, 24]),
+                  169: (64, 32, [6, 12, 32, 32]),
+                  201: (64, 32, [6, 12, 48, 32])}
+
+
+class DenseNet(HybridBlock):
+    """Reference: model_zoo/vision/densenet.py."""
+
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(num_init_features, 7, strides=2,
+                                    padding=3, use_bias=False),
+                          nn.BatchNorm(), nn.Activation("relu"),
+                          nn.MaxPool2D(3, 2, padding=1))
+        channels = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for _ in range(num_layers):
+                self.features.add(_DenseLayer(growth_rate, bn_size, dropout))
+            channels += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                channels //= 2
+                self.features.add(_Transition(channels))
+        self.features.add(nn.BatchNorm(), nn.Activation("relu"),
+                          nn.GlobalAvgPool2D(), nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _Branches(HybridBlock):
+    """Parallel branches concatenated on the channel axis (the Inception
+    block wiring primitive)."""
+
+    def __init__(self, *branches):
+        super().__init__()
+        self.branches = nn.HybridSequential()
+        for b in branches:
+            self.branches.add(b)
+
+    def forward(self, x):
+        from ... import nd
+        return nd.concat(*[b(x) for b in self.branches._children.values()],
+                         dim=1)
+
+
+def _i3_conv(ch, k, s=1, p=0):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(ch, k, strides=s, padding=p, use_bias=False),
+            nn.BatchNorm(epsilon=0.001), nn.Activation("relu"))
+    return blk
+
+
+def _i3_seq(*blocks):
+    s = nn.HybridSequential()
+    s.add(*blocks)
+    return s
+
+
+class Inception3(HybridBlock):
+    """Inception-v3 (reference model_zoo/vision/inception.py), built from
+    the standard A/B/C/D/E blocks; expects 299x299 inputs (any >= 75 works
+    — the head is a global pool)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        conv, seq = _i3_conv, _i3_seq
+
+        def pool_branch(pool, ch):
+            return seq(pool, conv(ch, 1))
+
+        def block_a(pool_ch):
+            return _Branches(
+                conv(64, 1),
+                seq(conv(48, 1), conv(64, 5, p=2)),
+                seq(conv(64, 1), conv(96, 3, p=1), conv(96, 3, p=1)),
+                pool_branch(nn.AvgPool2D(3, 1, padding=1), pool_ch))
+
+        def block_b():
+            return _Branches(
+                conv(384, 3, s=2),
+                seq(conv(64, 1), conv(96, 3, p=1), conv(96, 3, s=2)),
+                nn.MaxPool2D(3, 2))
+
+        def block_c(ch7):
+            return _Branches(
+                conv(192, 1),
+                seq(conv(ch7, 1), conv(ch7, (1, 7), p=(0, 3)),
+                    conv(192, (7, 1), p=(3, 0))),
+                seq(conv(ch7, 1), conv(ch7, (7, 1), p=(3, 0)),
+                    conv(ch7, (1, 7), p=(0, 3)), conv(ch7, (7, 1), p=(3, 0)),
+                    conv(192, (1, 7), p=(0, 3))),
+                pool_branch(nn.AvgPool2D(3, 1, padding=1), 192))
+
+        def block_d():
+            return _Branches(
+                seq(conv(192, 1), conv(320, 3, s=2)),
+                seq(conv(192, 1), conv(192, (1, 7), p=(0, 3)),
+                    conv(192, (7, 1), p=(3, 0)), conv(192, 3, s=2)),
+                nn.MaxPool2D(3, 2))
+
+        def block_e():
+            return _Branches(
+                conv(320, 1),
+                seq(conv(384, 1), _Branches(conv(384, (1, 3), p=(0, 1)),
+                                            conv(384, (3, 1), p=(1, 0)))),
+                seq(conv(448, 1), conv(384, 3, p=1),
+                    _Branches(conv(384, (1, 3), p=(0, 1)),
+                              conv(384, (3, 1), p=(1, 0)))),
+                pool_branch(nn.AvgPool2D(3, 1, padding=1), 192))
+
+        self.features = nn.HybridSequential()
+        self.features.add(conv(32, 3, s=2), conv(32, 3), conv(64, 3, p=1),
+                          nn.MaxPool2D(3, 2), conv(80, 1), conv(192, 3),
+                          nn.MaxPool2D(3, 2),
+                          block_a(32), block_a(64), block_a(64),
+                          block_b(),
+                          block_c(128), block_c(160), block_c(160),
+                          block_c(192),
+                          block_d(), block_e(), block_e(),
+                          nn.GlobalAvgPool2D(), nn.Dropout(0.5),
+                          nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
 # --------------------------------------------------------------------------
 # factory functions + registry
 # --------------------------------------------------------------------------
@@ -299,6 +468,30 @@ def _resnet_factory(name):
     return factory
 
 
+def _make_densenet(num):
+    def factory(pretrained=False, root="~/.mxnet/models", **kwargs):
+        init, growth, cfg = _DENSENET_SPEC[num]
+        net = DenseNet(init, growth, cfg, **kwargs)
+        if pretrained:
+            _load_pretrained(net, f"densenet{num}", root)
+        return net
+    factory.__name__ = f"densenet{num}"
+    return factory
+
+
+densenet121 = _make_densenet(121)
+densenet161 = _make_densenet(161)
+densenet169 = _make_densenet(169)
+densenet201 = _make_densenet(201)
+
+
+def inception_v3(pretrained=False, root="~/.mxnet/models", **kwargs):
+    net = Inception3(**kwargs)
+    if pretrained:
+        _load_pretrained(net, "inceptionv3", root)
+    return net
+
+
 resnet18_v1 = _resnet_factory("resnet18_v1")
 resnet34_v1 = _resnet_factory("resnet34_v1")
 resnet50_v1 = _resnet_factory("resnet50_v1")
@@ -317,6 +510,9 @@ _MODELS = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
     "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
     "resnet152_v1": resnet152_v1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

@@ -19,6 +19,7 @@ mesh is the supported mode, matching `dist_sync` semantics.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
@@ -192,7 +193,7 @@ class KVStore:
             elif k[:2] == "s:":
                 k = k[2:]
             if j == "__arity__":
-                arity[k] = int(v.asnumpy())
+                arity[k] = int(np.asarray(v.asnumpy()).reshape(-1)[0])
                 continue
             if k == "" or not j.isdigit():
                 raise ValueError(f"malformed optimizer-state key '{fk}'")

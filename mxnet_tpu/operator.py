@@ -1,0 +1,154 @@
+"""Custom python operators (reference: python/mxnet/operator.py —
+`CustomOp`, `CustomOpProp`, `operator.register`, invoked as
+`mx.nd.Custom(*data, op_type=name)`).
+
+TPU-native translation: the reference runs custom python ops as host
+callbacks from the C++ engine (GIL-bound, graph-opaque). Here the host
+round-trip is `jax.pure_callback`, wrapped in `jax.custom_vjp` so the op is
+*jittable* and differentiable: under jit XLA treats it as an opaque host
+call, exactly the semantics the reference documents. forward/backward
+receive numpy arrays, matching the reference's NDArray-on-CPU behavior."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import register as _register_op
+
+_CUSTOM_PROPS = {}
+
+
+class CustomOp:
+    """Base class for the imperative compute of a custom op (reference
+    `mx.operator.CustomOp`). Subclasses override forward/backward; `req` is
+    always 'write' here (the functional core has no in-place add)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError(
+            "this CustomOp does not define a backward; wrap calls in "
+            "autograd.pause() or define backward()")
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Reference helper: honor the write request. dst is a numpy view
+        slot (a list cell here, not a mutable NDArray)."""
+        if req in ("write", "inplace", None):
+            dst[...] = src
+        elif req == "add":
+            dst[...] = dst + src
+        # req == 'null': drop
+
+
+class CustomOpProp:
+    """Declares the custom op's signature (reference
+    `mx.operator.CustomOpProp`): argument/output names, shape/type
+    inference, and the CustomOp factory. Constructor kwargs arrive as
+    STRINGS (reference behavior — they ride the op's attr map)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under `op_type=reg_name`
+    (reference `mx.operator.register`)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get(reg_name):
+    return _CUSTOM_PROPS[reg_name]
+
+
+def _as_shape_dtype(avals):
+    return [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]
+
+
+@_register_op("Custom")
+def custom(*inputs, op_type=None, **kwargs):
+    """The `Custom` op (reference `src/operator/custom/custom.cc`): look up
+    the registered prop, infer output shapes/dtypes, and run the python
+    CustomOp via pure_callback with a custom_vjp for backward."""
+    if op_type is None or op_type not in _CUSTOM_PROPS:
+        raise KeyError(
+            f"Custom: op_type {op_type!r} is not registered "
+            f"(known: {sorted(_CUSTOM_PROPS)})")
+    # reference semantics: prop kwargs are strings
+    prop = _CUSTOM_PROPS[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+    in_shapes = [list(x.shape) for x in inputs]
+    in_dtypes = [x.dtype for x in inputs]
+    shapes = prop.infer_shape(in_shapes)
+    in_shapes2, out_shapes = shapes[0], shapes[1]
+    types = prop.infer_type(in_dtypes)
+    out_dtypes = types[1]
+    n_out = len(out_shapes)
+    op = prop.create_operator(None, in_shapes2, in_dtypes)
+
+    out_specs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                 for s, d in zip(out_shapes, out_dtypes)]
+    in_specs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                for s, d in zip(in_shapes2, in_dtypes)]
+
+    def host_forward(*arrs):
+        ins = [np.asarray(a) for a in arrs]
+        outs = [np.zeros(s.shape, s.dtype) for s in out_specs]
+        op.forward(is_train=True, req=["write"] * n_out,
+                   in_data=ins, out_data=outs, aux=[])
+        return tuple(outs)
+
+    def host_backward(*arrs):
+        k = len(out_specs)
+        ogs = [np.asarray(a) for a in arrs[:k]]
+        ins = [np.asarray(a) for a in arrs[k:k + len(in_specs)]]
+        outs = [np.asarray(a) for a in arrs[k + len(in_specs):]]
+        igs = [np.zeros(s.shape, s.dtype) for s in in_specs]
+        op.backward(req=["write"] * len(igs), out_grad=ogs, in_data=ins,
+                    out_data=outs, in_grad=igs, aux=[])
+        return tuple(igs)
+
+    @jax.custom_vjp
+    def run(*xs):
+        return jax.pure_callback(host_forward, tuple(out_specs), *xs)
+
+    def run_fwd(*xs):
+        outs = jax.pure_callback(host_forward, tuple(out_specs), *xs)
+        return outs, (xs, outs)
+
+    def run_bwd(res, gs):
+        xs, outs = res
+        igs = jax.pure_callback(host_backward, tuple(in_specs),
+                                *gs, *xs, *outs)
+        return igs
+
+    run.defvjp(run_fwd, run_bwd)
+    result = run(*inputs)
+    return result if n_out > 1 else result[0]

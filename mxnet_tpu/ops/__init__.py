@@ -49,7 +49,9 @@ from . import nn_ops        # noqa: E402,F401
 from . import random_ops    # noqa: E402,F401
 from . import optimizer_ops  # noqa: E402,F401
 from . import rnn_ops       # noqa: E402,F401
-from . import detection_ops  # noqa: E402,F401  (box_nms/box_iou/ROIAlign)
+from . import detection_ops  # noqa: E402,F401  (box_nms/ROIAlign/MultiBox)
+from . import misc_ops      # noqa: E402,F401  (loss layers, STN, LRN, fft)
+from .. import operator     # noqa: E402,F401  (registers the Custom op)
 from . import control_flow  # noqa: E402,F401  (foreach/while_loop/cond)
 
 RNG_OPS.update(name for name in OPS

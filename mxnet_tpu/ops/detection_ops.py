@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from . import register
@@ -143,3 +144,313 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
 
     out = jax.vmap(one)(r)
     return out.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox family (reference src/operator/contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior")
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation. `data` supplies the feature-map shape (B,C,H,W);
+    anchors are normalized corner boxes, (1, H*W*A, 4) with
+    A = len(sizes) + len(ratios) - 1: (size_i, ratio_0) for all sizes plus
+    (size_0, ratio_j) for j>0 — the reference's combination rule."""
+    _, _, H, W = data.shape
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    wh = [(s * float(np.sqrt(ratios[0])), s / float(np.sqrt(ratios[0])))
+          for s in sizes]
+    wh += [(sizes[0] * float(np.sqrt(r)), sizes[0] / float(np.sqrt(r)))
+           for r in ratios[1:]]
+    wh = jnp.asarray(wh, jnp.float32)                        # (A, 2)
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")             # (H, W)
+    centers = jnp.stack([gx, gy], -1).reshape(-1, 1, 2)      # (HW, 1, 2)
+    half = wh[None, :, :] / 2.0                              # (1, A, 2)
+    boxes = jnp.concatenate([centers - half, centers + half], -1)
+    boxes = boxes.reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _encode_offsets(anchors, matched, variances):
+    """(cx,cy,w,h) offset encoding of matched gt boxes vs anchors, both
+    corner-format (..., 4)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    acx = (anchors[..., 0] + anchors[..., 2]) / 2
+    acy = (anchors[..., 1] + anchors[..., 3]) / 2
+    gw = jnp.maximum(matched[..., 2] - matched[..., 0], 1e-12)
+    gh = jnp.maximum(matched[..., 3] - matched[..., 1], 1e-12)
+    gcx = (matched[..., 0] + matched[..., 2]) / 2
+    gcy = (matched[..., 1] + matched[..., 3]) / 2
+    v0, v1, v2, v3 = variances
+    return jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-12) / v0,
+                      (gcy - acy) / jnp.maximum(ah, 1e-12) / v1,
+                      jnp.log(gw / jnp.maximum(aw, 1e-12)) / v2,
+                      jnp.log(gh / jnp.maximum(ah, 1e-12)) / v3], -1)
+
+
+@register("_contrib_MultiBoxTarget")
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor↔gt matching + offset encoding. anchor (1,A,4) corner;
+    label (B,M,5) rows [cls, x1, y1, x2, y2] with cls<0 padding;
+    cls_pred (B, num_cls+1, A) used only for hard-negative mining.
+    Returns (box_target (B,A*4), box_mask (B,A*4), cls_target (B,A));
+    cls_target is matched-class+1 with 0 = background, ignore_label for
+    mined-away negatives."""
+    anc = anchor.reshape(-1, 4).astype(jnp.float32)          # (A, 4)
+    A = anc.shape[0]
+
+    def one(lab, cpred):
+        gt_valid = lab[:, 0] >= 0                            # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = _corner_iou(anc, gt_boxes)                     # (A, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        # stage 1: each valid gt claims its best anchor (bipartite).
+        # Padding rows must not scatter at all (their argmax lands on
+        # anchor 0 and would clobber a real gt's claim): route them to the
+        # out-of-range index A, dropped by the scatter. Duplicate claims on
+        # one anchor resolve via max-combining (deterministic: highest gt
+        # index wins; the reference's sequential loop is equally arbitrary).
+        M = lab.shape[0]
+        best_anchor = jnp.argmax(iou, axis=0)                # (M,)
+        safe_idx = jnp.where(gt_valid, best_anchor, A)
+        forced = jnp.zeros((A,), bool).at[safe_idx].set(True, mode="drop")
+        forced_gt = jnp.zeros((A,), jnp.int32).at[safe_idx].max(
+            jnp.arange(M, dtype=jnp.int32), mode="drop")
+        # stage 2: remaining anchors match their best gt above threshold
+        best_gt = jnp.argmax(iou, axis=1)                    # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        thresh_pos = best_iou >= overlap_threshold
+        pos = forced | thresh_pos
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        matched = gt_boxes[gt_idx]                           # (A, 4)
+        target = _encode_offsets(anc, matched, variances)
+        mask = pos[:, None].astype(jnp.float32)
+        cls_t = jnp.where(pos, lab[gt_idx, 0].astype(jnp.float32) + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # near-positives (IoU >= negative_mining_thresh but below
+            # overlap_threshold) are excluded from mining entirely
+            # (reference rule) — neither positive nor trainable background
+            mineable = (cls_t == 0) & (best_iou < negative_mining_thresh)
+            # hardness of a negative = its max non-background class score
+            hardness = jnp.where(mineable, cpred[1:].max(axis=0), -jnp.inf)
+            n_neg = jnp.maximum(
+                negative_mining_ratio * pos.sum(),
+                float(minimum_negative_samples)).astype(jnp.int32)
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+            keep_neg = (rank < n_neg) & (hardness > -jnp.inf)
+            cls_t = jnp.where((cls_t == 0) & ~keep_neg,
+                              float(ignore_label), cls_t)
+        return (target * mask).reshape(-1), \
+            jnp.repeat(mask[:, 0], 4), cls_t
+
+    bt, bm, ct = jax.vmap(one)(label.astype(jnp.float32),
+                               cls_pred.astype(jnp.float32))
+    return bt, bm, ct
+
+
+@register("_contrib_MultiBoxDetection")
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS. cls_prob (B, num_cls+1, A), loc_pred (B, A*4),
+    anchor (1, A, 4) -> (B, A, 6) rows [class_id, score, x1, y1, x2, y2];
+    suppressed/background rows get class_id -1 (reference semantics)."""
+    anc = anchor.reshape(-1, 4).astype(jnp.float32)
+    A = anc.shape[0]
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    v0, v1, v2, v3 = variances
+
+    def one(cp, lp):
+        # best non-background class per anchor
+        cp = cp.T                                            # (A, C+1)
+        masked = cp.at[:, background_id].set(-jnp.inf)
+        cls_id = jnp.argmax(masked, axis=1)
+        score = jnp.max(masked, axis=1)
+        d = lp.reshape(A, 4)
+        cx = d[:, 0] * v0 * aw + acx
+        cy = d[:, 1] * v1 * ah + acy
+        w = jnp.exp(d[:, 2] * v2) * aw
+        h = jnp.exp(d[:, 3] * v3) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        keep = score > threshold
+        out_id = jnp.where(keep, cls_id.astype(jnp.float32) - 
+                           (cls_id > background_id), -1.0)
+        out = jnp.concatenate([out_id[:, None],
+                               jnp.where(keep, score, -1.0)[:, None],
+                               boxes], axis=1)
+        return out
+
+    det = jax.vmap(one)(cls_prob.astype(jnp.float32),
+                        loc_pred.astype(jnp.float32))
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max ROI pooling (reference src/operator/roi_pooling.cc): integer bin
+    boundaries (round + floor/ceil), max over each bin. data (B,C,H,W),
+    rois (R,5) [batch_idx, x1, y1, x2, y2] image coords -> (R,C,PH,PW)."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    PH, PW = pooled_size
+    B, C, H, W = data.shape
+    x = data.astype(jnp.float32)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        ph = jnp.arange(PH, dtype=jnp.float32)
+        pw = jnp.arange(PW, dtype=jnp.float32)
+        hs = jnp.floor(ph * rh / PH) + y1                    # (PH,)
+        he = jnp.ceil((ph + 1) * rh / PH) + y1
+        ws = jnp.floor(pw * rw / PW) + x1
+        we = jnp.ceil((pw + 1) * rw / PW) + x1
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        my = (ys[None, :] >= hs[:, None]) & (ys[None, :] < he[:, None])
+        mx = (xs[None, :] >= ws[:, None]) & (xs[None, :] < we[:, None])
+        m = my[:, None, :, None] & mx[None, :, None, :]      # (PH,PW,H,W)
+        img = x[jnp.maximum(bidx, 0)]                        # (C,H,W)
+        vals = jnp.where(m[None], img[:, None, None], -jnp.inf)
+        pooled = vals.max(axis=(3, 4))
+        pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        return jnp.where(bidx >= 0, pooled, jnp.zeros_like(pooled))
+
+    return jax.vmap(one)(rois.astype(jnp.float32)).astype(data.dtype)
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, output_size=(1, 1)):
+    """Adaptive average pooling (reference
+    src/operator/contrib/adaptive_avg_pooling.cc): bin i spans
+    [floor(i*H/OH), ceil((i+1)*H/OH)). Bin masks are trace-time numpy
+    constants, so the whole op lowers to two (MXU-friendly) matmuls."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    OH, OW = output_size
+    B, C, H, W = data.shape
+
+    def bin_matrix(n_in, n_out):
+        m = np.zeros((n_out, n_in), np.float32)
+        for i in range(n_out):
+            s = int(np.floor(i * n_in / n_out))
+            e = int(np.ceil((i + 1) * n_in / n_out))
+            m[i, s:e] = 1.0 / (e - s)
+        return jnp.asarray(m)
+
+    my = bin_matrix(H, OH)
+    mx = bin_matrix(W, OW)
+    tmp = jnp.einsum("oh,bchw->bcow", my, data.astype(jnp.float32))
+    out = jnp.einsum("pw,bcow->bcop", mx, tmp)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_Proposal")
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposal generation (reference
+    src/operator/contrib/proposal.cc / multi_proposal.cc), static-shape:
+    anchors at every feature cell, bbox-delta decode, clip to image,
+    min-size filter, top-pre_nms by fg score, greedy NMS, then the first
+    rpn_post_nms_top_n survivors (zero-padded when fewer). Output
+    (B*post, 5) rows [batch_idx, x1, y1, x2, y2] (+ (B*post, 1) scores if
+    output_score)."""
+    if iou_loss:
+        raise NotImplementedError(
+            "proposal: iou_loss decode is not supported; silently applying "
+            "the standard delta decode would corrupt proposals")
+    B, A2, H, W = cls_prob.shape
+    A = len(scales) * len(ratios)
+    base = float(feature_stride)
+    anchors = []
+    for r in ratios:
+        for s in scales:
+            ws = base * s * float(np.sqrt(1.0 / r))
+            hs = base * s * float(np.sqrt(r))
+            anchors.append([-(ws - 1) / 2, -(hs - 1) / 2,
+                            (ws - 1) / 2, (hs - 1) / 2])
+    anc = jnp.asarray(anchors, jnp.float32)                  # (A, 4)
+    sy = jnp.arange(H, dtype=jnp.float32) * base
+    sx = jnp.arange(W, dtype=jnp.float32) * base
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([gx, gy, gx, gy], -1).reshape(-1, 1, 4)
+    all_anc = (anc[None] + shifts).reshape(-1, 4)            # (HWA, 4)
+    N = all_anc.shape[0]
+    topn = min(rpn_pre_nms_top_n, N) if rpn_pre_nms_top_n > 0 else N
+
+    def one(cp, bp, info):
+        scores = cp[A:].transpose(1, 2, 0).reshape(-1)       # fg scores (HWA,)
+        deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = all_anc[:, 2] - all_anc[:, 0] + 1.0
+        ah = all_anc[:, 3] - all_anc[:, 1] + 1.0
+        acx = all_anc[:, 0] + 0.5 * (aw - 1)
+        acy = all_anc[:, 1] + 0.5 * (ah - 1)
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (h - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (h - 1)], -1)
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                           jnp.clip(boxes[:, 1], 0, im_h - 1),
+                           jnp.clip(boxes[:, 2], 0, im_w - 1),
+                           jnp.clip(boxes[:, 3], 0, im_h - 1)], -1)
+        min_sz = rpn_min_size * info[2]
+        ok = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_sz)
+              & (boxes[:, 3] - boxes[:, 1] + 1 >= min_sz))
+        scores = jnp.where(ok, scores, -1.0)
+        top_s, top_i = lax.top_k(scores, topn)
+        rows = jnp.concatenate([jnp.zeros((topn, 1)), top_s[:, None],
+                                boxes[top_i]], axis=1)
+        kept = box_nms(rows, overlap_thresh=threshold, valid_thresh=0.0,
+                       topk=rpn_post_nms_top_n, coord_start=2, score_index=1,
+                       id_index=-1, force_suppress=True)
+        # survivors first (already score-sorted by box_nms); pad to the
+        # fixed rpn_post_nms_top_n rows when fewer candidates exist
+        alive = kept[:, 1] > 0
+        order = jnp.argsort(~alive)                          # stable: alive first
+        sel = kept[order]
+        if sel.shape[0] < rpn_post_nms_top_n:
+            sel = jnp.pad(sel, ((0, rpn_post_nms_top_n - sel.shape[0]),
+                                (0, 0)))
+        sel = sel[:rpn_post_nms_top_n]
+        rois = sel[:, 2:6]
+        rscores = jnp.where(sel[:, 1] > 0, sel[:, 1], 0.0)
+        return rois, rscores
+
+    rois, rscores = jax.vmap(one)(cls_prob.astype(jnp.float32),
+                                  bbox_pred.astype(jnp.float32),
+                                  im_info.astype(jnp.float32))
+    bidx = jnp.repeat(jnp.arange(B, dtype=jnp.float32), rpn_post_nms_top_n)
+    flat = jnp.concatenate([bidx[:, None], rois.reshape(-1, 4)], axis=1)
+    if output_score:
+        return flat, rscores.reshape(-1, 1)
+    return flat

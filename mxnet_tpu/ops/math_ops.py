@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from . import register, alias
@@ -256,7 +257,12 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"
     if ret_typ == "both":
         return vals, idx
     if ret_typ == "mask":
-        raise NotImplementedError("topk ret_typ='mask'")
+        # 1 at every selected position, in the input's shape (topk indices
+        # are distinct, so the one-hot sum is exactly 0/1)
+        moved_idx = jnp.moveaxis(idx, axis, -1).astype(jnp.int32)
+        mask = jax.nn.one_hot(moved_idx, moved.shape[-1],
+                              dtype=jnp.dtype(dtype)).sum(-2)
+        return jnp.moveaxis(mask, -1, axis)
     raise ValueError(ret_typ)
 
 
@@ -286,3 +292,83 @@ def _zeros_op(shape=(), dtype="float32"):
 @register("_ones")
 def _ones_op(shape=(), dtype="float32"):
     return jnp.ones(tuple(shape), jnp.dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# extended linalg family (reference src/operator/tensor/la_op.cc: syevd,
+# gelqf, inverse, det, slogdet, makediag/extractdiag, maketrian/extracttrian)
+# ---------------------------------------------------------------------------
+
+@register("linalg_syevd")
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: returns (U, L) with A = U^T diag(L) U
+    (rows of U are eigenvectors — the reference's layout)."""
+    w, v = jnp.linalg.eigh(A.astype(jnp.float32))
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_gelqf")
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q row-orthonormal (reference gelqf)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A.astype(jnp.float32), -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_inverse")
+def linalg_inverse(A):
+    return jnp.linalg.inv(A.astype(jnp.float32))
+
+
+@register("linalg_det")
+def linalg_det(A):
+    return jnp.linalg.det(A.astype(jnp.float32))
+
+
+@register("linalg_slogdet")
+def linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A.astype(jnp.float32))
+    return sign, logabs
+
+
+@register("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    idx = jnp.arange(A.shape[-1])
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    return out.at[..., r, c].set(A)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+def _trian_indices(n, offset, lower):
+    """Triangle selection shared by maketrian/extracttrian (reference rule:
+    offset > 0 selects the upper triangle starting at that super-diagonal,
+    offset < 0 the lower triangle from that sub-diagonal; only at offset 0
+    does `lower` pick the side)."""
+    if offset > 0:
+        return np.triu_indices(n, k=offset)
+    if offset < 0:
+        return np.tril_indices(n, k=offset)
+    return np.tril_indices(n) if lower else np.triu_indices(n)
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(A, offset=0, lower=True):
+    """Pack a vector of triangle entries into a triangular matrix
+    (reference maketrian). A (..., k) with k = n*(n+1)/2 for offset 0."""
+    k = A.shape[-1]
+    n = int((np.sqrt(8 * k + 1) - 1) / 2) + abs(offset)
+    rows, cols = _trian_indices(n, offset, lower)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows[:k], cols[:k]].set(A)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    rows, cols = _trian_indices(A.shape[-1], offset, lower)
+    return A[..., rows, cols]

@@ -1,0 +1,417 @@
+"""Miscellaneous classic operators.
+
+TPU-native equivalents of the reference's loss-layer ops
+(`src/operator/make_loss.cc`, `src/operator/regression_output.cc`,
+`src/operator/svm_output.cc`), spatial-transform family
+(`src/operator/spatial_transformer.cc`, `src/operator/grid_generator.cc`,
+`src/operator/bilinear_sampler.cc`, `src/operator/correlation.cc`), LRN
+(`src/operator/nn/lrn.cc`), and assorted tensor utilities
+(`src/operator/tensor/matrix_op.cc`, `src/operator/tensor/ravel.cc`,
+`src/operator/contrib/fft.cc`, `src/operator/contrib/krprod.cc`).
+
+All ops are pure static-shape jax functions; the "loss layer" ops reproduce
+the reference's grad-override semantics (forward is identity-ish, backward
+injects the loss gradient and ignores the incoming head gradient) via
+`jax.custom_vjp`, exactly like `SoftmaxOutput` in `nn_ops.py`.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import register, alias
+
+
+def _zero_cot(label):
+    """Cotangent for a (possibly integer) label primal under custom_vjp."""
+    if jnp.issubdtype(label.dtype, jnp.integer):
+        return np.zeros(label.shape, jax.dtypes.float0)
+    return jnp.zeros_like(label)
+
+
+# ---------------------------------------------------------------------------
+# gradient-control / loss-layer ops
+# ---------------------------------------------------------------------------
+
+@register("BlockGrad")
+def block_grad(data):
+    """Identity forward, zero gradient (reference `BlockGrad` /
+    `stop_gradient`, `src/operator/tensor/elemwise_unary_op_basic.cc`)."""
+    return jax.lax.stop_gradient(data)
+
+
+alias("stop_gradient", "BlockGrad")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _make_loss_core(data, grad_scale, normalization, valid_thresh):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, normalization, valid_thresh):
+    return data, data
+
+
+def _make_loss_bwd(grad_scale, normalization, valid_thresh, data, g):
+    # Reference `MakeLoss` (src/operator/make_loss-inl.h): the incoming head
+    # gradient is ignored; d(data) = grad_scale, normalized by batch size
+    # ("batch") or by the count of entries > valid_thresh ("valid").
+    grad = jnp.full_like(data, grad_scale)
+    if normalization == "batch":
+        grad = grad / data.shape[0]
+    elif normalization == "valid":
+        n = jnp.maximum((data > valid_thresh).sum().astype(data.dtype), 1.0)
+        grad = grad / n
+    return (grad,)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return _make_loss_core(data, float(grad_scale), str(normalization),
+                           float(valid_thresh))
+
+
+alias("make_loss", "MakeLoss")
+
+
+def _regression_output(kind):
+    """Build a reference-style regression loss layer: forward applies the
+    link function; backward is (link(data) - label) * grad_scale / batch,
+    with the head gradient ignored (`src/operator/regression_output-inl.h`)."""
+    links = {
+        "linear": (lambda x: x, lambda o, l: o - l),
+        "logistic": (jax.nn.sigmoid, lambda o, l: o - l),
+        "mae": (lambda x: x, lambda o, l: jnp.sign(o - l)),
+    }
+    link, dloss = links[kind]
+
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return link(data)
+
+    def fwd(data, label, grad_scale):
+        out = link(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        # the reference normalises by the number of outputs per example
+        n = max(int(np.prod(out.shape[1:])), 1)
+        grad = dloss(out, label.astype(out.dtype)) * (grad_scale / n)
+        return grad, _zero_cot(label)
+
+    core.defvjp(fwd, bwd)
+
+    def op(data, label=None, grad_scale=1.0):
+        if label is None:
+            return link(data)
+        return core(data, label, float(grad_scale))
+
+    return op
+
+
+register("LinearRegressionOutput")(_regression_output("linear"))
+register("LogisticRegressionOutput")(_regression_output("logistic"))
+register("MAERegressionOutput")(_regression_output("mae"))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_core(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_output_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_output_bwd(margin, reg_coef, use_linear, res, g):
+    # Reference `SVMOutput` (src/operator/svm_output-inl.h): multi-class
+    # hinge. For true class l: violation_j = [j != l] * [f_j - f_l + m > 0];
+    # linear: d_j = +c * viol_j, d_l = -c * sum(viol); squared: scaled by the
+    # margin violation magnitude. Head gradient ignored (loss layer).
+    data, label = res
+    lab = label.astype(jnp.int32)
+    f_l = jnp.take_along_axis(data, lab[..., None], axis=-1)
+    viol = data - f_l + margin
+    onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=data.dtype)
+    active = (viol > 0).astype(data.dtype) * (1.0 - onehot)
+    if use_linear:
+        grad = active - onehot * active.sum(-1, keepdims=True)
+    else:
+        sv = 2.0 * viol * active
+        grad = sv - onehot * sv.sum(-1, keepdims=True)
+    return grad * reg_coef, _zero_cot(label)
+
+
+_svm_output_core.defvjp(_svm_output_fwd, _svm_output_bwd)
+
+
+@register("SVMOutput")
+def svm_output(data, label=None, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    if label is None:
+        return data
+    return _svm_output_core(data, label, float(margin),
+                            float(regularization_coefficient),
+                            bool(use_linear))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Huber-style loss (reference `smooth_l1`,
+    `src/operator/tensor/elemwise_binary_scalar_op_extended.cc`):
+    0.5*(s*x)^2 if |x| < 1/s^2 else |x| - 0.5/s^2."""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data,
+                     absd - 0.5 / s2)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    """Reference `SoftmaxActivation` (deprecated upstream in favour of
+    `softmax`): instance mode softmaxes over all non-batch dims flattened;
+    channel mode over axis 1."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# LRN
+# ---------------------------------------------------------------------------
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (NCHW), reference
+    `src/operator/nn/lrn.cc`: out = x / (k + alpha/n * sum_window x^2)^beta."""
+    half = nsize // 2
+    sq = data * data
+    # windowed channel sum via padded cumulative sum: O(C) and static-shape
+    pad = jnp.pad(sq, ((0, 0), (half + 1, half), (0, 0), (0, 0)))
+    csum = jnp.cumsum(pad, axis=1)
+    window = csum[:, nsize:] - csum[:, :-nsize]
+    norm = (knorm + (alpha / nsize) * window) ** beta
+    return data / norm
+
+
+# ---------------------------------------------------------------------------
+# spatial-transform family
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, gx, gy):
+    """Sample NCHW `data` at normalized coords gx,gy in [-1,1] (shape
+    (B, Ho, Wo)) with bilinear interpolation and zero padding outside."""
+    B, C, H, W = data.shape
+    x = (gx + 1.0) * (W - 1) / 2.0
+    y = (gy + 1.0) * (H - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(yi, xi):
+        inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        flat = data.reshape(B, C, H * W)
+        idx = (yc * W + xc).reshape(B, 1, -1)
+        vals = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (B, C, idx.shape[-1])), axis=2)
+        vals = vals.reshape(B, C, *xi.shape[1:])
+        return vals * inb[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """Reference `BilinearSampler` (src/operator/bilinear_sampler.cc):
+    data (B,C,H,W), grid (B,2,Ho,Wo) with grid[:,0]=x, grid[:,1]=y in
+    [-1,1]; zero padding outside."""
+    return _bilinear_sample(data, grid[:, 0], grid[:, 1])
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Reference `GridGenerator` (src/operator/grid_generator.cc).
+
+    affine: data (B,6) row-major 2x3 matrices -> grid (B,2,H,W) over the
+    target shape. warp: data (B,2,H,W) pixel flow -> normalized sampling
+    grid (identity + flow)."""
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("bij,jk->bik", theta.astype(jnp.float32), coords)
+        return out.reshape(-1, 2, H, W)
+    # warp: flow field in pixels added to the identity grid
+    B, _, H, W = data.shape
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    x = (gx[None] + data[:, 0]) * 2.0 / max(W - 1, 1) - 1.0
+    y = (gy[None] + data[:, 1]) * 2.0 / max(H - 1, 1) - 1.0
+    return jnp.stack([x, y], axis=1)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """Reference `SpatialTransformer` (src/operator/spatial_transformer.cc):
+    affine grid from `loc` (B,6) + bilinear sampling of `data`."""
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation (reference src/operator/correlation.cc): for each
+    displacement (dy,dx) on a stride2 grid within max_displacement, the
+    channel-mean of data1 * shifted(data2) (or -|a-b| when is_multiply=0),
+    averaged over a kernel_size patch. Matching the reference's geometry:
+    the padded grid is cropped by border = max_displacement + kernel_radius
+    on every side, then strided by stride1 — output
+    (B, D*D, (H+2p-2*border)//stride1 rounded up, same for W). The
+    displacement loop unrolls at trace time (static)."""
+    B, C, H, W = data1.shape
+    p = pad_size
+    a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    b = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    d = max_displacement // stride2
+    k = kernel_size // 2
+    Hp, Wp = H + 2 * p, W + 2 * p
+    rows = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            oy, ox = dy * stride2, dx * stride2
+            shifted = jnp.roll(b, (-oy, -ox), axis=(2, 3))
+            valid_y = jnp.zeros(Hp, bool).at[max(0, -oy):Hp - max(0, oy)].set(True)
+            valid_x = jnp.zeros(Wp, bool).at[max(0, -ox):Wp - max(0, ox)].set(True)
+            mask = (valid_y[:, None] & valid_x[None, :]).astype(a.dtype)
+            prod = a * shifted if is_multiply else -jnp.abs(a - shifted)
+            corr = prod.mean(axis=1) * mask
+            if kernel_size > 1:
+                pk = jnp.pad(corr, ((0, 0), (k, k), (k, k)))
+                cs = jnp.cumsum(jnp.cumsum(pk, axis=1), axis=2)
+                cs = jnp.pad(cs, ((0, 0), (1, 0), (1, 0)))
+                n = kernel_size
+                corr = (cs[:, n:, n:] - cs[:, :-n, n:] - cs[:, n:, :-n]
+                        + cs[:, :-n, :-n]) / (n * n)
+            border = max_displacement + k
+            crop = corr[:, border:Hp - border, border:Wp - border]
+            rows.append(crop[:, ::stride1, ::stride1])
+    return jnp.stack(rows, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+@register("depth_to_space")
+def depth_to_space(data, block_size):
+    """NCHW depth→space (reference src/operator/tensor/matrix_op.cc DCR)."""
+    B, C, H, W = data.shape
+    bs = block_size
+    x = data.reshape(B, bs, bs, C // (bs * bs), H, W)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(B, C // (bs * bs), H * bs, W * bs)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size):
+    B, C, H, W = data.shape
+    bs = block_size
+    x = data.reshape(B, C, H // bs, bs, W // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(B, C * bs * bs, H // bs, W // bs)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """Row-wise take (reference `batch_take`): out[i] = a[i, indices[i]]."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("ravel_multi_index")
+def ravel_multi_index(data, shape=None):
+    """(ndim, N) indices -> (N,) flat indices (reference tensor/ravel.cc)."""
+    strides = np.cumprod([1] + list(shape[::-1][:-1]))[::-1]
+    return (data.astype(jnp.int32)
+            * jnp.asarray(strides.copy(), jnp.int32)[:, None]).sum(0) \
+        .astype(data.dtype)
+
+
+@register("unravel_index")
+def unravel_index(data, shape=None):
+    """(N,) flat indices -> (ndim, N) coordinates."""
+    idx = data.astype(jnp.int32)
+    out = []
+    for dim in reversed(shape):
+        out.append(idx % dim)
+        idx = idx // dim
+    return jnp.stack(out[::-1]).astype(data.dtype)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    """Column-wise Kronecker product (reference contrib/krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+            infer_range=False, ctx=None):
+    vals = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        vals = jnp.repeat(vals, repeat)
+    return vals
+
+
+@register("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32",
+              ctx=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=dtype)
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=dtype)
+
+
+@register("_contrib_fft")
+def fft(data, compute_size=128):
+    """Reference contrib FFT (src/operator/contrib/fft.cc): real input
+    (..., d) -> interleaved re/im (..., 2d)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1).reshape(*data.shape[:-1], -1)
+
+
+@register("_contrib_ifft")
+def ifft(data, compute_size=128):
+    """Inverse of `_contrib_fft`: interleaved (..., 2d) -> real (..., d).
+    The reference scales by 1/d (numpy ifft semantics)."""
+    re = data[..., 0::2]
+    im = data[..., 1::2]
+    return jnp.fft.ifft(re + 1j * im, axis=-1).real.astype(data.dtype)

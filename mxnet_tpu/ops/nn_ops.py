@@ -108,7 +108,8 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 
 @register("Pooling")
 def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
-            pad=None, pooling_convention="valid", count_include_pad=True, layout=None):
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            layout=None, p_value=2):
     n = data.ndim - 2
     if global_pool:
         kernel = data.shape[2:]
@@ -144,7 +145,12 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return summed / counts
     if pool_type == "lp":
-        raise NotImplementedError("lp pooling")
+        # Lp pooling: (sum |x|^p)^(1/p) over each window
+        p_val = float(p_value)
+        powed = jnp.abs(data.astype(jnp.float32)) ** p_val
+        summed = lax.reduce_window(powed, 0.0, lax.add, window, strides,
+                                   padding)
+        return (summed ** (1.0 / p_val)).astype(data.dtype)
     raise ValueError(pool_type)
 
 

@@ -192,3 +192,95 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
     new_mom = momentum * mom - lr * (g + wd * weight32)
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+# ---------------------------------------------------------------------------
+# fused multi-tensor variants (reference `multi_sgd_update`, `multi_sum_sq`,
+# `multi_mp_sgd_*` in src/operator/optimizer_op.cc / contrib/multi_*.cc):
+# one call updates a whole parameter group. Under jit, XLA fuses the group
+# into a handful of kernels — the TPU analog of the reference's fused CUDA
+# multi-tensor launch.
+# ---------------------------------------------------------------------------
+
+def _per_tensor(vals, i, default):
+    if vals is None:
+        return default
+    if isinstance(vals, (int, float)):
+        return float(vals)
+    return float(vals[i])
+
+
+@register("multi_sum_sq")
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, one fused pass (used by LARS/global clip)."""
+    return tuple(jnp.sum(a.astype(jnp.float32) ** 2) for a in arrays)
+
+
+@register("multi_sgd_update")
+def multi_sgd_update(*weights_grads, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    """weights_grads = (w0, g0, w1, g1, ...); returns the updated weights."""
+    n = num_weights if num_weights is not None else len(weights_grads) // 2
+    out = []
+    for i in range(n):
+        w, g = weights_grads[2 * i], weights_grads[2 * i + 1]
+        out.append(sgd_update(w, g, _per_tensor(lrs, i, 0.01),
+                              wd=_per_tensor(wds, i, 0.0),
+                              rescale_grad=rescale_grad,
+                              clip_gradient=clip_gradient))
+    return tuple(out)
+
+
+@register("multi_sgd_mom_update")
+def multi_sgd_mom_update(*wgm, momentum=0.0, lrs=None, wds=None,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=None):
+    """wgm = (w0, g0, m0, w1, g1, m1, ...); returns ((w, m), ...) flattened
+    as (w0, m0, w1, m1, ...)."""
+    n = num_weights if num_weights is not None else len(wgm) // 3
+    out = []
+    for i in range(n):
+        w, g, m = wgm[3 * i], wgm[3 * i + 1], wgm[3 * i + 2]
+        nw, nm = sgd_mom_update(w, g, m, _per_tensor(lrs, i, 0.01),
+                                momentum=momentum,
+                                wd=_per_tensor(wds, i, 0.0),
+                                rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        out += [nw, nm]
+    return tuple(out)
+
+
+@register("multi_mp_sgd_update")
+def multi_mp_sgd_update(*wgw32, lrs=None, wds=None, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    """wgw32 = (w0, g0, w32_0, ...): bf16/f16 weight + grad + f32 master.
+    Returns (w0, w32_0, w1, w32_1, ...)."""
+    n = num_weights if num_weights is not None else len(wgw32) // 3
+    out = []
+    for i in range(n):
+        w, g, w32 = wgw32[3 * i], wgw32[3 * i + 1], wgw32[3 * i + 2]
+        nw, nw32 = mp_sgd_update(w, g, w32, _per_tensor(lrs, i, 0.01),
+                                 wd=_per_tensor(wds, i, 0.0),
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        out += [nw, nw32]
+    return tuple(out)
+
+
+@register("multi_mp_sgd_mom_update")
+def multi_mp_sgd_mom_update(*wgmw32, momentum=0.0, lrs=None, wds=None,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    """wgmw32 = (w0, g0, m0, w32_0, ...). Returns (w0, m0, w32_0, ...)."""
+    n = num_weights if num_weights is not None else len(wgmw32) // 4
+    out = []
+    for i in range(n):
+        w, g, m, w32 = wgmw32[4 * i:4 * i + 4]
+        nw, nm, nw32 = mp_sgd_mom_update(w, g, m, w32,
+                                         _per_tensor(lrs, i, 0.01),
+                                         momentum=momentum,
+                                         wd=_per_tensor(wds, i, 0.0),
+                                         rescale_grad=rescale_grad,
+                                         clip_gradient=clip_gradient)
+        out += [nw, nm, nw32]
+    return tuple(out)

@@ -1,0 +1,124 @@
+"""contrib.text tests (reference:
+tests/python/unittest/test_contrib_text.py — vocabulary indexing rules,
+embedding load, vocabulary attachment, composite embeddings)."""
+import collections
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.contrib import text
+
+
+def make_counter():
+    return text.utils.count_tokens_from_str(
+        "the quick brown fox the quick the")
+
+
+def test_count_tokens_from_str():
+    c = make_counter()
+    assert c["the"] == 3 and c["quick"] == 2 and c["fox"] == 1
+    c2 = text.utils.count_tokens_from_str("The THE", to_lower=True)
+    assert c2["the"] == 2
+
+
+def test_vocabulary_ordering_and_limits():
+    v = text.vocab.Vocabulary(make_counter())
+    # index 0 = <unk>; then by descending freq, ties alphabetical
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "the"
+    assert v.idx_to_token[2] == "quick"
+    assert v.idx_to_token[3:] == ["brown", "fox"]
+    assert v.to_indices("the") == 1
+    assert v.to_indices(["fox", "nope"]) == [4, 0]
+    assert v.to_tokens([1, 2]) == ["the", "quick"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+    v2 = text.vocab.Vocabulary(make_counter(), most_freq_count=2,
+                               reserved_tokens=["<pad>"])
+    assert v2.idx_to_token == ["<unk>", "<pad>", "the", "quick"]
+    v3 = text.vocab.Vocabulary(make_counter(), min_freq=2)
+    assert set(v3.idx_to_token) == {"<unk>", "the", "quick"}
+
+
+def test_vocabulary_validation():
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(make_counter(), min_freq=0)
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(make_counter(),
+                              reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(make_counter(), reserved_tokens=["a", "a"])
+
+
+def write_embedding(path, header=False):
+    lines = []
+    if header:
+        lines.append("3 4")
+    lines += ["the 1 2 3 4", "fox 5 6 7 8", "dog 9 10 11 12"]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_custom_embedding_load_and_lookup(tmp_path):
+    p = write_embedding(tmp_path / "emb.txt")
+    emb = text.embedding.CustomEmbedding(pretrained_file_path=p)
+    assert emb.vec_len == 4
+    assert len(emb) == 4                       # <unk> + 3 tokens
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("fox").asnumpy(), [5, 6, 7, 8])
+    vecs = emb.get_vecs_by_tokens(["dog", "missing"]).asnumpy()
+    np.testing.assert_allclose(vecs[0], [9, 10, 11, 12])
+    np.testing.assert_allclose(vecs[1], np.zeros(4))   # unk -> zeros
+    emb.update_token_vectors("the", np.ones(4, np.float32))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("the").asnumpy(), np.ones(4))
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", np.ones(4, np.float32))
+
+
+def test_fasttext_header_tolerated(tmp_path):
+    p = write_embedding(tmp_path / "wiki.vec", header=True)
+    emb = text.embedding.FastText(pretrained_file_path=p)
+    assert len(emb) == 4 and emb.vec_len == 4
+
+
+def test_registry_create_and_file_names(tmp_path):
+    p = write_embedding(tmp_path / "glove.txt")
+    emb = text.embedding.create("glove", pretrained_file_path=p)
+    assert isinstance(emb, text.embedding.GloVe)
+    names = text.embedding.get_pretrained_file_names("glove")
+    assert "glove.6B.50d.txt" in names
+    with pytest.raises(KeyError):
+        text.embedding.create("word2vec9000")
+    with pytest.raises(FileNotFoundError):
+        text.embedding.create("glove", pretrained_file_path="/nope.txt")
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    p = write_embedding(tmp_path / "emb.txt")
+    vocab = text.vocab.Vocabulary(make_counter())
+    emb = text.embedding.CustomEmbedding(pretrained_file_path=p,
+                                         vocabulary=vocab)
+    # re-indexed to the vocab's order; tokens missing from the file get unk
+    assert emb.idx_to_token == vocab.idx_to_token
+    np.testing.assert_allclose(
+        emb.idx_to_vec.asnumpy()[vocab.to_indices("the")], [1, 2, 3, 4])
+    np.testing.assert_allclose(
+        emb.idx_to_vec.asnumpy()[vocab.to_indices("quick")], np.zeros(4))
+
+
+def test_composite_embedding(tmp_path):
+    p1 = write_embedding(tmp_path / "a.txt")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("the 0.5 0.5\nquick 1 1\n")
+    e1 = text.embedding.CustomEmbedding(pretrained_file_path=p1)
+    e2 = text.embedding.CustomEmbedding(pretrained_file_path=str(p2))
+    vocab = text.vocab.Vocabulary(make_counter())
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 6
+    the = comp.get_vecs_by_tokens("the").asnumpy()
+    np.testing.assert_allclose(the, [1, 2, 3, 4, 0.5, 0.5])
+    # "quick": missing in e1 (zeros), present in e2
+    q = comp.get_vecs_by_tokens("quick").asnumpy()
+    np.testing.assert_allclose(q, [0, 0, 0, 0, 1, 1])

@@ -1,0 +1,91 @@
+"""Custom python operator tests (reference:
+tests/python/unittest/test_operator.py test_custom_op — registration,
+forward via nd.Custom, backward through autograd, jit-ability)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("sq_plus_b")
+class SquarePlusBProp(mx.operator.CustomOpProp):
+    def __init__(self, b="0.0"):
+        super().__init__(need_top_grad=True)
+        self.b = float(b)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        b = self.b
+
+        class SquarePlusB(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] ** 2 + b)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+        return SquarePlusB()
+
+
+def test_custom_forward():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = nd.Custom(nd.array(x), op_type="sq_plus_b", b=1.5).asnumpy()
+    assert_almost_equal(out, x ** 2 + 1.5)
+
+
+def test_custom_backward():
+    x = nd.array([1.0, -2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sq_plus_b").sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_custom_under_jit():
+    import jax
+
+    from mxnet_tpu.ops import OPS
+
+    fn = OPS["Custom"]
+    jitted = jax.jit(lambda a: fn(a, op_type="sq_plus_b", b=2.0))
+    out = np.asarray(jitted(np.array([2.0, 3.0], np.float32)))
+    assert_almost_equal(out, np.array([6.0, 11.0], np.float32))
+
+
+def test_custom_multi_output_and_errors():
+    @mx.operator.register("split_sign")
+    class SplitSignProp(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["pos", "neg"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0], in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class SplitSign(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                np.maximum(in_data[0], 0))
+                    self.assign(out_data[1], req[1],
+                                np.minimum(in_data[0], 0))
+
+            return SplitSign()
+
+    pos, neg = nd.Custom(nd.array([1.0, -2.0]), op_type="split_sign")
+    assert_almost_equal(pos.asnumpy(), [1.0, 0.0])
+    assert_almost_equal(neg.asnumpy(), [0.0, -2.0])
+
+    with pytest.raises(KeyError, match="not registered"):
+        nd.Custom(nd.array([1.0]), op_type="nope")

@@ -1,0 +1,482 @@
+"""Oracle tests for the classic-op widening: loss layers, spatial-transform
+family, LRN, tensor utilities, extended linalg, multi-tensor optimizers, and
+the SSD MultiBox family (reference:
+tests/python/unittest/test_operator.py equivalents)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.ndarray import ndarray as F
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# loss-layer / gradient-control ops
+# ---------------------------------------------------------------------------
+
+def test_blockgrad_stops_gradient():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (F.BlockGrad(x) * x).sum()
+    y.backward()
+    # d/dx [stop(x) * x] = stop(x)
+    assert_almost_equal(x.grad, x.asnumpy())
+
+
+def test_make_loss_grad_is_scale():
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = F.MakeLoss(x, grad_scale=2.0)
+    y.backward()
+    assert_almost_equal(x.grad, np.full((4, 3), 2.0))
+
+
+def test_make_loss_batch_normalization():
+    x = nd.ones((4, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = F.MakeLoss(x, normalization="batch")
+    y.backward()
+    assert_almost_equal(x.grad, np.full((4, 3), 0.25))
+
+
+def test_linear_regression_output():
+    rng = np.random.RandomState(1)
+    data = rng.rand(5, 3).astype(np.float32)
+    label = rng.rand(5, 3).astype(np.float32)
+    x = nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        out = F.LinearRegressionOutput(x, nd.array(label), grad_scale=1.0)
+    assert_almost_equal(out, data)
+    out.backward()
+    assert_almost_equal(x.grad, (data - label) / 3.0, atol=1e-6)
+
+
+def test_logistic_regression_output():
+    rng = np.random.RandomState(2)
+    data = rng.randn(4, 2).astype(np.float32)
+    label = rng.randint(0, 2, (4, 2)).astype(np.float32)
+    x = nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        out = F.LogisticRegressionOutput(x, nd.array(label))
+    sig = 1 / (1 + np.exp(-data))
+    assert_almost_equal(out, sig, atol=1e-6)
+    out.backward()
+    assert_almost_equal(x.grad, (sig - label) / 2.0, atol=1e-6)
+
+
+def test_mae_regression_output():
+    data = np.array([[1.0, -2.0]], np.float32)
+    label = np.array([[0.0, 0.0]], np.float32)
+    x = nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        out = F.MAERegressionOutput(x, nd.array(label))
+    out.backward()
+    assert_almost_equal(x.grad, np.array([[0.5, -0.5]]))
+
+
+def test_svm_output_hinge_grad():
+    # margin 1, true class 0; class 1 violates (f1 - f0 + 1 = 1.5 > 0)
+    data = np.array([[1.0, 1.5, -3.0]], np.float32)
+    label = np.array([0], np.float32)
+    x = nd.array(data)
+    x.attach_grad()
+    with autograd.record():
+        out = F.SVMOutput(x, nd.array(label), use_linear=True)
+    assert_almost_equal(out, data)
+    out.backward()
+    assert_almost_equal(x.grad, np.array([[-1.0, 1.0, 0.0]]))
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    out = F.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    ref = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    assert_almost_equal(out, ref)
+
+
+def test_softmax_activation_modes():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    ch = F.SoftmaxActivation(nd.array(x), mode="channel").asnumpy()
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert_almost_equal(ch, e / e.sum(1, keepdims=True), atol=1e-6)
+    inst = F.SoftmaxActivation(nd.array(x), mode="instance").asnumpy()
+    flat = x.reshape(2, -1)
+    ef = np.exp(flat - flat.max(1, keepdims=True))
+    assert_almost_equal(inst, (ef / ef.sum(1, keepdims=True)).reshape(x.shape),
+                        atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# LRN + spatial-transform family
+# ---------------------------------------------------------------------------
+
+def test_lrn_matches_numpy():
+    rng = np.random.RandomState(4)
+    x = rng.rand(2, 7, 3, 3).astype(np.float32)
+    nsize, alpha, beta, knorm = 5, 1e-4, 0.75, 2.0
+    out = F.LRN(nd.array(x), alpha=alpha, beta=beta, knorm=knorm,
+                nsize=nsize).asnumpy()
+    C = x.shape[1]
+    ref = np.empty_like(x)
+    for c in range(C):
+        lo, hi = max(0, c - nsize // 2), min(C, c + nsize // 2 + 1)
+        win = (x[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = x[:, c] / (knorm + alpha / nsize * win) ** beta
+    assert_almost_equal(out, ref, atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(5)
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype(np.float32)   # (1,2,4,4)
+    out = F.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    assert_almost_equal(out, x, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 1, 5, 5).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = F.SpatialTransformer(nd.array(x), nd.array(theta),
+                               target_shape=(5, 5)).asnumpy()
+    assert_almost_equal(out, x, atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 3, 3), np.float32)
+    grid = F.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 3), np.linspace(-1, 1, 3),
+                         indexing="ij")
+    assert_almost_equal(grid[0, 0], xs.astype(np.float32), atol=1e-6)
+    assert_almost_equal(grid[0, 1], ys.astype(np.float32), atol=1e-6)
+
+
+def test_correlation_self_is_mean_square():
+    rng = np.random.RandomState(7)
+    x = rng.rand(1, 3, 4, 4).astype(np.float32)
+    out = F.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                        max_displacement=0, stride1=1, stride2=1,
+                        pad_size=0).asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert_almost_equal(out[0, 0], (x * x).mean(axis=1)[0], atol=1e-6)
+
+
+def test_correlation_flownet_geometry():
+    # reference output geometry: border = max_displacement + kernel_radius
+    # cropped from the padded grid (FlowNet config: 8x8, pad 4, disp 4)
+    rng = np.random.RandomState(17)
+    a = rng.rand(1, 2, 8, 8).astype(np.float32)
+    b = rng.rand(1, 2, 8, 8).astype(np.float32)
+    out = F.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                        max_displacement=4, stride1=1, stride2=1,
+                        pad_size=4).asnumpy()
+    assert out.shape == (1, 81, 8, 8)
+    # center displacement (dy=dx=0) over the crop == plain channel mean
+    assert_almost_equal(out[0, 40], (a * b).mean(axis=1)[0], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# tensor utilities
+# ---------------------------------------------------------------------------
+
+def test_depth_space_roundtrip():
+    rng = np.random.RandomState(8)
+    x = rng.rand(2, 8, 3, 3).astype(np.float32)
+    d2s = F.depth_to_space(nd.array(x), block_size=2)
+    assert d2s.shape == (2, 2, 6, 6)
+    back = F.space_to_depth(d2s, block_size=2).asnumpy()
+    assert_almost_equal(back, x)
+
+
+def test_batch_take():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2, 1, 0], np.float32)
+    out = F.batch_take(nd.array(a), nd.array(idx)).asnumpy()
+    assert_almost_equal(out, a[np.arange(4), idx.astype(int)])
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = np.array([0, 7, 59, 23], np.int32)
+    coords = F.unravel_index(nd.array(flat), shape=shape).asnumpy()
+    ref = np.stack(np.unravel_index(flat, shape))
+    assert_almost_equal(coords, ref)
+    back = F.ravel_multi_index(nd.array(coords.astype(np.int32)),
+                               shape=shape).asnumpy()
+    assert_almost_equal(back, flat)
+
+
+def test_khatri_rao():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(9, dtype=np.float32).reshape(3, 3)
+    out = F.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    ref = np.stack([np.kron(a[:, i], b[:, i]).reshape(-1)
+                    for i in range(3)], axis=1)
+    assert_almost_equal(out, ref)
+
+
+def test_arange_linspace_eye():
+    assert_almost_equal(F._arange(start=1, stop=7, step=2).asnumpy(),
+                        np.arange(1, 7, 2, dtype=np.float32))
+    assert_almost_equal(F._arange(start=0, stop=3, repeat=2).asnumpy(),
+                        np.repeat(np.arange(3, dtype=np.float32), 2))
+    assert_almost_equal(F._linspace(start=0, stop=1, num=5).asnumpy(),
+                        np.linspace(0, 1, 5, dtype=np.float32))
+    assert_almost_equal(F._eye(N=3, M=4, k=1).asnumpy(), np.eye(3, 4, k=1))
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(9)
+    x = rng.rand(2, 8).astype(np.float32)
+    f = F._contrib_fft(nd.array(x))
+    assert f.shape == (2, 16)
+    ref = np.fft.fft(x, axis=-1)
+    assert_almost_equal(f.asnumpy()[:, 0::2], ref.real, atol=1e-4)
+    assert_almost_equal(f.asnumpy()[:, 1::2], ref.imag, atol=1e-4)
+    back = F._contrib_ifft(f).asnumpy()
+    assert_almost_equal(back, x, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# extended linalg
+# ---------------------------------------------------------------------------
+
+def test_linalg_syevd_reconstructs():
+    rng = np.random.RandomState(10)
+    a = rng.rand(4, 4).astype(np.float32)
+    a = (a + a.T) / 2
+    u, lam = F.linalg_syevd(nd.array(a))
+    u, lam = u.asnumpy(), lam.asnumpy()
+    assert_almost_equal(u.T @ np.diag(lam) @ u, a, atol=1e-4)
+
+
+def test_linalg_gelqf():
+    rng = np.random.RandomState(11)
+    a = rng.rand(3, 5).astype(np.float32)
+    L, Q = F.linalg_gelqf(nd.array(a))
+    L, Q = L.asnumpy(), Q.asnumpy()
+    assert_almost_equal(L @ Q, a, atol=1e-5)
+    assert_almost_equal(Q @ Q.T, np.eye(3), atol=1e-5)
+
+
+def test_linalg_inverse_det_slogdet():
+    rng = np.random.RandomState(12)
+    a = rng.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    inv = F.linalg_inverse(nd.array(a)).asnumpy()
+    assert_almost_equal(inv @ a, np.eye(3), atol=1e-5)
+    det = float(F.linalg_det(nd.array(a)).asnumpy())
+    assert abs(det - np.linalg.det(a)) < 1e-3
+    sign, logabs = F.linalg_slogdet(nd.array(a))
+    assert_almost_equal(float(sign.asnumpy()) * np.exp(float(logabs.asnumpy())),
+                        np.linalg.det(a), rtol=1e-4)
+
+
+def test_linalg_diag_trian_roundtrip():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    m = F.linalg_makediag(nd.array(v)).asnumpy()
+    assert_almost_equal(m, np.diag(v))
+    back = F.linalg_extractdiag(nd.array(m)).asnumpy()
+    assert_almost_equal(back, v)
+    tri = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], np.float32)
+    t = F.linalg_maketrian(nd.array(tri)).asnumpy()
+    assert_almost_equal(t, np.array([[1, 0, 0], [2, 3, 0], [4, 5, 6]],
+                                    np.float32))
+    assert_almost_equal(F.linalg_extracttrian(nd.array(t)).asnumpy(), tri)
+    # nonzero offset: make/extract must agree (offset sign picks the side)
+    v = np.array([7.0, 8.0, 9.0], np.float32)
+    up = F.linalg_maketrian(nd.array(v), offset=1)
+    assert_almost_equal(
+        F.linalg_extracttrian(up, offset=1).asnumpy(), v)
+    lo = F.linalg_maketrian(nd.array(v), offset=-1)
+    assert_almost_equal(
+        F.linalg_extracttrian(lo, offset=-1).asnumpy(), v)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor optimizer ops
+# ---------------------------------------------------------------------------
+
+def test_multi_sum_sq():
+    a = np.array([1.0, 2.0], np.float32)
+    b = np.array([[3.0]], np.float32)
+    out = F.multi_sum_sq(nd.array(a), nd.array(b))
+    assert_almost_equal(float(out[0].asnumpy()), 5.0)
+    assert_almost_equal(float(out[1].asnumpy()), 9.0)
+
+
+def test_multi_sgd_matches_single():
+    rng = np.random.RandomState(13)
+    ws = [rng.rand(3).astype(np.float32), rng.rand(2, 2).astype(np.float32)]
+    gs = [rng.rand(3).astype(np.float32), rng.rand(2, 2).astype(np.float32)]
+    flat = []
+    for w, g in zip(ws, gs):
+        flat += [nd.array(w), nd.array(g)]
+    outs = F.multi_sgd_update(*flat, lrs=(0.1, 0.2), wds=(0.0, 0.01))
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        single = F.sgd_update(nd.array(w), nd.array(g), [0.1, 0.2][i],
+                              wd=[0.0, 0.01][i])
+        assert_almost_equal(outs[i].asnumpy(), single.asnumpy())
+
+
+def test_multi_sgd_mom_matches_single():
+    rng = np.random.RandomState(14)
+    w, g, m = [rng.rand(4).astype(np.float32) for _ in range(3)]
+    nw, nm = F.multi_sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                    momentum=0.9, lrs=(0.05,), wds=(0.0,))
+    rw, rm = F.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m), 0.05,
+                              momentum=0.9)
+    assert_almost_equal(nw.asnumpy(), rw.asnumpy())
+    assert_almost_equal(nm.asnumpy(), rm.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family + ROIPooling + adaptive pooling + Proposal
+# ---------------------------------------------------------------------------
+
+def test_multibox_prior_counts_and_centers():
+    data = nd.zeros((1, 3, 2, 2))
+    anchors = F._contrib_MultiBoxPrior(
+        data, sizes=(0.5, 0.25), ratios=(1.0, 2.0)).asnumpy()
+    # A = 2 sizes + 2 ratios - 1 = 3 per position, 4 positions
+    assert anchors.shape == (1, 12, 4)
+    first = anchors[0, 0]
+    # first anchor: center (0.25, 0.25), size 0.5 -> [0, 0, 0.5, 0.5]
+    assert_almost_equal(first, np.array([0, 0, 0.5, 0.5], np.float32),
+                        atol=1e-6)
+    # ratio-2 anchor of size 0.5: w = 0.5*sqrt(2), h = 0.5/sqrt(2)
+    r2 = anchors[0, 2]
+    assert abs((r2[2] - r2[0]) - 0.5 * np.sqrt(2)) < 1e-5
+    assert abs((r2[3] - r2[1]) - 0.5 / np.sqrt(2)) < 1e-5
+
+
+def test_multibox_target_perfect_match():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       np.float32)
+    # one gt exactly on anchor 0, class 2
+    label = np.array([[[2.0, 0.0, 0.0, 0.5, 0.5],
+                       [-1.0, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 2), np.float32)
+    bt, bm, ct = F._contrib_MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                           nd.array(cls_pred))
+    ct = ct.asnumpy()
+    assert ct.shape == (1, 2)
+    assert ct[0, 0] == 3.0          # class 2 -> target 3 (background=0)
+    assert ct[0, 1] == 0.0          # unmatched -> background
+    # perfect match -> zero offsets, mask on anchor 0 only
+    assert_almost_equal(bt.asnumpy()[0, :4], np.zeros(4), atol=1e-5)
+    assert_almost_equal(bm.asnumpy()[0], np.array([1, 1, 1, 1, 0, 0, 0, 0],
+                                                  np.float32))
+
+
+def test_multibox_target_padding_rows_do_not_clobber():
+    # a gt whose best IoU is BELOW the threshold must still claim its best
+    # anchor (bipartite stage), even when padding rows (cls=-1) are present
+    # — padding argmaxes land on anchor 0 and must be dropped, not scattered
+    anchors = np.array([[[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 1.0, 1.0]]],
+                       np.float32)
+    gt = np.array([[[1.0, 0.0, 0.0, 0.2, 0.9],      # IoU with anchor0 ~0.27
+                    [-1.0, 0, 0, 0, 0],
+                    [-1.0, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    _, bm, ct = F._contrib_MultiBoxTarget(nd.array(anchors), nd.array(gt),
+                                          nd.array(cls_pred),
+                                          overlap_threshold=0.5)
+    assert ct.asnumpy()[0, 0] == 2.0     # class 1 -> target 2, forced match
+    assert bm.asnumpy()[0, :4].sum() == 4.0
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]],
+                       np.float32)
+    # zero offsets -> boxes == anchors
+    loc = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]], np.float32)
+    out = F._contrib_MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()
+    assert out.shape == (1, 2, 6)
+    # anchor 0: best non-bg class 2 (id 1), score 0.7; anchor 1: best non-bg
+    # class 1 (id 0), score 0.1 — above threshold, a valid detection
+    # (reference semantics: background only wins when all classes are below
+    # the threshold)
+    rows = {tuple(np.round(np.asarray(r[2:], np.float64), 3)): r
+            for r in out[0]}
+    r0 = rows[(0.1, 0.1, 0.4, 0.4)]
+    assert r0[0] == 1.0 and abs(r0[1] - 0.7) < 1e-6
+    r1 = rows[(0.6, 0.6, 0.9, 0.9)]
+    assert r1[0] == 0.0 and abs(r1[1] - 0.1) < 1e-6
+
+
+def test_roi_pooling_oracle():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = F.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                       spatial_scale=1.0).asnumpy()
+    ref = np.array([[[[5.0, 7.0], [13.0, 15.0]]]], np.float32)
+    assert_almost_equal(out, ref)
+
+
+def test_adaptive_avg_pooling():
+    rng = np.random.RandomState(15)
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    out = F._contrib_AdaptiveAvgPooling2D(nd.array(x),
+                                          output_size=(3, 3)).asnumpy()
+    ref = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, ref, atol=1e-6)
+    # non-divisible: 5 -> 2 bins [0:3), [2:5) per the floor/ceil rule
+    x2 = rng.rand(1, 1, 5, 5).astype(np.float32)
+    out2 = F._contrib_AdaptiveAvgPooling2D(nd.array(x2),
+                                           output_size=(2, 2)).asnumpy()
+    b0, b1 = slice(0, 3), slice(2, 5)
+    ref2 = np.array([[[[x2[0, 0, b0, b0].mean(), x2[0, 0, b0, b1].mean()],
+                       [x2[0, 0, b1, b0].mean(), x2[0, 0, b1, b1].mean()]]]])
+    assert_almost_equal(out2, ref2, atol=1e-6)
+
+
+def test_proposal_shapes_and_ordering():
+    rng = np.random.RandomState(16)
+    B, A, H, W = 1, 6, 4, 4          # scales x ratios = 2*3 = 6
+    cls_prob = rng.rand(B, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (rng.rand(B, 4 * A, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois, scores = F._contrib_Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=32, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=(2, 4), ratios=(0.5, 1, 2),
+        feature_stride=16, output_score=True)
+    rois, scores = rois.asnumpy(), scores.asnumpy()
+    assert rois.shape == (8, 5)
+    assert scores.shape == (8, 1)
+    assert (rois[:, 0] == 0).all()
+    # boxes clipped to the image
+    assert (rois[:, 1:] >= 0).all() and (rois[:, 1:] <= 63).all()
+    # scores of surviving proposals are descending
+    s = scores[:, 0]
+    live = s[s > 0]
+    assert (np.diff(live) <= 1e-6).all()
+
+
+def test_proposal_pads_when_few_anchors():
+    # anchor count (H*W*A = 24) below rpn_post_nms_top_n: output is
+    # zero-padded to the fixed size instead of crashing
+    rng = np.random.RandomState(18)
+    cls_prob = rng.rand(1, 12, 2, 2).astype(np.float32)
+    bbox_pred = np.zeros((1, 24, 2, 2), np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    rois = F._contrib_Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_post_nms_top_n=50, rpn_min_size=1, scales=(2, 4),
+        ratios=(0.5, 1, 2), feature_stride=8).asnumpy()
+    assert rois.shape == (50, 5)
+    with pytest.raises(NotImplementedError):
+        F._contrib_Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                            nd.array(im_info), iou_loss=True)
