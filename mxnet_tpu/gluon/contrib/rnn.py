@@ -1,0 +1,164 @@
+"""gluon.contrib.rnn (reference:
+`python/mxnet/gluon/contrib/rnn/rnn_cell.py` VariationalDropoutCell and
+LSTMPCell, `python/mxnet/gluon/contrib/rnn/conv_rnn_cell.py`
+Conv2DLSTMCell).
+
+VariationalDropoutCell holds one dropout mask per sequence (Gal & Ghahramani
+variational dropout): masks are sampled lazily on the first step after
+`reset()` and reused at every step. Conv2DLSTMCell is an LSTM whose i2h/h2h
+transforms are convolutions over NCHW feature maps; LSTMPCell projects the
+hidden state down to `projection_size` before it recurs."""
+from __future__ import annotations
+
+from ... import ndarray as _nd
+from ..parameter import Parameter
+from ..rnn.rnn_cell import RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Wrap `base_cell` with per-sequence (not per-step) dropout masks on
+    inputs/states/outputs. Call `reset()` between sequences to resample."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self.reset()
+
+    def reset(self):
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    @staticmethod
+    def _mask(rate, like):
+        keep = _nd._random_uniform(low=0.0, high=1.0,
+                                   shape=like.shape) >= rate
+        return keep.astype("float32") / (1.0 - rate)
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        training = autograd.is_training() or autograd.is_recording()
+        if training and self._drop_inputs > 0:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self._drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if training and self._drop_states > 0:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self._drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        out, next_states = self.base_cell(inputs, states)
+        if training and self._drop_outputs > 0:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self._drop_outputs, out)
+            out = out * self._output_mask
+        return out, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state, layout,
+                              merge_outputs, valid_length)
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projected recurrent state (reference LSTMPCell, the
+    LSTMP of Sak et al.): cell keeps `hidden_size` internals but recurs and
+    outputs a `projection_size` vector."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(4 * hidden_size, input_size),
+            allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_size, projection_size))
+        self.h2r_weight = Parameter(
+            "h2r_weight", shape=(projection_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_param_shapes(self, x_shape, *rest):
+        return {"i2h_weight": (4 * self._hidden_size, x_shape[-1])}
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        h = self._hidden_size
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * h) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                             num_hidden=4 * h)
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        c = F.sigmoid(f) * states[1] + F.sigmoid(i) * F.tanh(g)
+        hidden = F.sigmoid(o) * F.tanh(c)
+        r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                             num_hidden=self._projection_size)
+        return r, [r, c]
+
+
+class Conv2DLSTMCell(RecurrentCell):
+    """Convolutional LSTM over NCHW maps (reference Conv2DLSTMCell, Shi et
+    al. 2015). `input_shape` is (channels, H, W); gates come from i2h/h2h
+    convolutions with `same` padding so states keep the spatial shape."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, **kwargs):
+        super().__init__(**kwargs)
+        in_c, in_h, in_w = input_shape
+        self._shape = (in_c, in_h, in_w)
+        self._hidden_channels = hidden_channels
+        self._i2h_kernel = (i2h_kernel, i2h_kernel) \
+            if isinstance(i2h_kernel, int) else tuple(i2h_kernel)
+        self._h2h_kernel = (h2h_kernel, h2h_kernel) \
+            if isinstance(h2h_kernel, int) else tuple(h2h_kernel)
+        if any(k % 2 == 0 for k in self._i2h_kernel + self._h2h_kernel):
+            raise ValueError("Conv2DLSTMCell kernels must be odd for "
+                             "'same' padding")
+        self.i2h_weight = Parameter(
+            "i2h_weight",
+            shape=(4 * hidden_channels, in_c) + self._i2h_kernel)
+        self.h2h_weight = Parameter(
+            "h2h_weight",
+            shape=(4 * hidden_channels, hidden_channels) + self._h2h_kernel)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_channels,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_channels,),
+                                  init="zeros")
+
+    def state_info(self, batch_size=0):
+        _, h, w = self._shape
+        return [{"shape": (batch_size, self._hidden_channels, h, w)},
+                {"shape": (batch_size, self._hidden_channels, h, w)}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        hc = self._hidden_channels
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel,
+                            pad=tuple(k // 2 for k in self._i2h_kernel),
+                            num_filter=4 * hc)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel,
+                            pad=tuple(k // 2 for k in self._h2h_kernel),
+                            num_filter=4 * hc)
+        gates = i2h + h2h
+        i, f, g, o = F.split(gates, num_outputs=4, axis=1)
+        c = F.sigmoid(f) * states[1] + F.sigmoid(i) * F.tanh(g)
+        out = F.sigmoid(o) * F.tanh(c)
+        return out, [out, c]

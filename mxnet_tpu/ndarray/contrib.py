@@ -210,3 +210,19 @@ def isnan(x):
 
 def isfinite(x):
     return _invoke_pure(lambda a: jnp.isfinite(a), (x,))
+
+
+# --------------------------------------------------------------------------
+# registry passthrough: every `_contrib_X` op is also exposed as
+# `nd.contrib.X` (the reference's `mx.nd.contrib` namespace, generated from
+# the op registry at import in `python/mxnet/ndarray/register.py`)
+# --------------------------------------------------------------------------
+
+def __getattr__(name):
+    full = "_contrib_" + name
+    from ..ops import OPS as _OPS
+    if full in _OPS:
+        fn = getattr(_nd, full)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'nd.contrib' has no attribute '{name}'")

@@ -88,10 +88,6 @@ def get(reg_name):
     return _CUSTOM_PROPS[reg_name]
 
 
-def _as_shape_dtype(avals):
-    return [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in avals]
-
-
 @_register_op("Custom")
 def custom(*inputs, op_type=None, **kwargs):
     """The `Custom` op (reference `src/operator/custom/custom.cc`): look up

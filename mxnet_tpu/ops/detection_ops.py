@@ -300,9 +300,12 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 
     det = jax.vmap(one)(cls_prob.astype(jnp.float32),
                         loc_pred.astype(jnp.float32))
-    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
-                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
-                   force_suppress=force_suppress)
+    out = box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+    # box_nms only rewrites the score column; the documented contract is
+    # that suppressed rows ALSO carry class_id -1
+    return out.at[..., 0].set(jnp.where(out[..., 1] < 0, -1.0, out[..., 0]))
 
 
 @register("ROIPooling")
@@ -334,10 +337,14 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
         xs = jnp.arange(W, dtype=jnp.float32)
         my = (ys[None, :] >= hs[:, None]) & (ys[None, :] < he[:, None])
         mx = (xs[None, :] >= ws[:, None]) & (xs[None, :] < we[:, None])
-        m = my[:, None, :, None] & mx[None, :, None, :]      # (PH,PW,H,W)
         img = x[jnp.maximum(bidx, 0)]                        # (C,H,W)
-        vals = jnp.where(m[None], img[:, None, None], -jnp.inf)
-        pooled = vals.max(axis=(3, 4))
+        # separable masked max (rows then cols): peak intermediate is
+        # (C,PH,H,W) -> (C,PH,W), fused by XLA — not the joint
+        # (PH,PW,H,W) mask blowup
+        tmp = jnp.where(my[None, :, :, None], img[:, None, :, :],
+                        -jnp.inf).max(axis=2)                # (C,PH,W)
+        pooled = jnp.where(mx[None, None, :, :], tmp[:, :, None, :],
+                           -jnp.inf).max(axis=3)             # (C,PH,PW)
         pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
         return jnp.where(bidx >= 0, pooled, jnp.zeros_like(pooled))
 

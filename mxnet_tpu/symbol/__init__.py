@@ -581,5 +581,6 @@ def load(fname):
 
 
 from . import executor  # noqa: E402,F401
+from . import contrib   # noqa: E402,F401  (sym.contrib.<op> namespace)
 from .executor import Executor  # noqa: E402,F401
 __all__ += ["Executor"]

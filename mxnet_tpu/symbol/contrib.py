@@ -1,0 +1,17 @@
+"""sym.contrib: `_contrib_X` registry ops as `sym.contrib.X` symbols
+(reference: `python/mxnet/symbol/contrib.py`, generated from the op
+registry), plus the control-flow sugar re-exported from the op library."""
+from __future__ import annotations
+
+from ..ops import OPS as _OPS
+
+
+def __getattr__(name):
+    full = "_contrib_" + name
+    if full in _OPS:
+        from . import _make_sym_op
+        fn = _make_sym_op(full)
+        fn.__name__ = name
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'sym.contrib' has no attribute '{name}'")

@@ -1,0 +1,17 @@
+"""Docs-in-sync gate: docs/env_vars.md must match the config registry
+(tools/gen_docs.py is the generator)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir))
+
+
+def test_env_vars_doc_in_sync():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_docs.py"),
+         "--check"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr

@@ -1,0 +1,93 @@
+"""gluon.contrib tests (reference:
+tests/python/unittest/test_gluon_contrib.py — contrib.nn layers and
+contrib.rnn cells). Also guards the contrib package import itself, which
+was silently broken (`from . import rnn` with no rnn module)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import contrib, rnn
+
+
+def test_contrib_package_imports():
+    assert hasattr(contrib, "nn") and hasattr(contrib, "rnn")
+
+
+def test_variational_dropout_mask_constant_across_steps():
+    mx.random.seed(0)
+    base = rnn.RNNCell(8, input_size=4)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.base_cell.initialize()
+    x = nd.ones((2, 4))
+    states = cell.begin_state(2)
+    with autograd.record():
+        cell(x, states)
+        mask_a = cell._input_mask.asnumpy()
+        cell(x, states)
+        mask_b = cell._input_mask.asnumpy()
+    np.testing.assert_array_equal(mask_a, mask_b)
+    cell.reset()
+    assert cell._input_mask is None
+    # inference: no dropout applied
+    out, _ = cell(x, states)
+    assert cell._input_mask is None or not autograd.is_training()
+
+
+def test_variational_dropout_unroll_trains():
+    mx.random.seed(1)
+    base = rnn.LSTMCell(8, input_size=3)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.2,
+                                              drop_outputs=0.2)
+    cell.base_cell.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 5, 3).astype(np.float32))
+    with autograd.record():
+        outs, states = cell.unroll(5, x)
+        loss = outs.sum()
+    loss.backward()
+    assert outs.shape == (2, 5, 8)
+    g = cell.base_cell.i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all()
+
+
+def test_lstmp_cell_shapes_and_grad():
+    cell = contrib.rnn.LSTMPCell(hidden_size=16, projection_size=6,
+                                 input_size=4)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(1).rand(3, 4).astype(np.float32))
+    states = cell.begin_state(3)
+    assert states[0].shape == (3, 6) and states[1].shape == (3, 16)
+    with autograd.record():
+        out, new_states = cell(x, states)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (3, 6)
+    assert new_states[0].shape == (3, 6) and new_states[1].shape == (3, 16)
+    assert np.isfinite(cell.h2r_weight.grad().asnumpy()).all()
+
+
+def test_conv2d_lstm_cell():
+    cell = contrib.rnn.Conv2DLSTMCell(input_shape=(2, 6, 6),
+                                      hidden_channels=4)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(2).rand(2, 2, 6, 6).astype(np.float32))
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4, 6, 6)
+    assert new_states[1].shape == (2, 4, 6, 6)
+    # unroll over time keeps spatial shape
+    seq = nd.array(np.random.RandomState(3)
+                   .rand(2, 3, 2, 6, 6).astype(np.float32))
+    outs, _ = cell.unroll(3, seq)
+    assert outs.shape == (2, 3, 4, 6, 6)
+    with pytest.raises(ValueError, match="odd"):
+        contrib.rnn.Conv2DLSTMCell((2, 6, 6), 4, i2h_kernel=2)
+
+
+def test_contrib_nn_still_works():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    from mxnet_tpu.gluon import nn
+    net.add(nn.Dense(3), nn.Dense(5))
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 8)

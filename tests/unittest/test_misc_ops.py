@@ -416,6 +416,22 @@ def test_multibox_detection_decodes_and_nms():
     assert r1[0] == 0.0 and abs(r1[1] - 0.1) < 1e-6
 
 
+def test_multibox_detection_suppressed_rows_get_id_minus_one():
+    # two same-class anchors overlapping heavily: the NMS-suppressed one
+    # must carry class_id -1 (not just score -1)
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.5, 0.5]]],
+                       np.float32)
+    loc = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.2], [0.9, 0.8]]], np.float32)
+    out = F._contrib_MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()
+    ids = sorted(out[0, :, 0].tolist())
+    assert ids == [-1.0, 0.0]
+    sup = out[0][out[0, :, 0] == -1.0][0]
+    assert sup[1] == -1.0
+
+
 def test_roi_pooling_oracle():
     x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
     rois = np.array([[0, 0, 0, 3, 3]], np.float32)
@@ -480,3 +496,23 @@ def test_proposal_pads_when_few_anchors():
     with pytest.raises(NotImplementedError):
         F._contrib_Proposal(nd.array(cls_prob), nd.array(bbox_pred),
                             nd.array(im_info), iou_loss=True)
+
+
+def test_contrib_namespaces():
+    """nd.contrib.X / sym.contrib.X expose every `_contrib_X` registry op
+    (reference: the generated mx.nd.contrib namespace)."""
+    from mxnet_tpu import sym
+
+    rows = np.random.RandomState(0).rand(1, 8, 6).astype(np.float32)
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5)
+    ref = F._contrib_box_nms(nd.array(rows), overlap_thresh=0.5)
+    assert_almost_equal(out.asnumpy(), ref.asnumpy())
+
+    data = sym.var("data")
+    s = sym.contrib.box_nms(data, overlap_thresh=0.5)
+    o = s.bind(args={"data": nd.array(rows)}).forward()
+    o0 = o[0] if isinstance(o, (list, tuple)) else o
+    assert_almost_equal(o0.asnumpy(), ref.asnumpy())
+
+    with pytest.raises(AttributeError):
+        nd.contrib.not_a_real_op
