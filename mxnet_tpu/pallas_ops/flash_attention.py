@@ -389,10 +389,12 @@ def _flash_bwd_xla(q, k, v, bias, out, lse, g, causal, sm_scale):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-# Above this many kv positions the blockwise Pallas backward wins (memory
-# first, then bandwidth; measured 1.56x at L=4096 causal); below it XLA's
-# fused L×L backward is faster. With attention dropout the Pallas backward
-# is used at every length: only it can regenerate the kernel-PRNG masks.
+# Above this many kv positions the blockwise Pallas backward wins; below it
+# XLA's fused L×L backward is faster. Measured with 512x512 blocks at
+# BERT-base shapes: Pallas fwd+bwd 5.3ms vs Pallas-fwd+XLA-bwd 6.6ms at
+# L=512, 1.47x at L=4096 — so the crossover sits at 512. With attention
+# dropout the Pallas backward is used at every length: only it can
+# regenerate the kernel-PRNG masks.
 # Knob: config 'pallas_bwd_min_len' / MXNET_TPU_PALLAS_BWD_MIN_LEN.
 
 
@@ -436,7 +438,7 @@ def _round_up(x, m):
 
 
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
-                    block_q=256, block_k=256, dropout=0.0, dropout_key=None):
+                    block_q=512, block_k=512, dropout=0.0, dropout_key=None):
     """Multi-head attention, flash-style.
 
     Args:
@@ -464,8 +466,18 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
                              sm_scale=sm_scale, dropout=dropout,
                              dropout_key=dropout_key)
 
-    block_q = min(block_q, _round_up(Lq, 128))
-    block_k = min(block_k, _round_up(Lk, 128))
+    def _fit_block(b, L):
+        # largest 128-multiple <= b that divides the lane-padded length, so
+        # a big default block never forces padding beyond round_up(L, 128)
+        # (e.g. L=768 runs at 384 blocks unpadded instead of padding to 1024)
+        Lp = _round_up(L, 128)
+        b = min(b, Lp)
+        while Lp % b:
+            b -= 128
+        return b
+
+    block_q = _fit_block(block_q, Lq)
+    block_k = _fit_block(block_k, Lk)
     Lq_p, Lk_p = _round_up(Lq, block_q), _round_up(Lk, block_k)
     if mask is not None:
         bias = jnp.where(mask.astype(bool), 0.0, _NEG).astype(jnp.float32)
