@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""BERT pretraining on a device mesh (reference: GluonNLP
+scripts/bert/run_pretraining.py — the BASELINE.json flagship workload).
+
+Runs the sharded train step (dp x fsdp x tp mesh axes picked from the
+available devices) with the fused-LAMB optimizer and the Pallas flash
+attention kernel. Synthetic batches stand in for the masked-LM corpus in
+this offline environment; swap `make_synthetic_batch` for a RecordIO
+pipeline (`mx.io.ImageRecordIter`-style, see mxnet_tpu/io) for real data.
+
+Single chip:   python examples/bert/pretrain.py --steps 20
+8 virtual CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+               JAX_PLATFORMS=cpu python examples/bert/pretrain.py \
+               --config tiny --dp 2 --fsdp 2 --tp 2 --steps 4
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import bert as bert_mod
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="base",
+                   choices=["tiny", "base", "large"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--checkpoint", default="",
+                   help="prefix for periodic sharded checkpoints")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    parallel.make_mesh(dp=args.dp, fsdp=args.fsdp, tp=args.tp)
+    cfg = {"tiny": bert_mod.bert_tiny_config,
+           "base": lambda: bert_mod.bert_base_config(dtype="bfloat16"),
+           "large": lambda: bert_mod.bert_large_config(dtype="bfloat16")}[
+        args.config]()
+    if args.config == "tiny":
+        args.seq_len = min(args.seq_len, cfg["max_length"])
+
+    mesh = parallel.current_mesh()
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if args.batch_size % n_data:
+        raise SystemExit(
+            f"batch size {args.batch_size} must be divisible by the "
+            f"sharded data-axis size {n_data} (dp x fsdp)")
+
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "lamb",
+        {"learning_rate": args.lr, "wd": 0.01},
+        param_mode="fsdp" if args.fsdp > 1 else "replicate")
+
+    masked = max(1, args.seq_len // 7)
+    b = bert_mod.make_synthetic_batch(cfg, args.batch_size, args.seq_len,
+                                      masked, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k])
+              for k in ("mlm_labels", "mlm_weights", "nsp_labels")]
+
+    tic = time.time()
+    for step in range(1, args.steps + 1):
+        loss = trainer.step(data, labels)
+        if step % 10 == 0 or step == args.steps:
+            toks = args.batch_size * args.seq_len * step
+            print(f"step {step}: loss={float(loss.asscalar()):.4f} "
+                  f"({toks / (time.time() - tic):.0f} tokens/s)")
+        if args.checkpoint and step % 50 == 0:
+            trainer.save_checkpoint(f"{args.checkpoint}-{step:06d}")
+
+
+if __name__ == "__main__":
+    main()

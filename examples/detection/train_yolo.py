@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Train YOLOv3-tiny on synthetic detection data and evaluate VOC07 mAP
+(reference: GluonCV scripts/detection — BASELINE.json workload #4 family).
+
+Synthetic bright-square images stand in for VOC in this offline
+environment; the full stack — anchor targets, detection loss, NMS decode,
+VOC07 11-point mAP — is the real one.
+
+  python examples/detection/train_yolo.py --steps 60
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Trainer
+from mxnet_tpu.metric import VOC07MApMetric
+from mxnet_tpu.models import yolo as Y
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--lr", type=float, default=1e-3)
+    return p.parse_args()
+
+
+def synthetic_batch(rng, args, max_gt=4):
+    imgs = np.zeros((args.batch_size, 3, args.image_size, args.image_size),
+                    np.float32)
+    boxes = np.zeros((args.batch_size, max_gt, 4), np.float32)
+    labels = np.full((args.batch_size, max_gt), -1.0, np.float32)
+    for b in range(args.batch_size):
+        size = rng.randint(args.image_size // 5, args.image_size // 2)
+        x = rng.randint(0, args.image_size - size)
+        y = rng.randint(0, args.image_size - size)
+        cls = rng.randint(0, args.classes)
+        imgs[b, cls % 3, y:y + size, x:x + size] = 1.0
+        boxes[b, 0] = (x, y, x + size, y + size)
+        labels[b, 0] = cls
+    return imgs, boxes, labels
+
+
+def main():
+    args = parse_args()
+    model = Y.YOLOv3Tiny(num_classes=args.classes,
+                         image_size=args.image_size)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = Trainer(model.collect_params(), "adam",
+                      {"learning_rate": args.lr})
+    rng = np.random.RandomState(0)
+    for step in range(1, args.steps + 1):
+        imgs, boxes, labels = synthetic_batch(rng, args)
+        targets = Y.yolo_targets(model, nd.array(boxes), nd.array(labels))
+        with autograd.record():
+            preds = model(nd.array(imgs))
+            loss = Y.yolo_loss(preds, targets, args.classes)
+        loss.backward()
+        trainer.step(1)
+        if step % 10 == 0:
+            print(f"step {step}: loss={float(loss.asscalar()):.4f}")
+
+    metric = VOC07MApMetric(iou_thresh=0.5)
+    imgs, boxes, labels = synthetic_batch(rng, args)
+    preds = model(nd.array(imgs))
+    det = Y.decode_predictions(model, preds).asnumpy()
+    gt = np.concatenate([labels[:, :, None], boxes], axis=2)
+    metric.update(gt, det)
+    print("VOC07 mAP on held-out synthetic batch:", metric.get()[1])
+
+
+if __name__ == "__main__":
+    main()

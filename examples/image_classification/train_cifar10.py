@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Train a model-zoo CNN on CIFAR-10 (reference:
+example/image-classification/train_cifar10.py, gluon edition).
+
+With no dataset on disk the vision datasets fall back to deterministic
+synthetic data, so this script always runs; point MXNET_HOME at a real
+CIFAR-10 copy for actual training.
+
+  python examples/image_classification/train_cifar10.py \
+      --model resnet18_v1 --epochs 2 --batch-size 128
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import Trainer, data as gdata, loss as gloss
+from mxnet_tpu.gluon.model_zoo import get_model
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--steps-per-epoch", type=int, default=0,
+                   help="cap steps per epoch (0 = full dataset)")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    net = get_model(args.model, classes=10)
+    net.initialize(init="xavier")
+    net.hybridize()
+
+    train_set = gdata.vision.CIFAR10(train=True)
+    loader = gdata.DataLoader(train_set, batch_size=args.batch_size,
+                              shuffle=True, last_batch="discard")
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": 0.9,
+                       "wd": 1e-4})
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for i, (x, y) in enumerate(loader):
+            if args.steps_per_epoch and i >= args.steps_per_epoch:
+                break
+            x = nd.transpose(x.astype("float32") / 255.0, axes=(0, 3, 1, 2))
+            with autograd.record():
+                out = net(x)
+                loss = lfn(out, y).mean()
+            loss.backward()
+            trainer.step(1)
+            metric.update([y], [out])
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f} "
+              f"({(i + 1) * args.batch_size / (time.time() - tic):.0f} "
+              f"samples/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Classic symbolic Module.fit() training loop (reference:
+example/image-classification/train_mnist.py — the pre-Gluon API that most
+MXNet tutorials start from).
+
+Synthetic separable blobs stand in for MNIST offline; everything else is
+the classic path: Symbol graph -> Module.bind -> fit() with optimizer,
+metric, Speedometer callback, and epoch-end checkpoints.
+
+  python examples/module_api/train_mnist_module.py --epochs 10
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio, sym
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--prefix", default="", help="checkpoint prefix")
+    return p.parse_args()
+
+
+def mlp_symbol(classes=10):
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=64, name="fc2")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.FullyConnected(h, num_hidden=classes, name="fc3")
+    return sym.SoftmaxOutput(h, name="softmax", normalization="batch")
+
+
+def blob_data(n=2048, classes=10, dim=784, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.normal(0, 2.5, (classes, dim))
+    y = rs.randint(0, classes, n)
+    x = centers[y] + rs.normal(0, 0.5, (n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    args = parse_args()
+    x, y = blob_data()
+    train = mio.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True)
+    val = mio.NDArrayIter(*blob_data(512, seed=1),
+                          batch_size=args.batch_size)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.cpu())
+    callbacks = [mx.callback.Speedometer(args.batch_size, frequent=20)]
+    epoch_cb = (mx.callback.do_checkpoint(args.prefix)
+                if args.prefix else None)
+    mod.fit(train, eval_data=val, eval_metric="acc",
+            num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=callbacks,
+            epoch_end_callback=epoch_cb)
+    print("final validation:", dict(mod.score(val, "acc")))
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,8 @@ _LAZY = {
     "sym": ".symbol",
     "symbol": ".symbol",
     "model": ".module",
+    "mon": ".monitor",
+    "monitor": ".monitor",
     "operator": ".operator",
     "profiler": ".profiler",
     "parallel": ".parallel",

@@ -1,4 +1,5 @@
 """Text utilities: vocabulary + pretrained embeddings (reference:
 python/mxnet/contrib/text/ — vocab.py, embedding.py, utils.py)."""
-from . import embedding, utils, vocab          # noqa: F401
-from .vocab import Vocabulary                  # noqa: F401
+from . import embedding, tokenizer, utils, vocab   # noqa: F401
+from .tokenizer import BERTTokenizer               # noqa: F401
+from .vocab import Vocabulary                      # noqa: F401

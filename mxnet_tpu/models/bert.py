@@ -218,6 +218,78 @@ class BERTForPretraining(HybridBlock):
         return scores, self.nsp(pooled)
 
 
+class BERTForQuestionAnswering(HybridBlock):
+    """SQuAD-style span-extraction head (reference: gluonnlp
+    BertForQA, scripts/bert/finetune_squad.py — the BASELINE SQuAD-F1
+    quality-gate workload): a single Dense projects each token to
+    (start, end) logits."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        self.bert = BERTModel(**cfg)
+        self.span = nn.Dense(2, in_units=cfg["units"], flatten=False,
+                             dtype=cfg["dtype"], weight_initializer="xavier")
+
+    def forward(self, inputs, token_types, valid_length=None):
+        """Returns (start_logits (B, L), end_logits (B, L)); positions past
+        valid_length are masked to -inf so softmax ignores padding."""
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+        seq, _ = self.bert(inputs, token_types, valid_length)
+        logits = self.span(seq)                      # (B, L, 2)
+
+        def split_mask(lg, vl=None):
+            start, end = lg[..., 0], lg[..., 1]
+            if vl is not None:
+                L = lg.shape[1]
+                live = jnp.arange(L)[None, :] < vl[:, None].astype(jnp.int32)
+                start = jnp.where(live, start, -1e9)
+                end = jnp.where(live, end, -1e9)
+            return start, end
+
+        if valid_length is None:
+            return apply_op(split_mask, logits)
+        return apply_op(split_mask, logits, valid_length)
+
+
+def bert_qa_loss(start_logits, end_logits, start_positions, end_positions):
+    """Mean cross-entropy of the gold start/end positions (reference:
+    finetune_squad.py loss)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import apply_op
+
+    def one(lg, pos):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.take_along_axis(
+            logp, pos.astype(jnp.int32)[:, None], 1).mean()
+
+    a = apply_op(one, start_logits, start_positions)
+    b = apply_op(one, end_logits, end_positions)
+    return (a + b) / 2
+
+
+class BERTClassifier(HybridBlock):
+    """Sentence(-pair) classification head over the pooled output
+    (reference: gluonnlp BERTClassifier, finetune_classifier.py)."""
+
+    def __init__(self, cfg, num_classes=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        self.bert = BERTModel(**cfg)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+        self.classifier = nn.Dense(num_classes, in_units=cfg["units"],
+                                   dtype=cfg["dtype"],
+                                   weight_initializer="xavier")
+
+    def forward(self, inputs, token_types, valid_length=None):
+        _, pooled = self.bert(inputs, token_types, valid_length)
+        if self.dropout is not None:
+            pooled = self.dropout(pooled)
+        return self.classifier(pooled)
+
+
 def bert_pretrain_loss(mlm_scores, nsp_scores, mlm_labels, mlm_weights, nsp_labels):
     """Pretraining loss on NDArrays (ShardedTrainer loss_fn AND eager
     autograd compatible). mlm_scores (B,P,V), mlm_labels (B,P),

@@ -259,6 +259,13 @@ class Module(BaseModule):
             self.load_optimizer_states(pre[2])
 
     # ------------------------------------------------------------------
+    def install_monitor(self, mon):
+        """Attach a `mx.monitor.Monitor`: records the executor's outputs,
+        params, and grads on activated batches (reference:
+        Module.install_monitor)."""
+        self._monitor = mon
+        mon._params = None  # this path feeds mon._activations directly
+
     def forward(self, data_batch, is_train=None):
         if not self.binded:
             raise MXNetError("forward: call bind first")
@@ -271,6 +278,19 @@ class Module(BaseModule):
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
         self._exec.forward(is_train=bool(is_train), **feed)
+        mon = getattr(self, "_monitor", None)
+        if mon is not None and mon.activated:
+            outs = self._exec.outputs
+            out_names = self._symbol.list_outputs()
+            for i, o in enumerate(outs):
+                tag = out_names[i] if i < len(out_names) else f"output{i}"
+                mon._activations.append((tag, o))
+            for name in self._param_names:
+                mon._activations.append((name, self._exec.arg_dict[name]))
+                if mon.monitor_gradient:
+                    g = self._exec.grad_dict.get(name)
+                    if g is not None:
+                        mon._activations.append((name + "_grad", g))
 
     def backward(self, out_grads=None):
         self._exec.backward(out_grads)

@@ -437,6 +437,19 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
+def _fit_block(b, L):
+    """Largest 128-multiple <= b that divides the lane-padded length, so a
+    big default block never forces padding beyond round_up(L, 128) (e.g.
+    L=768 runs at 384 blocks unpadded instead of padding to 1024).
+    Arbitrary caller values are clamped into the 128-multiple grid first;
+    128 always divides Lp, so the loop terminates."""
+    Lp = _round_up(L, 128)
+    b = max(128, min(b, Lp) // 128 * 128)
+    while Lp % b:
+        b -= 128
+    return b
+
+
 def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
                     block_q=512, block_k=512, dropout=0.0, dropout_key=None):
     """Multi-head attention, flash-style.
@@ -465,16 +478,6 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
         return mha_reference(q, k, v, bias=bias, causal=causal,
                              sm_scale=sm_scale, dropout=dropout,
                              dropout_key=dropout_key)
-
-    def _fit_block(b, L):
-        # largest 128-multiple <= b that divides the lane-padded length, so
-        # a big default block never forces padding beyond round_up(L, 128)
-        # (e.g. L=768 runs at 384 blocks unpadded instead of padding to 1024)
-        Lp = _round_up(L, 128)
-        b = min(b, Lp)
-        while Lp % b:
-            b -= 128
-        return b
 
     block_q = _fit_block(block_q, Lq)
     block_k = _fit_block(block_k, Lk)

@@ -1,0 +1,49 @@
+"""Smoke-run every example script with tiny settings (reference: the CI
+jobs that execute example/ scripts nightly). Each must exit 0 and print
+its progress lines."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir))
+
+CASES = [
+    ("image_classification/train_cifar10.py",
+     ["--model", "mobilenet0.25", "--epochs", "1", "--batch-size", "32",
+      "--steps-per-epoch", "3"], "epoch 0"),
+    ("bert/pretrain.py",
+     ["--config", "tiny", "--batch-size", "8", "--seq-len", "32",
+      "--steps", "3"], "step 3"),
+    ("nmt/train_transformer.py",
+     ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
+      "--units", "32"], "decode token accuracy"),
+    ("detection/train_yolo.py",
+     ["--steps", "4", "--batch-size", "4"], "VOC07 mAP"),
+    ("timeseries/train_deepar.py",
+     ["--epochs", "10", "--series", "8", "--samples", "5"], "CRPS"),
+    ("module_api/train_mnist_module.py",
+     ["--epochs", "2"], "final validation"),
+]
+
+
+@pytest.mark.parametrize("script,args,expect",
+                         CASES, ids=[c[0] for c in CASES])
+def test_example_runs(script, args, expect):
+    # JAX_PLATFORMS=cpu alone is NOT enough on this image — the baked axon
+    # plugin re-registers itself and backend init hangs probing the TPU
+    # tunnel; jax.config.update after import is required (same trick as
+    # tests/conftest.py), hence the runpy wrapper
+    path = os.path.join(ROOT, "examples", script)
+    wrapper = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, runpy; sys.argv = [sys.argv[1]] + sys.argv[2:]; "
+        "runpy.run_path(sys.argv[0], run_name='__main__')")
+    r = subprocess.run(
+        [sys.executable, "-c", wrapper, path] + args,
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert expect in r.stdout, r.stdout[-2000:]

@@ -1,0 +1,180 @@
+"""BERT finetuning heads + tokenizer (reference: gluonnlp BertForQA /
+BERTClassifier / BERTTokenizer, scripts/bert/finetune_*.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, parallel
+from mxnet_tpu.contrib import text
+from mxnet_tpu.models import bert as bert_mod
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _inputs(cfg, B=2, L=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg["vocab_size"], (B, L)).astype(np.int32)
+    types = np.zeros((B, L), np.int32)
+    valid = np.full((B,), L, np.int32)
+    valid[1] = L - 4
+    return nd.array(ids), nd.array(types), nd.array(valid)
+
+
+def test_qa_head_shapes_masks_and_grad():
+    cfg = bert_mod.bert_tiny_config()
+    model = bert_mod.BERTForQuestionAnswering(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    ids, types, valid = _inputs(cfg)
+    start, end = model(ids, types, valid)
+    assert start.shape == (2, 16) and end.shape == (2, 16)
+    # padding positions masked to -inf-ish for the shorter row
+    assert (start.asnumpy()[1, 12:] < -1e8).all()
+    assert (start.asnumpy()[1, :12] > -1e8).all()
+
+    sp = nd.array(np.array([1, 3], np.int32))
+    ep = nd.array(np.array([2, 5], np.int32))
+    with autograd.record():
+        s, e = model(ids, types, valid)
+        loss = bert_mod.bert_qa_loss(s, e, sp, ep)
+    loss.backward()
+    g = model.span.weight.grad()
+    assert np.isfinite(float(loss.asscalar()))
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_qa_finetune_overfits_tiny():
+    """The span head must overfit a fixed batch — the offline stand-in for
+    the SQuAD-F1 quality gate."""
+    from mxnet_tpu.gluon import Trainer
+
+    cfg = bert_mod.bert_tiny_config()
+    model = bert_mod.BERTForQuestionAnswering(cfg)
+    mx.random.seed(1)
+    model.initialize()
+    ids, types, valid = _inputs(cfg, B=4)
+    sp = nd.array(np.array([1, 3, 0, 7], np.int32))
+    ep = nd.array(np.array([2, 5, 4, 9], np.int32))
+    trainer = Trainer(model.collect_params(), "adam",
+                      {"learning_rate": 1e-3})
+    first = None
+    for _ in range(30):
+        with autograd.record():
+            s, e = model(ids, types, valid)
+            loss = bert_mod.bert_qa_loss(s, e, sp, ep)
+        loss.backward()
+        trainer.step(1)
+        first = first if first is not None else float(loss.asscalar())
+    last = float(loss.asscalar())
+    assert last < 0.5 * first, (first, last)
+    # exact-match on the overfit batch
+    s, e = model(ids, types, valid)
+    assert (s.asnumpy().argmax(1) == sp.asnumpy()).mean() >= 0.75
+
+
+def test_classifier_head():
+    cfg = bert_mod.bert_tiny_config()
+    model = bert_mod.BERTClassifier(cfg, num_classes=3)
+    mx.random.seed(2)
+    model.initialize()
+    ids, types, valid = _inputs(cfg)
+    out = model(ids, types, valid)
+    assert out.shape == (2, 3)
+    with autograd.record():
+        out = model(ids, types, valid)
+        loss = out.square().sum()
+    loss.backward()
+    assert np.isfinite(loss.asscalar())
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##ed", "##s", "over", "!", "un", "##believ",
+         "##able"]
+
+
+def _tok():
+    return text.tokenizer.BERTTokenizer(
+        {t: i for i, t in enumerate(VOCAB)})
+
+
+def test_basic_tokenizer():
+    bt = text.tokenizer.BasicTokenizer(lower=True)
+    assert bt("The quick,  Brown\tfox!") == \
+        ["the", "quick", ",", "brown", "fox", "!"]
+
+
+def test_wordpiece_greedy_longest_match():
+    tok = _tok()
+    assert tok("jumped") == ["jump", "##ed"]
+    assert tok("jumps") == ["jump", "##s"]
+    assert tok("unbelievable") == ["un", "##believ", "##able"]
+    assert tok("zzz") == ["[UNK]"]
+
+
+def test_bert_tokenizer_encode():
+    tok = _tok()
+    ids, types, valid = tok.encode("the quick fox", "jumped !",
+                                   max_length=12)
+    assert len(ids) == 12 and len(types) == 12
+    toks = [VOCAB[i] for i in ids[:valid]]
+    assert toks[0] == "[CLS]" and toks.count("[SEP]") == 2
+    # token types: 0 for the first segment (incl CLS/SEP), 1 for second
+    sep1 = toks.index("[SEP]")
+    assert all(t == 0 for t in types[:sep1 + 1])
+    assert all(t == 1 for t in types[sep1 + 1:valid])
+    assert all(i == 0 for i in ids[valid:])          # [PAD]
+
+
+def test_encode_truncates_text_not_separators():
+    tok = _tok()
+    # budget forces truncation; both terminal [SEP]s must survive
+    ids, types, valid = tok.encode("the quick brown fox", "jumped over",
+                                   max_length=8)
+    toks = [VOCAB[i] for i in ids[:valid]]
+    assert toks[0] == "[CLS]" and toks.count("[SEP]") == 2
+    assert toks[-1] == "[SEP]"
+    assert valid == 8
+    # segment-1 still present (the longer segment was trimmed first)
+    sep1 = toks.index("[SEP]")
+    assert valid - sep1 - 2 >= 1      # at least one token of text_b
+
+
+def test_cjk_chars_split_individually():
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "中", "国", "the"])}
+    tok = text.tokenizer.BERTTokenizer(vocab)
+    assert tok("the中国") == ["the", "中", "国"]
+
+
+def test_fit_block_handles_odd_requests():
+    # arbitrary caller block sizes must not hang flash_attention's
+    # TPU-dispatch clamp
+    from mxnet_tpu.pallas_ops.flash_attention import _fit_block
+
+    assert _fit_block(100, 512) == 128
+    assert _fit_block(0, 512) == 128
+    assert _fit_block(512, 768) == 384
+    assert _fit_block(512, 512) == 512
+    assert _fit_block(1024, 512) == 512
+    assert _fit_block(512, 640) == 128
+
+
+def test_tokenizer_from_vocabulary_and_file(tmp_path):
+    import collections
+    v = text.vocab.Vocabulary(collections.Counter(
+        {"fox": 3, "the": 5}), reserved_tokens=["[CLS]"],
+        unknown_token="[UNK]")
+    tok = text.tokenizer.BERTTokenizer(v)
+    assert tok.convert_tokens_to_ids(["the"]) == [v.to_indices("the")]
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    tok2 = text.tokenizer.BERTTokenizer(str(p))
+    assert tok2("jumped") == ["jump", "##ed"]

@@ -1,0 +1,75 @@
+"""mx.monitor.Monitor tests (reference:
+tests/python/unittest/test_monitor.py — interval activation, regex
+filtering, output/param/grad stats on both gluon and Module paths)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu import io as mio
+from mxnet_tpu.gluon import nn, Trainer
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_monitor_gluon_interval_and_stats():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=2)
+    mon.install(net)
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    y = nd.array(np.array([0, 1, 0, 1], np.float32))
+
+    seen = []
+    for step in range(4):
+        active = mon.tic()
+        assert active == (step % 2 == 0)
+        with autograd.record():
+            loss = lfn(net(x), y).mean()
+        loss.backward()
+        tr.step(1)
+        rows = mon.toc()
+        seen.append(len(rows))
+    # activated batches produce rows (activations + params + grads);
+    # inactive batches produce none
+    assert seen[0] > 0 and seen[2] > 0
+    assert seen[1] == 0 and seen[3] == 0
+
+
+def test_monitor_pattern_filters():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*weight.*",
+                             monitor_gradient=False)
+    mon.install(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    rows = mon.toc()
+    assert rows, "expected weight rows"
+    assert all("weight" in name for _, name, _ in rows)
+
+
+def test_monitor_module_path():
+    from mxnet_tpu import sym
+
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    out = sym.SoftmaxOutput(h, name="softmax", normalization="batch")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    x = np.random.RandomState(1).rand(8, 3).astype(np.float32)
+    y = np.random.RandomState(2).randint(0, 4, 8).astype(np.float32)
+    it = mio.NDArrayIter(x, y, batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mon = mx.monitor.Monitor(interval=1)
+    mod.install_monitor(mon)
+    batch = next(iter(it))
+    mon.tic()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    rows = mon.toc()
+    names = [name for _, name, _ in rows]
+    assert any("fc1" in n for n in names)
+    assert any(n.endswith("_grad") for n in names)
