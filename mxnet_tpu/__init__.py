@@ -48,6 +48,8 @@ _LAZY = {
     "model": ".module",
     "mon": ".monitor",
     "monitor": ".monitor",
+    "name": ".name",
+    "runtime": ".runtime",
     "operator": ".operator",
     "profiler": ".profiler",
     "parallel": ".parallel",

@@ -31,7 +31,9 @@ __all__ = [
     "HorizontalFlipAug", "BrightnessJitterAug", "ContrastJitterAug",
     "SaturationJitterAug", "ColorJitterAug", "HueJitterAug", "LightingAug",
     "ColorNormalizeAug", "RandomGrayAug", "CastAug", "CreateAugmenter",
-    "ImageIter",
+    "ImageIter", "ImageDetIter", "CreateDetAugmenter",
+    "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+    "DetRandomCropAug", "DetRandomPadAug",
 ]
 
 
@@ -148,7 +150,8 @@ def random_size_crop(src, size, area, ratio, interp=2):
 
 def color_normalize(src, mean, std=None):
     arr = _to_np(src).astype(np.float32)
-    arr = arr - np.asarray(_to_np(mean), np.float32)
+    if mean is not None:
+        arr = arr - np.asarray(_to_np(mean), np.float32)
     if std is not None:
         arr = arr / np.asarray(_to_np(std), np.float32)
     return nd_array(arr)
@@ -520,3 +523,269 @@ class ImageIter(DataIter):
         return DataBatch(data=[nd_array(batch_data)],
                          label=[nd_array(label_out)],
                          pad=self.batch_size - i)
+
+
+# ---------------------------------------------------------------------------
+# detection augmenters + ImageDetIter (reference: mx.image.detection —
+# CreateDetAugmenter and ImageDetIter, the SSD-era python detection
+# pipeline). Labels are (N, 5+) rows [cls, x1, y1, x2, y2] with coordinates
+# normalized to [0, 1]; augmenters transform image AND boxes together.
+# ---------------------------------------------------------------------------
+
+class DetAugmenter:
+    """Base: __call__(src_hwc, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only Augmenter into the detection pipeline."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and box x-coordinates with probability p."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            arr = _to_np(src)[:, ::-1]
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+            return arr, label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger mean-filled canvas and rescale
+    boxes (the reference's rand_pad expansion). The canvas aspect ratio is
+    sampled from `aspect_ratio_range`, retrying up to `max_attempts` times
+    for a canvas that actually contains the image."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=10,
+                 pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(max(1.0, self.area_range[0]),
+                                      self.area_range[1])
+            ratio = _pyrandom.uniform(*self.ratio_range)
+            new_h = int(h * scale / (ratio ** 0.5))
+            new_w = int(w * scale * (ratio ** 0.5))
+            if new_h > h and new_w > w:
+                break
+        else:
+            return arr, label
+        y0 = _pyrandom.randint(0, new_h - h)
+        x0 = _pyrandom.randint(0, new_w - w)
+        canvas = np.empty((new_h, new_w, arr.shape[2]), arr.dtype)
+        canvas[:] = np.asarray(self.pad_val, arr.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        label = label.copy()
+        label[:, (1, 3)] = (label[:, (1, 3)] * w + x0) / new_w
+        label[:, (2, 4)] = (label[:, (2, 4)] * h + y0) / new_h
+        return canvas, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Sample a crop that keeps at least `min_object_covered` of some box;
+    boxes whose centers fall outside the crop are dropped (cls -> -1)."""
+
+    def __init__(self, min_object_covered=0.1, area_range=(0.3, 1.0),
+                 aspect_ratio_range=(0.75, 1.33), max_attempts=25):
+        self.min_covered = min_object_covered
+        self.area_range = area_range
+        self.ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        arr = _to_np(src)
+        h, w = arr.shape[:2]
+        valid = label[:, 0] >= 0
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range) * h * w
+            ratio = _pyrandom.uniform(*self.ratio_range)
+            ch = int(round((area / ratio) ** 0.5))
+            cw = int(round((area * ratio) ** 0.5))
+            if ch > h or cw > w or ch < 1 or cw < 1:
+                continue
+            y0 = _pyrandom.randint(0, h - ch)
+            x0 = _pyrandom.randint(0, w - cw)
+            crop = (x0 / w, y0 / h, (x0 + cw) / w, (y0 + ch) / h)
+            if not valid.any():
+                break
+            # coverage of each gt by the crop
+            bx = label[valid]
+            ix = np.maximum(0.0, np.minimum(bx[:, 3], crop[2])
+                            - np.maximum(bx[:, 1], crop[0]))
+            iy = np.maximum(0.0, np.minimum(bx[:, 4], crop[3])
+                            - np.maximum(bx[:, 2], crop[1]))
+            areas = np.maximum(1e-12, (bx[:, 3] - bx[:, 1])
+                               * (bx[:, 4] - bx[:, 2]))
+            if (ix * iy / areas >= self.min_covered).any():
+                break
+        else:
+            return arr, label
+        out = arr[y0:y0 + ch, x0:x0 + cw]
+        label = label.copy()
+        cx = (label[:, 1] + label[:, 3]) / 2
+        cy = (label[:, 2] + label[:, 4]) / 2
+        keep = ((label[:, 0] >= 0) & (cx >= crop[0]) & (cx < crop[2])
+                & (cy >= crop[1]) & (cy < crop[3]))
+        label[:, 1] = np.clip((label[:, 1] - crop[0]) / (crop[2] - crop[0]),
+                              0, 1)
+        label[:, 3] = np.clip((label[:, 3] - crop[0]) / (crop[2] - crop[0]),
+                              0, 1)
+        label[:, 2] = np.clip((label[:, 2] - crop[1]) / (crop[3] - crop[1]),
+                              0, 1)
+        label[:, 4] = np.clip((label[:, 4] - crop[1]) / (crop[3] - crop[1]),
+                              0, 1)
+        label[~keep, 0] = -1.0
+        return out, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, hue=0, rand_gray=0,
+                       min_object_covered=0.1, area_range=(0.3, 3.0),
+                       aspect_ratio_range=(0.75, 1.33), max_attempts=25,
+                       pad_val=(127, 127, 127), inter_method=2):
+    """Build the standard detection augmenter list (reference
+    `CreateDetAugmenter`): geometric det-aware transforms + borrowed color
+    transforms + resize to data_shape + normalization."""
+    augs = []
+    if rand_crop > 0:
+        augs.append(DetRandomCropAug(min_object_covered,
+                                     (area_range[0], min(1.0, area_range[1])),
+                                     aspect_ratio_range, max_attempts))
+    if rand_pad > 0:
+        augs.append(DetRandomPadAug(aspect_ratio_range,
+                                    (1.0, max(1.0, area_range[1])),
+                                    max_attempts, pad_val))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        augs.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if hue:
+        augs.append(DetBorrowAug(HueJitterAug(hue)))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    augs.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
+                                            inter_method)))
+    if mean is not None or std is not None:
+        # mean=True / std=True request the ImageNet defaults; None means
+        # "skip that half" (matching CreateAugmenter above)
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection data iterator (reference `ImageDetIter`): yields NCHW
+    image batches + (batch, max_objects, 5) label tensors, padding object
+    rows with cls -1.
+
+    Per-image labels come from the imglist/lst/rec label payload: either a
+    flat multiple-of-5 [cls x1 y1 x2 y2]... vector, or the reference's
+    headered format [header_width, object_width, ...pad..., objects...]."""
+
+    def __init__(self, batch_size, data_shape, label_shape=None,
+                 aug_list=None, **kwargs):
+        aug_list = aug_list if aug_list is not None \
+            else CreateDetAugmenter(data_shape)
+        self._det_augs = aug_list
+        super().__init__(batch_size, data_shape, aug_list=[],
+                         label_width=1, **kwargs)
+        max_obj = label_shape[0] if label_shape else \
+            self._scan_max_objects()
+        self.max_objects = max_obj
+        self._provide_label = [("label", (batch_size, max_obj, 5))]
+
+    @staticmethod
+    def _parse_label(raw):
+        raw = np.ravel(np.asarray(raw, np.float32))
+        if raw.size >= 2 and raw[0] >= 2 and raw[1] >= 5 \
+                and (raw.size - int(raw[0])) % int(raw[1]) == 0:
+            hw, ow = int(raw[0]), int(raw[1])
+            body = raw[hw:]
+            return body.reshape(-1, ow)[:, :5]
+        if raw.size % 5 == 0:
+            # includes the empty background-image label -> (0, 5)
+            return raw.reshape(-1, 5)
+        raise ValueError(f"cannot parse detection label of size {raw.size}")
+
+    def _scan_max_objects(self):
+        """Max object count across the dataset — scans imglist labels, or
+        (for .rec-backed datasets) every record's header label. The rec
+        scan reads the whole file once; pass `label_shape` to skip it."""
+        n = 1
+        if self.record is not None:
+            for idx in self.seq:
+                header, _ = unpack(self.record.read_idx(idx))
+                try:
+                    n = max(n, len(self._parse_label(header.label)))
+                except ValueError:
+                    continue
+            return n
+        for label, _ in self.imglist.values():
+            try:
+                n = max(n, len(self._parse_label(label)))
+            except ValueError:
+                continue
+        return n
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), np.float32)
+        batch_label = np.full((self.batch_size, self.max_objects, 5), -1.0,
+                              np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img_bytes = self.next_sample()
+                try:
+                    img = _to_np(imdecode(img_bytes))
+                except Exception as e:
+                    logging.debug("skipping undecodable image: %s", e)
+                    continue
+                label = self._parse_label(raw_label)
+                for aug in self._det_augs:
+                    img, label = aug(img, label) if isinstance(
+                        aug, DetAugmenter) else (aug(img), label)
+                arr = _to_np(img)
+                if arr.shape[:2] != (h, w):
+                    arr = _to_np(imresize(arr, w, h))
+                batch_data[i] = arr.astype(np.float32).transpose(2, 0, 1)
+                k = min(len(label), self.max_objects)
+                batch_label[i, :k] = label[:k, :5]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            for j in range(i, self.batch_size):
+                batch_data[j] = batch_data[j % max(i, 1)]
+                batch_label[j] = batch_label[j % max(i, 1)]
+        return DataBatch(data=[nd_array(batch_data)],
+                        label=[nd_array(batch_label)],
+                        pad=self.batch_size - i)

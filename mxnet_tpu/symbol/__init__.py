@@ -145,11 +145,24 @@ def _schema_for(op):
 _NAME_COUNT = {}
 
 
-def _auto_name(op):
+def _scoped_name(name, op):
+    """Resolve a node name through the active mx.name scope. Explicit
+    names also route through the manager (reference semantics: Prefix
+    prefixes user-supplied names too)."""
     base = op.lower().lstrip("_")
+    from .. import name as _name_mod
+    mgr = _name_mod.current()
+    if mgr is not None:   # active mx.name.NameManager / Prefix scope
+        return mgr.get(name, base)
+    if name is not None:
+        return name
     i = _NAME_COUNT.get(base, 0)
     _NAME_COUNT[base] = i + 1
     return f"{base}{i}"
+
+
+def _auto_name(op):
+    return _scoped_name(None, op)
 
 
 # --------------------------------------------------------------------------
@@ -484,7 +497,7 @@ def _invoke(op_name, args, kwargs):
     `_symbol_creator` in python/mxnet/symbol/register.py)."""
     if op_name not in _ops.OPS:
         raise MXNetError(f"unknown op '{op_name}'")
-    name = kwargs.pop("name", None) or _auto_name(op_name)
+    name = _scoped_name(kwargs.pop("name", None), op_name)
     sch = _schema_for(op_name)
 
     inputs = []   # (name, Symbol)

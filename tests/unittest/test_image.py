@@ -119,3 +119,98 @@ def test_imageiter_from_imglist(tmp_path):
     batch = it.next()
     assert batch.data[0].shape == (2, 3, 16, 16)
     np.testing.assert_array_equal(batch.label[0].asnumpy(), [0.0, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# detection pipeline (reference: mx.image.detection)
+# ---------------------------------------------------------------------------
+
+def _det_label(rows):
+    return np.asarray(rows, np.float32)
+
+
+def test_det_horizontal_flip_flips_boxes():
+    from mxnet_tpu.image import DetHorizontalFlipAug
+
+    img = np.zeros((10, 10, 3), np.uint8)
+    label = _det_label([[0, 0.1, 0.2, 0.4, 0.6]])
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(img, label)
+    np.testing.assert_allclose(lab[0, 1:5], [0.6, 0.2, 0.9, 0.6],
+                               atol=1e-6)
+    # flip twice = identity
+    _, lab2 = aug(out, lab)
+    np.testing.assert_allclose(lab2, label, atol=1e-6)
+
+
+def test_det_random_pad_keeps_boxes_inside():
+    from mxnet_tpu.image import DetRandomPadAug
+
+    rng = np.random.RandomState(0)
+    img = (rng.rand(20, 20, 3) * 255).astype(np.uint8)
+    label = _det_label([[1, 0.25, 0.25, 0.75, 0.75]])
+    aug = DetRandomPadAug(area_range=(1.5, 2.0))
+    out, lab = aug(img, label)
+    assert out.shape[0] > 20 and out.shape[1] > 20
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    # box area shrinks in normalized units when the canvas grows
+    a0 = (label[0, 3] - label[0, 1]) * (label[0, 4] - label[0, 2])
+    a1 = (lab[0, 3] - lab[0, 1]) * (lab[0, 4] - lab[0, 2])
+    assert a1 < a0
+
+
+def test_image_det_iter_batches(tmp_path):
+    from mxnet_tpu.image import CreateDetAugmenter, ImageDetIter
+
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    paths = []
+    for i in range(3):
+        arr = (np.random.RandomState(i).rand(24, 24, 3) * 255) \
+            .astype(np.uint8)
+        p = tmp_path / f"img{i}.jpg"
+        Image.fromarray(arr).save(p)
+        paths.append(p.name)
+    # imglist entries: flat [cls x1 y1 x2 y2] (+ second object for one)
+    imglist = [
+        [0, 0.1, 0.1, 0.5, 0.5, str(paths[0])],
+        [1, 0.2, 0.2, 0.8, 0.8, 0, 0.0, 0.5, 0.5, 1.0, str(paths[1])],
+        [2, 0.0, 0.0, 1.0, 1.0, str(paths[2])],
+    ]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      imglist=imglist, path_root=str(tmp_path),
+                      aug_list=CreateDetAugmenter((3, 16, 16),
+                                                  rand_mirror=True))
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert batch.label[0].shape == (2, 2, 5)       # max 2 objects scanned
+    lab = batch.label[0].asnumpy()
+    # one image has a single object: its second row is padding (cls -1)
+    assert (lab[:, :, 0] >= -1).all()
+
+
+def test_det_label_header_format():
+    from mxnet_tpu.image import ImageDetIter
+
+    raw = [2, 5, 0, 0.1, 0.1, 0.6, 0.6, 1, 0.3, 0.3, 0.9, 0.9]
+    lab = ImageDetIter._parse_label(np.asarray(raw, np.float32))
+    assert lab.shape == (2, 5)
+    assert lab[1, 0] == 1
+
+
+def test_det_label_empty_is_background():
+    from mxnet_tpu.image import ImageDetIter
+
+    lab = ImageDetIter._parse_label(np.zeros((0,), np.float32))
+    assert lab.shape == (0, 5)
+
+
+def test_prefix_applies_to_explicit_names():
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    data = sym.var("data")
+    with mx.name.Prefix("net_"):
+        h = sym.FullyConnected(data, num_hidden=2, name="fc1")
+    assert h.name == "net_fc1"

@@ -1,0 +1,44 @@
+"""mx.name scopes + mx.runtime feature flags (reference:
+python/mxnet/name.py, python/mxnet/runtime.py)."""
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def test_prefix_scope_names_symbols():
+    data = sym.var("data")
+    with mx.name.Prefix("mlp_"):
+        h = sym.FullyConnected(data, num_hidden=4)
+    assert h.name.startswith("mlp_fullyconnected")
+    h2 = sym.FullyConnected(data, num_hidden=4)
+    assert not h2.name.startswith("mlp_")
+
+
+def test_name_manager_counts_per_hint():
+    with mx.name.NameManager():
+        data = sym.var("data")
+        a = sym.relu(data)
+        b = sym.relu(data)
+    assert a.name == "relu0" and b.name == "relu1"
+
+
+def test_nested_prefix_uses_innermost():
+    data = sym.var("data")
+    with mx.name.Prefix("outer_"):
+        with mx.name.Prefix("inner_"):
+            h = sym.relu(data)
+    assert h.name.startswith("inner_")
+
+
+def test_runtime_features():
+    f = mx.runtime.Features()
+    assert f.is_enabled("BF16")
+    assert not f.is_enabled("CUDA")       # no CUDA in this build, by design
+    assert "TPU" in f and "PALLAS" in f
+    names = [feat.name for feat in mx.runtime.feature_list()]
+    assert "DIST_KVSTORE" in names
+    try:
+        f.is_enabled("WARP_DRIVE")
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
