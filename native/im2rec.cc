@@ -1,0 +1,331 @@
+// im2rec: pack an image list into a RecordIO dataset (.rec + .idx).
+//
+// Native equivalent of the reference's C++ tools/im2rec.cc (OpenCV there;
+// libjpeg here), bit-compatible with mxnet_tpu/io/recordio.py:
+//   record  = [kMagic:u32][len & (1<<29)-1 : u32][payload][pad to 4B]
+//   payload = IRHeader<IfQQ>(flag,label,id,id2) [+ flag*f32 labels] + image
+// List-file format (same as tools/im2rec.py):  idx \t label... \t relpath
+//
+// Multi-threaded: N decode/encode workers, one writer preserving list
+// order. --resize re-encodes via libjpeg (shorter side -> S, bilinear);
+// without it the original file bytes pass through untouched.
+//
+// Build: make -C native im2rec     Usage:
+//   native/im2rec list.lst img_root out.rec [--resize 256] [--quality 95]
+//                                           [--num-thread 4]
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+#include <pthread.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <csetjmp>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xCED7230A;
+
+struct Task {
+  uint64_t idx = 0;
+  std::vector<float> labels;
+  std::string path;
+  std::vector<uint8_t> payload;  // filled by worker (header + image bytes)
+  bool ok = false;               // payload valid
+  bool done = false;             // worker finished (ok or failed)
+};
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jmp;
+};
+
+void err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jmp, 1);
+}
+
+// decode JPEG -> RGB8; returns false on failure
+bool decode_jpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                 int* w, int* h) {
+  jpeg_decompress_struct c;
+  JpegErr jerr;
+  c.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&c);
+    return false;
+  }
+  jpeg_create_decompress(&c);
+  jpeg_mem_src(&c, buf, len);
+  if (jpeg_read_header(&c, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&c);
+    return false;
+  }
+  c.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&c);
+  *w = c.output_width;
+  *h = c.output_height;
+  out->resize(size_t(*w) * *h * 3);
+  while (c.output_scanline < c.output_height) {
+    uint8_t* row = out->data() + size_t(c.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&c, &row, 1);
+  }
+  jpeg_finish_decompress(&c);
+  jpeg_destroy_decompress(&c);
+  return true;
+}
+
+bool encode_jpeg(const uint8_t* rgb, int w, int h, int quality,
+                 std::vector<uint8_t>* out) {
+  jpeg_compress_struct c;
+  JpegErr jerr;
+  c.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = err_exit;
+  // volatile: modified between setjmp and longjmp, read afterwards
+  // (C11 7.13.2.1 — non-volatile locals would be indeterminate)
+  uint8_t* volatile mem = nullptr;
+  volatile unsigned long mem_len = 0;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_compress(&c);
+    free(mem);
+    return false;
+  }
+  jpeg_create_compress(&c);
+  jpeg_mem_dest(&c, const_cast<uint8_t**>(&mem),
+                const_cast<unsigned long*>(&mem_len));
+  c.image_width = w;
+  c.image_height = h;
+  c.input_components = 3;
+  c.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&c);
+  jpeg_set_quality(&c, quality, TRUE);
+  jpeg_start_compress(&c, TRUE);
+  while (c.next_scanline < c.image_height) {
+    const uint8_t* row = rgb + size_t(c.next_scanline) * w * 3;
+    jpeg_write_scanlines(&c, const_cast<uint8_t**>(&row), 1);
+  }
+  jpeg_finish_compress(&c);
+  out->assign(mem, mem + mem_len);
+  free(mem);
+  jpeg_destroy_compress(&c);
+  return true;
+}
+
+// bilinear resize so the SHORTER side becomes `target`
+void resize_short(const std::vector<uint8_t>& src, int w, int h, int target,
+                  std::vector<uint8_t>* dst, int* ow, int* oh) {
+  double scale = double(target) / (w < h ? w : h);
+  *ow = int(w * scale + 0.5);
+  *oh = int(h * scale + 0.5);
+  dst->resize(size_t(*ow) * *oh * 3);
+  for (int y = 0; y < *oh; ++y) {
+    double fy = (y + 0.5) / scale - 0.5;
+    int y0 = fy < 0 ? 0 : int(fy);
+    int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    double wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < *ow; ++x) {
+      double fx = (x + 0.5) / scale - 0.5;
+      int x0 = fx < 0 ? 0 : int(fx);
+      int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      double wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int ch = 0; ch < 3; ++ch) {
+        double v00 = src[(size_t(y0) * w + x0) * 3 + ch];
+        double v01 = src[(size_t(y0) * w + x1) * 3 + ch];
+        double v10 = src[(size_t(y1) * w + x0) * 3 + ch];
+        double v11 = src[(size_t(y1) * w + x1) * 3 + ch];
+        double v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                   v10 * wy * (1 - wx) + v11 * wy * wx;
+        (*dst)[(size_t(y) * *ow + x) * 3 + ch] = uint8_t(v + 0.5);
+      }
+    }
+  }
+}
+
+void build_payload(Task* t, const std::vector<uint8_t>& img) {
+  // IRHeader <IfQQ>: flag>0 => `flag` f32 labels follow
+  uint32_t flag = t->labels.size() > 1 ? uint32_t(t->labels.size()) : 0;
+  float slabel = t->labels.empty() ? 0.f : t->labels[0];
+  t->payload.clear();
+  t->payload.reserve(24 + 4 * t->labels.size() + img.size());
+  auto push = [&](const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    t->payload.insert(t->payload.end(), b, b + n);
+  };
+  push(&flag, 4);
+  float lab = flag ? 0.f : slabel;
+  push(&lab, 4);
+  uint64_t id = t->idx, id2 = 0;
+  push(&id, 8);
+  push(&id2, 8);
+  if (flag) push(t->labels.data(), 4 * flag);
+  push(img.data(), img.size());
+}
+
+struct Shared {
+  std::vector<Task>* tasks;
+  std::string root;
+  int resize = 0;
+  int quality = 95;
+  size_t next = 0;
+  size_t write_pos = 0;          // first task not yet written out
+  size_t window = 64;            // max in-flight payloads (bounds RAM)
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_cond_t cv_done = PTHREAD_COND_INITIALIZER;   // task completed
+  pthread_cond_t cv_room = PTHREAD_COND_INITIALIZER;   // window advanced
+};
+
+void* worker(void* arg) {
+  Shared* sh = static_cast<Shared*>(arg);
+  for (;;) {
+    pthread_mutex_lock(&sh->mu);
+    while (sh->next < sh->tasks->size()
+           && sh->next >= sh->write_pos + sh->window)
+      pthread_cond_wait(&sh->cv_room, &sh->mu);
+    size_t i = sh->next++;
+    pthread_mutex_unlock(&sh->mu);
+    if (i >= sh->tasks->size()) return nullptr;
+    Task& t = (*sh->tasks)[i];
+    auto mark_done = [&]() {
+      pthread_mutex_lock(&sh->mu);
+      t.done = true;
+      pthread_cond_broadcast(&sh->cv_done);
+      pthread_mutex_unlock(&sh->mu);
+    };
+    std::ifstream f(sh->root + "/" + t.path, std::ios::binary);
+    if (!f) {
+      std::cerr << "im2rec: cannot open " << t.path << "\n";
+      mark_done();
+      continue;
+    }
+    std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(f)),
+                               std::istreambuf_iterator<char>());
+    if (sh->resize > 0) {
+      std::vector<uint8_t> rgb, resized, enc;
+      int w = 0, h = 0;
+      if (!decode_jpeg(bytes.data(), bytes.size(), &rgb, &w, &h)) {
+        std::cerr << "im2rec: decode failed for " << t.path << "\n";
+        mark_done();
+        continue;
+      }
+      int ow = w, oh = h;
+      if ((w < h ? w : h) != sh->resize) {
+        // shorter side -> target, up- OR down-scaling (the documented
+        // contract, matching tools/im2rec.py)
+        resize_short(rgb, w, h, sh->resize, &resized, &ow, &oh);
+      } else {
+        resized = rgb;
+      }
+      if (!encode_jpeg(resized.data(), ow, oh, sh->quality, &enc)) {
+        std::cerr << "im2rec: encode failed for " << t.path << "\n";
+        mark_done();
+        continue;
+      }
+      build_payload(&t, enc);
+      t.ok = true;
+    } else {
+      build_payload(&t, bytes);
+      t.ok = true;
+    }
+    pthread_mutex_lock(&sh->mu);
+    t.done = true;
+    pthread_cond_broadcast(&sh->cv_done);
+    pthread_mutex_unlock(&sh->mu);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: im2rec <list> <root> <out.rec> [--resize N] "
+                 "[--quality Q] [--num-thread T]\n";
+    return 2;
+  }
+  std::string list_path = argv[1], root = argv[2], out_rec = argv[3];
+  Shared sh;
+  int num_thread = 4;
+  for (int i = 4; i + 1 < argc; i += 2) {
+    std::string k = argv[i];
+    int v = atoi(argv[i + 1]);
+    if (k == "--resize") sh.resize = v;
+    else if (k == "--quality") sh.quality = v;
+    else if (k == "--num-thread") num_thread = v;
+    else { std::cerr << "unknown flag " << k << "\n"; return 2; }
+  }
+
+  std::vector<Task> tasks;
+  {
+    std::ifstream lf(list_path);
+    if (!lf) { std::cerr << "cannot open " << list_path << "\n"; return 1; }
+    std::string line;
+    while (std::getline(lf, line)) {
+      if (line.empty()) continue;
+      std::istringstream ss(line);
+      std::vector<std::string> cols;
+      std::string col;
+      while (std::getline(ss, col, '\t')) cols.push_back(col);
+      if (cols.size() < 3) continue;
+      Task t;
+      t.idx = strtoull(cols[0].c_str(), nullptr, 10);
+      for (size_t j = 1; j + 1 < cols.size(); ++j)
+        t.labels.push_back(strtof(cols[j].c_str(), nullptr));
+      t.path = cols.back();
+      tasks.push_back(std::move(t));
+    }
+  }
+  sh.tasks = &tasks;
+  sh.root = root;
+
+  std::vector<pthread_t> threads(num_thread);
+  for (auto& th : threads) pthread_create(&th, nullptr, worker, &sh);
+
+  std::ofstream rec(out_rec, std::ios::binary);
+  std::string idx_path = out_rec;
+  size_t dot = idx_path.rfind('.');
+  idx_path = (dot == std::string::npos ? idx_path : idx_path.substr(0, dot))
+             + ".idx";
+  std::ofstream idx(idx_path);
+  size_t written = 0;
+  // streaming ordered writer: consume each task as soon as it completes,
+  // then free its payload — RAM is bounded by `window` in-flight payloads
+  for (size_t i = 0; i < tasks.size(); ++i) {
+    Task& t = tasks[i];
+    pthread_mutex_lock(&sh.mu);
+    while (!t.done) pthread_cond_wait(&sh.cv_done, &sh.mu);
+    sh.write_pos = i + 1;
+    pthread_cond_broadcast(&sh.cv_room);
+    pthread_mutex_unlock(&sh.mu);
+    if (!t.ok) continue;
+    if (t.payload.size() >= (size_t(1) << 29)) {
+      std::cerr << "im2rec: record " << t.idx << " is "
+                << t.payload.size()
+                << " bytes, over the 2^29-1 RecordIO limit; skipped\n";
+      std::vector<uint8_t>().swap(t.payload);
+      continue;
+    }
+    idx << t.idx << "\t" << rec.tellp() << "\n";
+    uint32_t len = uint32_t(t.payload.size());
+    rec.write(reinterpret_cast<const char*>(&kMagic), 4);
+    rec.write(reinterpret_cast<const char*>(&len), 4);
+    rec.write(reinterpret_cast<const char*>(t.payload.data()),
+              t.payload.size());
+    static const char zeros[4] = {0, 0, 0, 0};
+    size_t pad = (4 - t.payload.size() % 4) % 4;
+    if (pad) rec.write(zeros, pad);
+    std::vector<uint8_t>().swap(t.payload);
+    ++written;
+  }
+  for (auto& th : threads) pthread_join(th, nullptr);
+  std::cout << "im2rec: wrote " << written << "/" << tasks.size()
+            << " records to " << out_rec << "\n";
+  return written == tasks.size() ? 0 : 1;
+}

@@ -1,0 +1,27 @@
+"""tools/comm_bench.py harness test (reference: tools/bandwidth/) — the
+collective bandwidth benchmark must run all four primitives on the
+virtual 8-device mesh."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir))
+
+
+def test_comm_bench_runs_all_collectives():
+    wrapper = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, runpy; sys.argv = [sys.argv[1]] + sys.argv[2:]; "
+        "runpy.run_path(sys.argv[0], run_name='__main__')")
+    r = subprocess.run(
+        [sys.executable, "-c", wrapper,
+         os.path.join(ROOT, "tools", "comm_bench.py"),
+         "--size-mb", "2", "--reps", "2"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr[-1500:]
+    for prim in ("psum", "all_gather", "reduce_scatter", "ppermute"):
+        assert prim in r.stdout, r.stdout
+    assert "GB/s" in r.stdout
