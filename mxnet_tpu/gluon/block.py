@@ -20,6 +20,7 @@ import contextlib
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import _engine
 from .. import ndarray as nd_mod
@@ -110,6 +111,67 @@ class Block:
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
         self.collect_params().load(filename, ctx=ctx, allow_missing=allow_missing,
                                    ignore_extra=ignore_extra)
+
+    def summary(self, *inputs):
+        """Print a per-layer table of output shapes and parameter counts
+        for one forward pass (reference: Block.summary, gluon 1.3+).
+
+        Must be called BEFORE hybridize(): the cached-jit path bypasses
+        forward hooks, so a hybridized forward would record no layers
+        (the reference asserts the same)."""
+        def any_active(blk):
+            if getattr(blk, "_active", False):
+                return True
+            return any(any_active(c) for c in blk._children.values())
+
+        if any_active(self):
+            raise ValueError(
+                "summary() needs the eager forward; call it before "
+                "hybridize() (or after hybridize(active=False))")
+        rows = []
+        hooks = []
+
+        def install(block, path):
+            def hook(blk, ins, out, _path=path):
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                shape = ", ".join(str(tuple(o.shape)) for o in outs
+                                  if hasattr(o, "shape"))
+                n_params = sum(
+                    int(np.prod(p.shape)) for _, p in blk._reg_params.items()
+                    if p.shape is not None)
+                rows.append((f"{_path}({type(blk).__name__})", shape,
+                             n_params))
+            block.register_forward_hook(hook)
+            hooks.append((block, hook))
+            for cname, child in block._children.items():
+                install(child, f"{path}.{cname}" if path else cname)
+
+        install(self, "")
+        try:
+            self(*inputs)
+        finally:
+            for blk, handle in hooks:
+                if handle in blk._forward_hooks:
+                    blk._forward_hooks.remove(handle)
+        total = sum(int(np.prod(p.shape)) for _, p in self._iter_params()
+                    if p.shape is not None)
+        trainable = sum(
+            int(np.prod(p.shape)) for _, p in self._iter_params()
+            if p.shape is not None and p.grad_req != "null")
+        width = max([len(r[0]) for r in rows] + [20])
+        lines = ["-" * (width + 40),
+                 f"{'Layer (type)':<{width}}  {'Output Shape':<24} Param #",
+                 "=" * (width + 40)]
+        for name, shape, n in rows:
+            lines.append(f"{name:<{width}}  {shape:<24} {n}")
+        lines += ["=" * (width + 40),
+                  f"Total params: {total}",
+                  f"Trainable params: {trainable}",
+                  f"Non-trainable params: {total - trainable}",
+                  "-" * (width + 40)]
+        text = "\n".join(lines)
+        print(text)
+        return text
 
     # -- hooks ----------------------------------------------------------
     def register_forward_hook(self, hook):

@@ -415,3 +415,34 @@ def ifft(data, compute_size=128):
     re = data[..., 0::2]
     im = data[..., 1::2]
     return jnp.fft.ifft(re + 1j * im, axis=-1).real.astype(data.dtype)
+
+
+@register("Crop")
+def crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+         num_args=None):
+    """Legacy NCHW crop (reference src/operator/crop.cc): with two inputs,
+    crop the first to the second's spatial size; with one input, crop to
+    `h_w`. Offset is (y, x); center_crop overrides offset."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    if not (0 <= y0 and y0 + th <= H and 0 <= x0 and x0 + tw <= W):
+        raise ValueError(
+            f"Crop: window ({th},{tw}) at offset ({y0},{x0}) does not fit "
+            f"input spatial dims ({H},{W})")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+# legacy capitalized / renamed aliases (reference keeps both spellings)
+alias("Cast", "cast")
+alias("Flatten", "flatten")
+alias("Reshape", "reshape")
+alias("SwapAxis", "swapaxes")
+alias("choose_element_0index", "pick")

@@ -284,3 +284,20 @@ def multi_mp_sgd_mom_update(*wgmw32, momentum=0.0, lrs=None, wds=None,
                                          clip_gradient=clip_gradient)
         out += [nw, nm, nw32]
     return tuple(out)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    """1.0 iff every element is finite (reference `all_finite`,
+    src/operator/contrib/all_finite.cc — the AMP loss-scale probe).
+    isfinite works on every float dtype directly — no upcast pass."""
+    return jnp.isfinite(data).all().astype(jnp.float32)
+
+
+@register("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """1.0 iff every element of every array is finite — one fused check."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.isfinite(a).all()
+    return ok.astype(jnp.float32)

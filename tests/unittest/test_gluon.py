@@ -219,3 +219,31 @@ def test_clip_global_norm():
     gluon.utils.clip_global_norm(arrays, 1.0)
     total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
     assert abs(total - 1.0) < 1e-5
+
+
+def test_block_summary():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dropout(0.5), nn.Dense(2))
+    net.initialize()
+    text = net.summary(nd.ones((4, 3)))
+    assert "Dense" in text and "Dropout" in text
+    assert "(4, 8)" in text and "(4, 2)" in text
+    # 3*8+8 + 8*2+2 = 50
+    assert "Total params: 50" in text
+    assert "Trainable params: 50" in text
+    # hooks removed: a later forward doesn't re-print
+    assert not net._forward_hooks
+    assert not net._children["0"]._forward_hooks
+
+
+def test_block_summary_rejects_hybridized():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    try:
+        net.summary(nd.ones((2, 3)))
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised

@@ -516,3 +516,37 @@ def test_contrib_namespaces():
 
     with pytest.raises(AttributeError):
         nd.contrib.not_a_real_op
+
+
+def test_all_finite_ops():
+    good = nd.array([1.0, 2.0])
+    bad = nd.array(np.array([1.0, np.inf], np.float32))
+    assert float(F.all_finite(good).asnumpy()) == 1.0
+    assert float(F.all_finite(bad).asnumpy()) == 0.0
+    assert float(F.multi_all_finite(good, good).asnumpy()) == 1.0
+    assert float(F.multi_all_finite(good, bad).asnumpy()) == 0.0
+
+
+def test_crop_and_legacy_aliases():
+    x = nd.array(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    ref = nd.zeros((1, 2, 2, 2))
+    out = F.Crop(x, ref, offset=(1, 1)).asnumpy()
+    assert_almost_equal(out, x.asnumpy()[:, :, 1:3, 1:3])
+    out2 = F.Crop(x, h_w=(2, 2), center_crop=True).asnumpy()
+    assert_almost_equal(out2, x.asnumpy()[:, :, 1:3, 1:3])
+    # capitalized legacy aliases resolve to the same kernels
+    assert F.Cast(x, dtype="int32").dtype == np.int32
+    assert F.SwapAxis(x, dim1=0, dim2=1).shape == (2, 1, 4, 4)
+    assert F.Reshape(x, shape=(2, 16)).shape == (2, 16)
+    d = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    idx = nd.array(np.array([1, 0], np.float32))
+    assert_almost_equal(F.choose_element_0index(d, idx).asnumpy(),
+                        np.array([2.0, 3.0]))
+
+
+def test_crop_rejects_out_of_bounds():
+    x = nd.ones((1, 1, 4, 4))
+    with pytest.raises(ValueError, match="does not fit"):
+        F.Crop(x, h_w=(2, 2), offset=(3, 3))
+    with pytest.raises(ValueError, match="does not fit"):
+        F.Crop(x, h_w=(6, 6), center_crop=True)
