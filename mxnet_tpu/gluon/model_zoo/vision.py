@@ -20,6 +20,8 @@ __all__ = ["get_model", "alexnet", "vgg11", "vgg13", "vgg16", "vgg19",
            "squeezenet1_1", "mobilenet1_0", "mobilenet0_5", "mobilenet0_25",
            "mobilenet_v2_1_0", "mobilenet_v2_0_5", "resnet18_v1",
            "resnet34_v1", "resnet50_v1", "resnet101_v1", "resnet152_v1",
+           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+           "resnet152_v2",
            "densenet121", "densenet161", "densenet169", "densenet201",
            "inception_v3",
            "AlexNet", "VGG", "SqueezeNet", "MobileNet", "MobileNetV2",
@@ -497,6 +499,11 @@ resnet34_v1 = _resnet_factory("resnet34_v1")
 resnet50_v1 = _resnet_factory("resnet50_v1")
 resnet101_v1 = _resnet_factory("resnet101_v1")
 resnet152_v1 = _resnet_factory("resnet152_v1")
+resnet18_v2 = _resnet_factory("resnet18_v2")
+resnet34_v2 = _resnet_factory("resnet34_v2")
+resnet50_v2 = _resnet_factory("resnet50_v2")
+resnet101_v2 = _resnet_factory("resnet101_v2")
+resnet152_v2 = _resnet_factory("resnet152_v2")
 
 _MODELS = {
     "alexnet": alexnet,
@@ -510,6 +517,9 @@ _MODELS = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
     "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
     "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+    "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+    "resnet152_v2": resnet152_v2,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "inceptionv3": inception_v3,

@@ -12,10 +12,14 @@ from . import ssd
 from . import yolo
 
 from .bert import BERTModel, BERTForPretraining, bert_base_config, bert_large_config
-from .resnet import get_resnet, resnet18_v1, resnet50_v1, resnet101_v1
+from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,
+                     resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2,
+                     resnet50_v2, resnet101_v2, resnet152_v2)
 from .yolo import YOLOv3Tiny
 
 __all__ = ["bert", "resnet", "transformer", "deepar", "ssd", "yolo",
            "BERTModel", "BERTForPretraining", "bert_base_config",
-           "bert_large_config", "get_resnet", "resnet18_v1", "resnet50_v1",
-           "resnet101_v1", "YOLOv3Tiny"]
+           "bert_large_config", "get_resnet", "resnet18_v1", "resnet34_v1",
+           "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
+           "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
+           "YOLOv3Tiny"]

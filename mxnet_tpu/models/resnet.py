@@ -12,7 +12,10 @@ from ..ndarray import ndarray as F
 
 __all__ = ["BasicBlockV1", "BottleneckV1", "ResNetV1", "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
-           "resnet152_v1"]
+           "resnet152_v1",
+           "BasicBlockV2", "BottleneckV2", "ResNetV2",
+           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+           "resnet152_v2"]
 
 
 def _conv3x3(channels, stride, in_channels):
@@ -100,18 +103,109 @@ class ResNetV1(HybridBlock):
         return self.output(self.features(x))
 
 
+class BasicBlockV2(HybridBlock):
+    """Pre-activation residual block (reference BasicBlockV2, He et al.
+    2016 identity mappings): BN-ReLU precedes each conv, and the shortcut
+    taps the PRE-activation input."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(channels, 1, channels)
+        self.ds = nn.Conv2D(channels, kernel_size=1, strides=stride,
+                            use_bias=False, in_channels=in_channels) \
+            if downsample else None
+
+    def forward(self, x):
+        act = F.Activation(self.bn1(x), act_type="relu")
+        residual = x if self.ds is None else self.ds(act)
+        out = self.conv1(act)
+        out = self.conv2(F.Activation(self.bn2(out), act_type="relu"))
+        return out + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        self.bn1 = nn.BatchNorm()
+        self.conv1 = nn.Conv2D(mid, kernel_size=1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.conv2 = _conv3x3(mid, stride, mid)
+        self.bn3 = nn.BatchNorm()
+        self.conv3 = nn.Conv2D(channels, kernel_size=1, use_bias=False)
+        self.ds = nn.Conv2D(channels, kernel_size=1, strides=stride,
+                            use_bias=False, in_channels=in_channels) \
+            if downsample else None
+
+    def forward(self, x):
+        act = F.Activation(self.bn1(x), act_type="relu")
+        residual = x if self.ds is None else self.ds(act)
+        out = self.conv1(act)
+        out = self.conv2(F.Activation(self.bn2(out), act_type="relu"))
+        out = self.conv3(F.Activation(self.bn3(out), act_type="relu"))
+        return out + residual
+
+
+class ResNetV2(HybridBlock):
+    """Pre-activation ResNet (reference ResNetV2): bare stem conv, BN-ReLU
+    moved inside blocks, final BN-ReLU before the pool."""
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.BatchNorm(scale=False, center=False))
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
+                                        use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            stage = nn.HybridSequential()
+            in_c = channels[i]
+            stage.add(block(channels[i + 1], stride,
+                            downsample=channels[i + 1] != in_c or stride != 1,
+                            in_channels=in_c))
+            for _ in range(num_layer - 1):
+                stage.add(block(channels[i + 1], 1, downsample=False,
+                                in_channels=channels[i + 1]))
+            self.features.add(stage)
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes, in_units=channels[-1])
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
 _SPECS = {
-    18: (BasicBlockV1, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: (BasicBlockV1, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: (BottleneckV1, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: (BottleneckV1, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: (BottleneckV1, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+    18: ("basic", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottleneck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottleneck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottleneck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
 }
 
+_BLOCKS = {(1, "basic"): BasicBlockV1, (1, "bottleneck"): BottleneckV1,
+           (2, "basic"): BasicBlockV2, (2, "bottleneck"): BottleneckV2}
 
-def get_resnet(num_layers, classes=1000, **kwargs):
-    block, layers, channels = _SPECS[num_layers]
-    return ResNetV1(block, layers, channels, classes=classes, **kwargs)
+
+def get_resnet(num_layers, classes=1000, version=1, **kwargs):
+    kind, layers, channels = _SPECS[num_layers]
+    block = _BLOCKS[(version, kind)]
+    net_cls = ResNetV1 if version == 1 else ResNetV2
+    return net_cls(block, layers, channels, classes=classes, **kwargs)
 
 
 def resnet18_v1(**kw):
@@ -132,3 +226,23 @@ def resnet101_v1(**kw):
 
 def resnet152_v1(**kw):
     return get_resnet(152, **kw)
+
+
+def resnet18_v2(**kw):
+    return get_resnet(18, version=2, **kw)
+
+
+def resnet34_v2(**kw):
+    return get_resnet(34, version=2, **kw)
+
+
+def resnet50_v2(**kw):
+    return get_resnet(50, version=2, **kw)
+
+
+def resnet101_v2(**kw):
+    return get_resnet(101, version=2, **kw)
+
+
+def resnet152_v2(**kw):
+    return get_resnet(152, version=2, **kw)

@@ -428,6 +428,10 @@ def crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
         th, tw = inputs[1].shape[2], inputs[1].shape[3]
     else:
         th, tw = h_w
+        if th <= 0 or tw <= 0:
+            raise ValueError(
+                "Crop: with a single input, h_w must give a positive "
+                f"window, got {h_w}")
     H, W = data.shape[2], data.shape[3]
     if center_crop:
         y0, x0 = (H - th) // 2, (W - tw) // 2

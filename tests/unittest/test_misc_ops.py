@@ -550,3 +550,9 @@ def test_crop_rejects_out_of_bounds():
         F.Crop(x, h_w=(2, 2), offset=(3, 3))
     with pytest.raises(ValueError, match="does not fit"):
         F.Crop(x, h_w=(6, 6), center_crop=True)
+
+
+def test_crop_requires_positive_window():
+    x = nd.ones((1, 1, 4, 4))
+    with pytest.raises(ValueError, match="positive"):
+        F.Crop(x)

@@ -17,6 +17,8 @@ from mxnet_tpu.gluon.model_zoo import get_model, vision
     ("mobilenet0.25", 224),
     ("mobilenetv2_0.5", 224),
     ("resnet18_v1", 32),
+    ("resnet18_v2", 32),
+    ("resnet50_v2", 32),
     ("densenet121", 64),
     ("inceptionv3", 96),
 ])
