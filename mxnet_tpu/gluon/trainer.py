@@ -23,6 +23,13 @@ class Trainer:
         if isinstance(params, (dict, ParameterDict)):
             params = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
+        if compression_params is not None:
+            raise ValueError(
+                "Trainer does not route gradients through a kvstore on TPU "
+                "(XLA collectives do the reduction inside the jitted "
+                "step), so compression_params has nothing to compress "
+                "here. Use the explicit kvstore path instead: "
+                "kv = mx.kv.create(...); kv.set_gradient_compression(...)")
         self._params = [p for p in params if p.grad_req != "null"]
         self._all_params = list(params)
         optimizer_params = optimizer_params or {}

@@ -15,6 +15,11 @@ training scripts run unchanged:
 `dist_async` is intentionally unsupported: async parameter-server updates
 have no SPMD equivalent (SURVEY.md §2.4) — sync data parallelism via the
 mesh is the supported mode, matching `dist_sync` semantics.
+
+2-bit gradient compression with error feedback IS supported
+(`set_gradient_compression({'type': '2bit', 'threshold': t})`, see
+compression.py) — applied on dense pushes, matching the reference's
+worker-side quantize → server-sum → dequantize flow.
 """
 from __future__ import annotations
 
@@ -39,6 +44,14 @@ class KVStore:
         self._opt_states = {}
         self._optimizer = None
         self._updater = None
+        self._compression = None
+
+    def set_gradient_compression(self, compression_params):
+        """Enable 2-bit gradient compression with error feedback on push
+        (reference: KVStore.set_gradient_compression /
+        src/kvstore/gradient_compression.cc)."""
+        from . import compression as _comp
+        self._compression = _comp.create(compression_params)
 
     # -- data plane ------------------------------------------------------
     def init(self, key, value):
@@ -51,17 +64,32 @@ class KVStore:
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vs = v if isinstance(v, (list, tuple)) else [v]
+            # validate BEFORE any aggregation: compression keeps
+            # error-feedback residuals, which a failed push must not touch
+            if k not in self._store:
+                raise KeyError(f"key {k} not initialized")
             if any(isinstance(x, BaseSparseNDArray) for x in vs):
                 # sparse aggregate stays sparse so the optimizer can take
                 # its lazy row-update path (reference: sparse push keeps
-                # kRowSparseStorage through the server merge)
+                # kRowSparseStorage through the server merge); compression
+                # applies to dense pushes only (reference behavior)
                 agg = vs[0]
                 for extra in vs[1:]:
                     agg = sparse_add(agg, extra)
+            elif self._compression is not None:
+                # per-slot quantize with error feedback (int8 wire
+                # payloads, the reference's worker->server format),
+                # aggregate in int32 so any slot count sums exactly,
+                # dequantize in the gradients' own dtype
+                qs = [self._compression.compress(k, i, x._data)
+                      for i, x in enumerate(vs)]
+                qsum = qs[0].astype(jnp.int32)
+                for q in qs[1:]:
+                    qsum = qsum + q
+                agg = NDArray(self._compression.decompress(qsum)
+                              .astype(vs[0]._data.dtype))
             else:
                 agg = NDArray(sum((x._data for x in vs[1:]), vs[0]._data))
-            if k not in self._store:
-                raise KeyError(f"key {k} not initialized")
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             elif self._optimizer is not None:

@@ -1,0 +1,67 @@
+"""2-bit gradient compression with error feedback (reference:
+`src/kvstore/gradient_compression.cc` — enabled via
+`kvstore.set_gradient_compression({'type': '2bit', 'threshold': t})`).
+
+Semantics match the reference: each worker's gradient is quantized to
+{-t, 0, +t} (2 bits of information per element; carried as int8 here — a
+4x wire reduction vs f32, the TPU-idiomatic stand-in for the reference's
+bit-packing, which XLA cannot express as a collective payload), and the
+quantization error is kept in a per-(key, slot) residual that is added to
+the NEXT gradient before quantizing — so nothing is lost, only delayed.
+
+The aggregation identity `sum_i t*q_i == t * sum_i q_i` lets the sum run
+on the quantized payloads; the kvstore accumulates them in int32, so any
+worker count sums exactly."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TwoBitCompression", "create"]
+
+
+class TwoBitCompression:
+    def __init__(self, threshold=0.5):
+        if threshold <= 0:
+            raise ValueError("2bit compression threshold must be > 0")
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    @staticmethod
+    @jax.jit
+    def _quantize(g, t):
+        q = jnp.where(g >= t, jnp.int8(1),
+                      jnp.where(g <= -t, jnp.int8(-1), jnp.int8(0)))
+        residual = g - t * q.astype(jnp.float32)
+        return q, residual
+
+    def compress(self, key, slot, grad):
+        """grad: f32 jax array. Returns the int8 quantized payload; the
+        residual for (key, slot) is updated in place."""
+        rkey = (key, slot)
+        res = self._residual.get(rkey)
+        g = grad.astype(jnp.float32)
+        if res is not None:
+            g = g + res
+        q, residual = self._quantize(g, self.threshold)
+        self._residual[rkey] = residual
+        return q
+
+    def decompress(self, qsum):
+        """Sum of int8 payloads -> f32 gradient sum."""
+        return qsum.astype(jnp.float32) * self.threshold
+
+    def reset(self):
+        self._residual.clear()
+
+
+def create(params):
+    """Build a compressor from the reference's param-dict form."""
+    if not params:
+        return None
+    kind = params.get("type", "2bit")
+    if kind != "2bit":
+        raise ValueError(
+            f"unsupported gradient compression type {kind!r}; this build "
+            "implements '2bit' (the reference's only shipped type)")
+    return TwoBitCompression(float(params.get("threshold", 0.5)))
