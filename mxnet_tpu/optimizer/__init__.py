@@ -17,7 +17,8 @@ from ..ndarray import ndarray as _nd
 from .. import ops as _ops
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "RMSProp",
-           "Ftrl", "Signum", "SignSGD", "LAMB", "LARS", "create", "register"]
+           "Ftrl", "Signum", "SignSGD", "LAMB", "LARS", "Adamax", "Nadam",
+           "AdaDelta", "DCASGD", "SGLD", "FTML", "create", "register"]
 
 _registry = Registry("optimizer")
 register = _registry.register
@@ -434,3 +435,208 @@ class LARS(Optimizer):
         new_mom = self.momentum * state._data - lr * trust * (g + wd * w32)
         state._data = new_mom
         weight._data = (w32 + new_mom).astype(weight.dtype)
+
+
+@register("adamax")
+class Adamax(Optimizer):
+    """Adam with an infinity-norm second moment (reference optimizer of
+    the same name)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"),
+                zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd,
+                            weight._data)
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        step = (lr / (1 - self.beta1 ** t)) * m._data \
+            / (u._data + self.epsilon)
+        weight._data = (weight._data.astype(jnp.float32) - step) \
+            .astype(weight.dtype)
+
+
+@register("nadam")
+class Nadam(Optimizer):
+    """Nesterov Adam with momentum schedule (reference Nadam,
+    schedule_decay as in Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self._m_schedule = {}
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"),
+                zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd,
+                            weight._data)
+        mu_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1)
+                                                 * self.schedule_decay))
+        sched = self._m_schedule.get(index, 1.0) * mu_t
+        self._m_schedule[index] = sched
+        sched_next = sched * mu_t1
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        g_prime = g / (1 - sched)
+        m_prime = m._data / (1 - sched_next)
+        v_prime = v._data / (1 - self.beta2 ** t)
+        m_bar = (1 - mu_t) * g_prime + mu_t1 * m_prime
+        step = lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+        weight._data = (weight._data.astype(jnp.float32) - step) \
+            .astype(weight.dtype)
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    """Accumulated-delta adaptive method (reference AdaDelta; no fixed
+    learning rate — `rho` and `epsilon` govern the step)."""
+
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"),
+                zeros(weight.shape, dtype="float32"))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd,
+                            weight._data)
+        acc_g, acc_d = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * g * g
+        step = jnp.sqrt(acc_d._data + self.epsilon) \
+            / jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_d._data = self.rho * acc_d._data + (1 - self.rho) * step * step
+        weight._data = (weight._data.astype(jnp.float32) - step) \
+            .astype(weight.dtype)
+
+
+@register("dcasgd")
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference DCASGD): compensates stale
+    gradients with lambda * g^2 * (w - w_prev). On TPU training is
+    synchronous, so the compensation term is usually zero — kept for
+    script compatibility."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = zeros(weight.shape, dtype="float32") if self.momentum else None
+        prev = NDArray(weight._data.astype("float32"))
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w32 = weight._data.astype(jnp.float32)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd, w32)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (w32 - prev._data)
+        if mom is not None:
+            mom._data = self.momentum * mom._data - lr * comp
+            new_w = w32 + mom._data
+        else:
+            new_w = w32 - lr * comp
+        prev._data = new_w
+        weight._data = new_w.astype(weight.dtype)
+
+
+@register("sgld")
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference SGLD): SGD half-step
+    plus Gaussian noise scaled by sqrt(lr) — posterior sampling, not just
+    optimization."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w32 = weight._data.astype(jnp.float32)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd, w32)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  jnp.float32) * math.sqrt(lr)
+        weight._data = (w32 - lr / 2 * g + noise).astype(weight.dtype)
+
+
+@register("ftml")
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference FTML, Zheng & Kwok 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype="float32"),   # d
+                zeros(weight.shape, dtype="float32"),   # v
+                zeros(weight.shape, dtype="float32"))   # z
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = _dense_grad_f32(grad, self._clip(), self.rescale_grad, wd,
+                            weight._data)
+        d, v, z = state
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v._data / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z._data = self.beta1 * z._data + (1 - self.beta1) * g \
+            - sigma * weight._data.astype(jnp.float32)
+        d._data = d_t
+        weight._data = (-z._data / d_t).astype(weight.dtype)
+
+
+def _dense_grad_f32(grad, clip, rescale, wd=0.0, weight=None):
+    """Dense f32 gradient with rescale + clip + weight decay applied in
+    one place (row_sparse grads are densified — these optimizers have no
+    lazy row path). Mirrors ops/optimizer_ops._apply_wd for the
+    class-based optimizers."""
+    import jax.numpy as jnp
+    if _is_row_sparse(grad):
+        grad = grad.tostype("default")
+    g = grad._data.astype(jnp.float32) * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    if wd and weight is not None:
+        g = g + wd * weight.astype(jnp.float32)
+    return g
