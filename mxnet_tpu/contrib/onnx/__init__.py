@@ -115,7 +115,7 @@ def _export_node(node, in_names, out_name):
         return n1("Add")
     if op in ("elemwise_mul", "broadcast_mul", "_mul"):
         return n1("Mul")
-    if op == "Concat":
+    if op in ("Concat", "concat"):
         return n1("Concat", {"axis": int(_attr(a, "dim", 1))})
     if op in ("softmax", "SoftmaxActivation"):
         return n1("Softmax", {"axis": int(_attr(a, "axis", -1))})

@@ -244,3 +244,45 @@ def test_unsupported_op_raises(tmp_path):
     with pytest.raises(NotImplementedError, match="not in the"):
         onnx_mx.export_model(out, {}, {"data": (1, 4)},
                              str(tmp_path / "x.onnx"))
+
+
+def densenet_block_symbol(num_classes=5):
+    """DenseNet-pattern topology: BN-ReLU-Conv layers whose outputs CONCAT
+    onto their inputs, a strided avg-pool transition, global pool head —
+    the concat-heavy export case the resnet test never exercises."""
+    data = sym.var("data")
+    x = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        no_bias=True, name="stem")
+    for i in range(3):
+        b = sym.BatchNorm(x, name=f"dense{i}_bn")
+        b = sym.Activation(b, act_type="relu", name=f"dense{i}_relu")
+        b = sym.Convolution(b, kernel=(3, 3), pad=(1, 1), num_filter=4,
+                            no_bias=True, name=f"dense{i}_conv")
+        x = sym.concat(x, b, dim=1, name=f"dense{i}_concat")
+    t = sym.BatchNorm(x, name="trans_bn")
+    t = sym.Activation(t, act_type="relu", name="trans_relu")
+    t = sym.Convolution(t, kernel=(1, 1), num_filter=8, no_bias=True,
+                        name="trans_conv")
+    t = sym.Pooling(t, kernel=(2, 2), stride=(2, 2), pool_type="avg",
+                    name="trans_pool")
+    pool = sym.Pooling(t, global_pool=True, pool_type="avg", name="gpool")
+    flat = sym.flatten(pool, name="flat")
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, flatten=False,
+                            name="fc")
+    return sym.softmax(fc, axis=-1, name="out")
+
+
+def test_densenet_pattern_roundtrip(tmp_path):
+    shape = (2, 3, 16, 16)
+    net = densenet_block_symbol()
+    params = _init_params(net, shape)
+    f = str(tmp_path / "densenet_block.onnx")
+    onnx_mx.export_model(net, params, {"data": shape}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    params2 = dict(args2)
+    params2.update(aux2)
+    rs = np.random.RandomState(11)
+    x = rs.normal(size=shape).astype(np.float32)
+    ref = _run(net, params, x)
+    got = _run_imported(sym2, params2, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
