@@ -11,7 +11,23 @@ import logging
 import os
 import threading
 
-__all__ = ["MXNetError", "get_env", "registry_get", "logger", "numeric_types", "string_types"]
+__all__ = ["MXNetError", "get_env", "registry_get", "logger", "numeric_types",
+           "string_types", "part_range"]
+
+
+def part_range(n, num_parts, part_index):
+    """Record range [lo, hi) owned by one input-sharding worker (reference:
+    `src/io/iter_image_recordio_2.cc` num_parts/part_index — each worker
+    reads a disjoint slice; slices union to exactly one epoch)."""
+    num_parts, part_index = int(num_parts), int(part_index)
+    if num_parts < 1 or not 0 <= part_index < num_parts:
+        raise ValueError(
+            f"invalid partition: part_index={part_index} num_parts={num_parts}")
+    lo = n * part_index // num_parts
+    hi = n * (part_index + 1) // num_parts
+    if num_parts > 1 and lo >= hi:
+        raise ValueError(f"empty partition: {num_parts} parts over {n} records")
+    return lo, hi
 
 logger = logging.getLogger("mxnet_tpu")
 

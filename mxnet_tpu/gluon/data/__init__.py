@@ -1,9 +1,10 @@
 """`gluon.data` (reference: `python/mxnet/gluon/data/`)."""
 from .dataset import Dataset, ArrayDataset, SimpleDataset
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      ShardedSampler)
 from .dataloader import DataLoader
 from . import vision
 
 __all__ = ["Dataset", "ArrayDataset", "SimpleDataset", "Sampler",
-           "SequentialSampler", "RandomSampler", "BatchSampler", "DataLoader",
-           "vision"]
+           "SequentialSampler", "RandomSampler", "BatchSampler",
+           "ShardedSampler", "DataLoader", "vision"]

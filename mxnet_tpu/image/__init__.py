@@ -428,7 +428,8 @@ class ImageIter(DataIter):
                  path_imglist=None, path_root=None, shuffle=False,
                  aug_list=None, imglist=None, label_width=1,
                  data_name="data", label_name="softmax_label",
-                 last_batch_handle="pad", **kwargs):
+                 last_batch_handle="pad", num_parts=1, part_index=0,
+                 **kwargs):
         super().__init__(batch_size)
         if len(data_shape) != 3 or data_shape[0] != 3:
             raise ValueError("data_shape must be (3, H, W)")
@@ -462,6 +463,11 @@ class ImageIter(DataIter):
             self.seq = list(self.imglist.keys())
         else:
             raise ValueError("need path_imgrec, path_imglist, or imglist")
+        # multi-worker input sharding (reference: iter_image_recordio_2.cc
+        # num_parts/part_index): each worker keeps a disjoint seq slice
+        from ..base import part_range
+        lo, hi = part_range(len(self.seq), num_parts, part_index)
+        self.seq = self.seq[lo:hi]
         self.cur = 0
         self.reset()
 

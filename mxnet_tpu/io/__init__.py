@@ -216,11 +216,17 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, preprocess_threads=4, round_batch=True,
-                 use_native=None, seed=0, **kwargs):
+                 use_native=None, seed=0, num_parts=1, part_index=0,
+                 **kwargs):
+        from ..base import part_range
         super().__init__(batch_size)
         self._data_shape = tuple(data_shape)  # (C, H, W)
         idx_path = path_imgidx or path_imgrec.rsplit(".", 1)[0] + ".idx"
         self._record = recordio.IndexedRecordIO(idx_path, path_imgrec, "r")
+        # multi-worker input sharding (reference: iter_image_recordio_2.cc
+        # num_parts/part_index): this worker owns a disjoint key slice
+        lo, hi = part_range(len(self._record.keys), num_parts, part_index)
+        self._part_keys = list(self._record.keys)[lo:hi]
         self._native = None
         if use_native is not False and self._record.keys:
             # C++ decode/augment/prefetch pipeline (native/), the analog of
@@ -238,7 +244,8 @@ class ImageRecordIter(DataIter):
                             num_threads=preprocess_threads, shuffle=shuffle,
                             rand_crop=rand_crop, rand_mirror=rand_mirror,
                             mean=[mean_r, mean_g, mean_b],
-                            std=[std_r, std_g, std_b], seed=seed)
+                            std=[std_r, std_g, std_b], seed=seed,
+                            num_parts=num_parts, part_index=part_index)
                     except RuntimeError:
                         self._native = None
         if use_native and self._native is None:
@@ -283,7 +290,7 @@ class ImageRecordIter(DataIter):
             if getattr(self, "_started", False):
                 self._native.reset()
             self._started = True
-        keys = list(self._record.keys)
+        keys = list(self._part_keys)
         if self._shuffle:
             np.random.shuffle(keys)
         self._keys = keys
@@ -341,11 +348,15 @@ class LibSVMIter(DataIter):
     (reference: `src/io/iter_libsvm.cc`)."""
 
     def __init__(self, data_libsvm, data_shape, batch_size, label_libsvm=None,
-                 label_shape=None, round_batch=True, **kwargs):
+                 label_shape=None, round_batch=True, num_parts=1,
+                 part_index=0, **kwargs):
+        from ..base import part_range
         super().__init__(batch_size)
         self._num_features = int(data_shape[0] if isinstance(
             data_shape, (tuple, list)) else data_shape)
         self._labels, self._rows = self._parse(data_libsvm)
+        lo, hi = part_range(len(self._rows), num_parts, part_index)
+        self._labels, self._rows = self._labels[lo:hi], self._rows[lo:hi]
         self._cursor = 0
 
     def _parse(self, path):
@@ -403,13 +414,17 @@ class CSVIter(DataIter):
     """Reference: `src/io/iter_csv.cc`."""
 
     def __init__(self, data_csv, data_shape, batch_size, label_csv=None,
-                 label_shape=(1,), round_batch=True, **kwargs):
+                 label_shape=(1,), round_batch=True, num_parts=1,
+                 part_index=0, **kwargs):
+        from ..base import part_range
         super().__init__(batch_size)
         data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
         label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32) \
             if label_csv else np.zeros(len(data), np.float32)
-        self._inner = NDArrayIter(data, label, batch_size=batch_size)
+        lo, hi = part_range(len(data), num_parts, part_index)
+        self._inner = NDArrayIter(data[lo:hi], label[lo:hi],
+                                  batch_size=batch_size)
 
     def reset(self):
         self._inner.reset()

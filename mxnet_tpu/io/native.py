@@ -48,7 +48,8 @@ def _load():
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_int]
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
         lib.mxtpu_pipe_next.restype = ctypes.c_int
         lib.mxtpu_pipe_next.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -76,7 +77,7 @@ class NativeImagePipeline:
     def __init__(self, rec_path, idx_path, batch_size, data_shape,
                  num_threads=4, shuffle=False, rand_crop=False,
                  rand_mirror=False, mean=None, std=None, seed=0,
-                 label_width=1):
+                 label_width=1, num_parts=1, part_index=0):
         lib = _load()
         if lib is None:
             raise RuntimeError("native pipeline unavailable")
@@ -87,7 +88,8 @@ class NativeImagePipeline:
         self._handle = lib.mxtpu_pipe_create(
             rec_path.encode(), (idx_path or "").encode(), batch_size, c, h, w,
             num_threads, int(shuffle), int(rand_crop), int(rand_mirror),
-            mean_arr, std_arr, seed, label_width)
+            mean_arr, std_arr, seed, label_width, int(num_parts),
+            int(part_index))
         if not self._handle:
             raise RuntimeError("native pipeline create failed: %s"
                                % lib.mxtpu_last_error().decode())

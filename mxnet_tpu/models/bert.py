@@ -26,7 +26,8 @@ from ..ndarray import ndarray as F
 def bert_base_config(**overrides):
     cfg = dict(vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
                num_heads=12, max_length=512, type_vocab_size=2, dropout=0.1,
-               dtype="float32", remat=False)
+               attn_dropout=None, seq_parallel=False, dtype="float32",
+               remat=False)
     cfg.update(overrides)
     return cfg
 
@@ -42,6 +43,16 @@ def bert_large_config(**overrides):
     return cfg
 
 
+def bert_long_config(**overrides):
+    """Long-context pretraining config: sequence sharded over the mesh's
+    `sp` axis (ring attention — SURVEY §5.7 north-star). Attention-
+    probability dropout must be 0 under the ring (hidden dropout stays)."""
+    cfg = bert_base_config(max_length=8192, seq_parallel=True,
+                           attn_dropout=0.0, remat=True)
+    cfg.update(overrides)
+    return cfg
+
+
 def bert_tiny_config(**overrides):
     """Test-scale config."""
     cfg = bert_base_config(vocab_size=128, units=64, hidden_size=128,
@@ -51,10 +62,16 @@ def bert_tiny_config(**overrides):
 
 
 class BERTAttention(HybridBlock):
-    """Self-attention with fused QKV and the flash kernel."""
+    """Self-attention with fused QKV and the flash kernel (or ring attention
+    over the `sp` mesh axis when seq_parallel is set)."""
 
-    def __init__(self, units, num_heads, dropout=0.0, dtype="float32", **kwargs):
+    def __init__(self, units, num_heads, dropout=0.0, dtype="float32",
+                 seq_parallel=False, **kwargs):
         super().__init__(**kwargs)
+        if seq_parallel and dropout > 0.0:
+            raise ValueError(
+                "attention-probability dropout is not supported under ring "
+                "sequence parallelism; pass attn_dropout=0 in the config")
         self._units = units
         self._num_heads = num_heads
         self.qkv = nn.Dense(3 * units, in_units=units, flatten=False, dtype=dtype,
@@ -62,20 +79,26 @@ class BERTAttention(HybridBlock):
         self.proj = nn.Dense(units, in_units=units, flatten=False, dtype=dtype,
                              weight_initializer="xavier")
         self._dropout = dropout
+        self._seq_parallel = seq_parallel
 
     def forward(self, x, mask=None):
         # x: (B, L, E); mask: (B, L) 1=valid
         qkv = self.qkv(x)  # (B, L, 3E)
         out = F.fused_self_attention(qkv, mask, num_heads=self._num_heads,
-                                     dropout=self._dropout)
+                                     dropout=self._dropout,
+                                     seq_parallel=self._seq_parallel)
         return self.proj(out)
 
 
 class BERTEncoderLayer(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 dtype="float32", **kwargs):
+                 dtype="float32", attn_dropout=None, seq_parallel=False,
+                 **kwargs):
         super().__init__(**kwargs)
-        self.attention = BERTAttention(units, num_heads, dropout, dtype)
+        self.attention = BERTAttention(
+            units, num_heads,
+            dropout if attn_dropout is None else attn_dropout, dtype,
+            seq_parallel=seq_parallel)
         self.attn_ln = nn.LayerNorm(in_channels=units)
         self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
                                dtype=dtype, weight_initializer="xavier")
@@ -111,15 +134,39 @@ def _remat_call(layer, x, mask):
     return NDArray(jax.checkpoint(f)(*args))
 
 
+def _positions(position_embed, L, sp_manual):
+    """Slice L position embeddings. Inside a shard_map stage controlling
+    `sp`, this device holds tokens [off, off+L) of the global sequence —
+    slice ITS positions, not [0, L). The GLOBAL length is validated here:
+    dynamic_slice clamps out-of-range starts, which would otherwise
+    silently reuse shard 0's positions on every shard."""
+    import jax
+    max_len = position_embed.shape[0]
+    if sp_manual:
+        n = jax.lax.psum(1, "sp")       # static: axis size
+        if L * n > max_len:
+            raise ValueError(
+                f"global sequence length {L * n} (local {L} x sp={n}) "
+                f"exceeds max_length {max_len}")
+        off = jax.lax.axis_index("sp") * L
+        return NDArray(jax.lax.dynamic_slice_in_dim(
+            position_embed.data()._data, off, L, 0))
+    if L > max_len:
+        raise ValueError(f"sequence length {L} exceeds max_length {max_len}")
+    return NDArray(position_embed.data()._data[:L])
+
+
 class BERTModel(HybridBlock):
     """Embeddings + encoder stack + pooler (reference: gluonnlp BERTModel)."""
 
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
                  max_length=512, type_vocab_size=2, dropout=0.1,
+                 attn_dropout=None, seq_parallel=False,
                  dtype="float32", remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._remat = remat
+        self._seq_parallel = seq_parallel
         self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
                                        weight_initializer="xavier")
         self.token_type_embed = nn.Embedding(type_vocab_size, units, dtype=dtype,
@@ -133,7 +180,9 @@ class BERTModel(HybridBlock):
         self.layers = nn.HybridSequential()
         for _ in range(num_layers):
             self.layers.add(BERTEncoderLayer(units, hidden_size, num_heads,
-                                             dropout, dtype))
+                                             dropout, dtype,
+                                             attn_dropout=attn_dropout,
+                                             seq_parallel=seq_parallel))
         self.pooler = nn.Dense(units, in_units=units, flatten=False,
                                activation="tanh", dtype=dtype,
                                weight_initializer="xavier")
@@ -144,19 +193,30 @@ class BERTModel(HybridBlock):
         if L > max_len:
             raise ValueError(
                 f"sequence length {L} exceeds max_length {max_len}")
+        from ..parallel import in_manual
+        sp_manual = self._seq_parallel and in_manual("sp")
         x = self.word_embed(inputs)
         if token_types is not None:
             x = x + self.token_type_embed(token_types)
-        pos = NDArray(self.position_embed.data()._data[:L])
-        x = x + pos.expand_dims(axis=0)
+        x = x + _positions(self.position_embed, L, sp_manual).expand_dims(axis=0)
         x = self.embed_ln(x)
         if self.embed_dropout:
             x = self.embed_dropout(x)
         mask = None
         if valid_length is not None:
+            import jax
             import jax.numpy as jnp
             vl = valid_length._data if isinstance(valid_length, NDArray) else valid_length
-            mask = NDArray(jnp.arange(L)[None, :] < vl[:, None].astype(jnp.int32))
+            idx = jnp.arange(L)
+            if sp_manual:
+                idx = idx + jax.lax.axis_index("sp") * L
+            mask = NDArray(idx[None, :] < vl[:, None].astype(jnp.int32))
+        if self._seq_parallel and not sp_manual:
+            # anchor the sequence sharding early so GSPMD keeps (B, L, E)
+            # activations sp-sharded between the attention shard_maps
+            from ..ndarray import apply_op
+            from ..parallel import specs as _sp
+            x = apply_op(_sp.constrain_seq, x)
         from .. import _engine
         # remat only where it means something: inside a jit trace (the
         # eager tape stores activations per-op; jax.checkpoint there would
@@ -176,6 +236,60 @@ class BERTModel(HybridBlock):
         x = apply_op(_specs.constrain_batch, x)
         pooled = self.pooler(F.slice_axis(x, axis=1, begin=0, end=1).squeeze(axis=1))
         return x, pooled
+
+
+class BERTEmbedStage(HybridBlock):
+    """BERT embeddings as pipeline stage 0 (word + type + position + LN).
+    sp-aware like BERTModel: under a shard_map that controls `sp` it embeds
+    this device's sequence shard with the correct global positions."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        units, dtype = cfg["units"], cfg["dtype"]
+        self._seq_parallel = cfg.get("seq_parallel", False)
+        self.word_embed = nn.Embedding(cfg["vocab_size"], units, dtype=dtype,
+                                       weight_initializer="xavier")
+        self.position_embed = Parameter(
+            "position_weight", shape=(cfg["max_length"], units), dtype=dtype,
+            init="xavier")
+        self.position_embed.shard_hint = "embedding"
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+
+    def forward(self, inputs):
+        from ..parallel import in_manual
+        L = inputs.shape[1]
+        sp_manual = self._seq_parallel and in_manual("sp")
+        x = self.word_embed(inputs)
+        x = x + _positions(self.position_embed, L, sp_manual).expand_dims(axis=0)
+        return self.embed_ln(x)
+
+
+def bert_pipeline_stages(cfg, num_stages):
+    """Split a BERT encoder into pipeline stage blocks: stage 0 =
+    embeddings, stages 1..S-1 = equal groups of encoder layers. Padding
+    masks don't travel the activation carrier, so stages attend over the
+    full (micro)batch sequence.
+
+    Use with the hetero PipelineTrainer only on sp=1 meshes. For sequence
+    parallelism, build homogeneous stages (BERTEmbedStage + identical
+    BERTEncoderLayer stages) for SeqPipelineTrainer instead — ring
+    attention's collectives cannot live inside the hetero stage switch."""
+    layers_per = cfg["num_layers"] // (num_stages - 1)
+    if layers_per * (num_stages - 1) != cfg["num_layers"]:
+        raise ValueError(
+            f"num_layers {cfg['num_layers']} not divisible into "
+            f"{num_stages - 1} encoder stages")
+    stages = [BERTEmbedStage(cfg)]
+    for _ in range(num_stages - 1):
+        seq = nn.HybridSequential()
+        for _ in range(layers_per):
+            seq.add(BERTEncoderLayer(
+                cfg["units"], cfg["hidden_size"], cfg["num_heads"],
+                cfg["dropout"], cfg["dtype"],
+                attn_dropout=cfg.get("attn_dropout"),
+                seq_parallel=cfg.get("seq_parallel", False)))
+        stages.append(seq)
+    return stages
 
 
 class BERTForPretraining(HybridBlock):

@@ -444,10 +444,15 @@ def flash_attention_op(q, k, v, mask=None, causal=False, sm_scale=None,
 
 @register("fused_self_attention")
 def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
-                         dropout=0.0, _training=None):
+                         dropout=0.0, seq_parallel=False, _training=None):
     """Self-attention from a fused QKV projection (B, L, 3E) → (B, L, E).
     The model-facing fused path (replaces the reference's interleaved-matmul
-    attention ops for new code)."""
+    attention ops for new code).
+
+    seq_parallel: shard the sequence over the mesh's `sp` axis and run ring
+    attention (SURVEY §5.7 long-context path). No-op when the active mesh
+    has sp=1, so the same model config runs anywhere. Attention-probability
+    dropout is not supported under the ring (raises)."""
     B, L, E3 = qkv.shape
     H = num_heads
     D = E3 // 3 // H
@@ -455,6 +460,24 @@ def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
     q = x[:, :, 0].transpose(0, 2, 1, 3)
     k = x[:, :, 1].transpose(0, 2, 1, 3)
     v = x[:, :, 2].transpose(0, 2, 1, 3)
-    out = flash_attention_op(q, k, v, mask=mask, causal=causal,
-                             dropout=dropout, _training=_training)
+    from ..parallel import current_mesh, in_manual
+    sp_n = current_mesh().shape.get("sp", 1) if seq_parallel else 1
+    if seq_parallel and (sp_n > 1 or in_manual("sp")):
+        from .. import _engine
+        training = _engine.is_training() if _training is None else _training
+        if dropout > 0.0 and training:
+            raise ValueError(
+                "attention-probability dropout is not supported under ring "
+                "sequence parallelism; configure the model with "
+                "attn_dropout=0 (hidden dropout is unaffected)")
+        from ..parallel.ring_attention import ring_attention, sp_self_attention
+        if in_manual("sp"):
+            # already inside a shard_map that controls sp (pipeline stage):
+            # arrays are per-shard, use the ring collectives directly
+            out = ring_attention(q, k, v, "sp", mask=mask, causal=causal)
+        else:
+            out = sp_self_attention(q, k, v, mask=mask, causal=causal)
+    else:
+        out = flash_attention_op(q, k, v, mask=mask, causal=causal,
+                                 dropout=dropout, _training=_training)
     return out.transpose(0, 2, 1, 3).reshape(B, L, H * D)

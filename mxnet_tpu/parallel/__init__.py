@@ -4,14 +4,16 @@ See SURVEY.md §2.4/§2.5 — this package is the TPU-native replacement for the
 reference's KVStore transports and the home of the net-new parallelism the
 reference lacks (tensor, pipeline, sequence/ring)."""
 from .mesh import (make_mesh, MeshPlan, current_mesh, set_mesh, named_sharding,
-                   PartitionSpec, local_mesh_devices)
+                   PartitionSpec, local_mesh_devices, manual_axes, in_manual)
 from . import specs
 from .specs import batch_spec, param_spec, fsdp_spec, replicated, apply_tp_rules
 from .functional_opt import FunctionalOptimizer
 from .trainer import ShardedTrainer
-from .ring_attention import ring_attention, ring_self_attention
+from .ring_attention import (ring_attention, ring_self_attention,
+                             sp_self_attention)
 from .pipeline import (pipeline_apply, pipeline_shard_map,
-                       pipeline_apply_hetero, PipelineTrainer)
+                       pipeline_apply_hetero, PipelineTrainer,
+                       SeqPipelineTrainer)
 from .distributed import init_distributed, is_distributed
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .moe import moe_apply, moe_ffn
@@ -20,7 +22,9 @@ __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding"
            "PartitionSpec", "local_mesh_devices", "specs", "batch_spec",
            "param_spec", "fsdp_spec", "replicated", "apply_tp_rules",
            "FunctionalOptimizer", "ShardedTrainer", "ring_attention",
-           "ring_self_attention", "pipeline_apply", "pipeline_shard_map",
-           "pipeline_apply_hetero", "PipelineTrainer", "init_distributed",
+           "ring_self_attention", "sp_self_attention", "manual_axes",
+           "in_manual", "pipeline_apply", "pipeline_shard_map",
+           "pipeline_apply_hetero", "PipelineTrainer", "SeqPipelineTrainer",
+           "init_distributed",
            "is_distributed", "ulysses_attention", "ulysses_self_attention",
            "moe_apply", "moe_ffn"]

@@ -24,9 +24,35 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding",
-           "PartitionSpec", "local_mesh_devices"]
+           "PartitionSpec", "local_mesh_devices", "manual_axes", "in_manual"]
 
 _current = {"mesh": None}
+_manual = set()
+
+
+class manual_axes:
+    """Mark mesh axes as already under manual (shard_map) control while
+    tracing, so axis-aware library code (ring attention, sp position
+    embeddings) uses per-shard collectives directly instead of opening a
+    nested shard_map. SeqPipelineTrainer sets this around its jitted step;
+    see `ops.nn_ops.fused_self_attention` and `models.bert` for consumers."""
+
+    def __init__(self, *names):
+        self.names = set(names)
+
+    def __enter__(self):
+        self._added = self.names - _manual
+        _manual.update(self.names)
+        return self
+
+    def __exit__(self, *exc):
+        _manual.difference_update(self._added)
+        return False
+
+
+def in_manual(name):
+    """True when `name` is currently a manual (shard_map-controlled) axis."""
+    return name in _manual
 
 
 class MeshPlan:

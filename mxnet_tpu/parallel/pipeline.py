@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 from .mesh import current_mesh
 
 __all__ = ["pipeline_apply", "pipeline_shard_map", "pipeline_apply_hetero",
-           "PipelineTrainer"]
+           "PipelineTrainer", "SeqPipelineTrainer"]
 
 
 def _schedule(n, sid, M, axis_name, step_fn, state0):
@@ -142,6 +142,195 @@ def pipeline_apply_hetero(stage_fns, stage_params, microbatch_inputs,
     return _schedule(n, sid, M, axis_name, step, state0)
 
 
+class SeqPipelineTrainer:
+    """Pipeline x data x sequence parallelism in one SPMD program.
+
+    The composition the hetero PipelineTrainer cannot express: ring
+    attention's sp collectives must execute UNCONDITIONALLY on every device,
+    so the pipeline must be homogeneous — every pp stage runs the SAME
+    function over stage-STACKED parameters (sharded over `pp`), embed and
+    head run replicated across pp outside the scan (cheap: they are a small
+    fraction of the compute), and dp/sp shard the batch/sequence inside the
+    same shard_map. This is the long-context training schedule of SURVEY
+    §5.7: pp moves layer groups across chips, sp (ring attention +
+    sp-offset position embeddings, signalled via `manual_axes`) shards the
+    sequence, dp the batch.
+
+    embed: gluon block mapping raw inputs -> (B, L, E) activation.
+    stages: list of structurally IDENTICAL gluon blocks (act -> act).
+    head: gluon block mapping act -> outputs for loss_fn.
+    data_specs/label_specs: PartitionSpecs of the raw batch arrays, e.g.
+    P(('dp','fsdp'), 'sp') for token ids.
+    """
+
+    def __init__(self, embed, stages, head, loss_fn, optimizer="sgd",
+                 optimizer_params=None, num_microbatches=2, mesh=None,
+                 axis_name="pp", data_specs=None, label_specs=None,
+                 remat=True):
+        from .. import optimizer as opt_mod
+        from .functional_opt import FunctionalOptimizer
+
+        self.embed, self.stages, self.head = embed, list(stages), head
+        self.loss_fn = loss_fn
+        self.mesh = mesh or current_mesh()
+        self.axis = axis_name
+        self.M = num_microbatches
+        self.remat = remat
+        self._data_specs = list(data_specs or [])
+        self._label_specs = list(label_specs or [])
+        if self.mesh.shape.get(axis_name, 1) != len(self.stages):
+            raise ValueError(
+                f"pipeline axis '{axis_name}' has "
+                f"{self.mesh.shape.get(axis_name, 1)} devices but "
+                f"{len(self.stages)} stages were given; they must match")
+        self._opt = opt_mod.create(optimizer, **(optimizer_params or {})) \
+            if isinstance(optimizer, str) else optimizer
+        self._fopt_cls = FunctionalOptimizer
+        self.num_update = 0
+        self._step_cache = {}
+        self._setup()
+
+    def _setup(self):
+        from ..gluon.block import functional_call
+
+        def pure(blk, what):
+            fn, gp, aux = functional_call(blk, train=True)
+            if aux:
+                raise NotImplementedError(
+                    f"aux state (BatchNorm stats) in pipeline {what}")
+            return fn, gp
+
+        self._embed_fn, embed_gp = pure(self.embed, "embed")
+        stage_fns, stage_gps = zip(*[pure(s, "stage") for s in self.stages])
+        self._stage_fn = stage_fns[0]
+        ref_names = [n for n, _ in stage_gps[0]]
+        for gp in stage_gps[1:]:
+            if [n for n, _ in gp] != ref_names:
+                raise ValueError("homogeneous pipeline stages must be "
+                                 "structurally identical")
+        self._head_fn, head_gp = pure(self.head, "head")
+        self._embed_gp, self._stage_gps, self._head_gp = \
+            embed_gp, stage_gps, head_gp
+
+        names = [f"embed.{n}" for n, _ in embed_gp]
+        names += [f"stages.{n}" for n in ref_names]
+        names += [f"head.{n}" for n, _ in head_gp]
+        self.fopt = self._fopt_cls(self._opt, names)
+
+        from . import specs as _specs
+        rep = _specs.replicated(self.mesh)
+        self._rep = rep
+        self._n_embed, self._n_stage = len(embed_gp), len(ref_names)
+        # stage params stacked over a leading stage dim, sharded over pp —
+        # device pp=i holds only ITS stage's weights (true pipeline memory)
+        flat = [jax.device_put(p.data()._data, rep) for _, p in embed_gp]
+        self._stack_shard = []
+        for li in range(self._n_stage):
+            leaves = [gp[li][1].data()._data for gp in stage_gps]
+            stacked = jnp.stack(leaves)
+            sh = jax.sharding.NamedSharding(
+                self.mesh, P(*((self.axis,) + (None,) * (stacked.ndim - 1))))
+            self._stack_shard.append(sh)
+            flat.append(jax.device_put(stacked, sh))
+        flat += [jax.device_put(p.data()._data, rep) for _, p in head_gp]
+        self.params = flat
+        self._pshard = ([rep] * self._n_embed + self._stack_shard +
+                        [rep] * len(head_gp))
+        self.opt_state = [
+            tuple(jax.device_put(z, s) for z in st)
+            for st, s in zip(self.fopt.init(self.params), self._pshard)]
+
+    def _build_step(self, n_data, n_label):
+        from jax import shard_map
+        from .. import random as _random
+        from .trainer import call_loss
+
+        M, axis, mesh = self.M, self.axis, self.mesh
+        embed_fn, stage_fn, head_fn = \
+            self._embed_fn, self._stage_fn, self._head_fn
+        loss_fn = self.loss_fn
+        fopt = self.fopt
+        remat = self.remat
+        ne, ns = self._n_embed, self._n_stage
+        data_axes = tuple(a for a in ("dp", "fsdp", "sp")
+                          if mesh.shape.get(a, 1) > 1)
+
+        dspecs = (self._data_specs + [P()] * n_data)[:n_data]
+        lspecs = (self._label_specs + [P()] * n_label)[:n_label]
+        stack_specs = [P(*((axis,) + (None,) * (s.ndim - 1)))
+                       for s in self.params[ne:ne + ns]]
+
+        def body(ep, sp_, hp, rng, *arrs):
+            data_l, labels_l = arrs[:n_data], arrs[n_data:]
+            outs, _ = embed_fn(ep, [], jax.random.fold_in(rng, 7),
+                               *[jnp.asarray(a) for a in data_l])
+            x0 = outs[0]                            # (B_loc, L_loc, E)
+            mb = x0.shape[0] // M
+            mbs = x0.reshape((M, mb) + x0.shape[1:])
+            sp_local = [a[0] for a in sp_]          # drop the stage dim
+
+            def sfn(pl, x):
+                o, _ = stage_fn(pl, [], jax.random.fold_in(rng, 11), x)
+                return o[0]
+
+            acts = pipeline_apply(sfn, sp_local, mbs, axis, remat=remat)
+            full = acts.reshape((-1,) + acts.shape[2:])
+            houts, _ = head_fn(hp, [], jax.random.fold_in(rng, 13), full)
+            loss = call_loss(loss_fn, rng, [houts[0]], list(labels_l))
+            # equal-sized shards: global mean = mean of shard means
+            return lax.pmean(loss, data_axes) if data_axes else loss
+
+        sharded = shard_map(
+            body, mesh=mesh,
+            in_specs=([P()] * ne, stack_specs, [P()] * len(self._head_gp),
+                      P(), *dspecs, *lspecs),
+            out_specs=P(), check_vma=False)
+
+        def step(params, opt_state, t, lr, rng, *batch):
+            def loss_of(flat):
+                return sharded(flat[:ne], flat[ne:ne + ns], flat[ne + ns:],
+                               rng, *batch)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(params))
+            new_params, new_opt = fopt.apply(params, grads, opt_state, t, lr)
+            return loss, new_params, new_opt
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def step(self, data, labels):
+        from ..ndarray import NDArray
+        from .. import random as _random
+        from .mesh import manual_axes
+
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        batch = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                 for b in list(data) + list(labels)]
+        key = (len(data), tuple(b.shape for b in batch))
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(len(data), len(labels))
+        self.num_update += 1
+        t = jnp.asarray(self.num_update, jnp.float32)
+        lr = jnp.asarray(self.fopt.lr_at(self.num_update), jnp.float32)
+        # sp is shard_map-controlled while the step traces: stage blocks'
+        # ring attention and sp position embeddings run per-shard
+        with manual_axes("sp"):
+            loss, self.params, self.opt_state = self._step_cache[key](
+                self.params, self.opt_state, t, lr, _random.next_key(),
+                *batch)
+        return NDArray(loss)
+
+    def sync_to_block(self):
+        ne, ns = self._n_embed, self._n_stage
+        for (_, p), v in zip(self._embed_gp, self.params[:ne]):
+            p.data()._data = v
+        for li, stacked in enumerate(self.params[ne:ne + ns]):
+            for si, gp in enumerate(self._stage_gps):
+                gp[li][1].data()._data = stacked[si]
+        for (_, p), v in zip(self._head_gp, self.params[ne + ns:]):
+            p.data()._data = v
+
+
 class PipelineTrainer:
     """Train a list of gluon stage blocks over the `pp` mesh axis.
 
@@ -157,11 +346,36 @@ class PipelineTrainer:
     """
 
     def __init__(self, stages, loss_fn, optimizer="sgd", optimizer_params=None,
-                 head=None, num_microbatches=4, mesh=None, axis_name="pp"):
+                 head=None, num_microbatches=4, mesh=None, axis_name="pp",
+                 data_specs=None, act_spec=None):
+        """data_specs: optional per-input PartitionSpecs over the (mb, ...)
+        microbatch dims (e.g. P(('dp','fsdp')) for tokens) — the pipeline
+        then runs data-sharded INSIDE its shard_map, composing pp with dp.
+        act_spec: PartitionSpec of the activation carrier's (mb, ...) dims;
+        required when data_specs shard anything. 'sp' specs are rejected
+        (collectives cannot live inside the stage switch) — use
+        SeqPipelineTrainer for pp x sp."""
         from .. import optimizer as opt_mod
         from .functional_opt import FunctionalOptimizer
 
         self.stages = list(stages)
+        self._data_specs = list(data_specs) if data_specs else None
+        self._act_spec = act_spec
+        if self._data_specs and act_spec is None:
+            raise ValueError("act_spec is required when data_specs shard "
+                             "the microbatch inputs")
+        for spec in (self._data_specs or []) + \
+                ([act_spec] if act_spec is not None else []):
+            for ax in spec:
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                if "sp" in axes:
+                    raise ValueError(
+                        "sequence parallelism cannot run inside the "
+                        "heterogeneous pipeline: ring attention's ppermutes "
+                        "would sit inside the per-stage lax.switch, and "
+                        "collectives inside divergent control flow are "
+                        "illegal SPMD. Use SeqPipelineTrainer (homogeneous "
+                        "stages; collectives execute uniformly)")
         self.head = head
         self.loss_fn = loss_fn
         self.mesh = mesh or current_mesh()
@@ -250,6 +464,25 @@ class PipelineTrainer:
         from .. import random as _random
         impl = jax.random.key_impl(_random.get_state())
 
+        # local activation-carrier shape: divide the probed global dims by
+        # the mesh-axis sizes named in act_spec (dim 0 of act_sd is mb)
+        local_act = act_sd
+        if self._act_spec is not None:
+            shape = list(act_sd[0])
+            for d, ax in enumerate(self._act_spec):
+                if ax is None:
+                    continue
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                n = 1
+                for a in axes:
+                    n *= mesh.shape.get(a, 1)
+                if shape[d] % n:
+                    raise ValueError(
+                        f"activation dim {d} ({shape[d]}) not divisible by "
+                        f"axis product {n} of spec {self._act_spec}")
+                shape[d] //= n
+            local_act = (tuple(shape), act_sd[1])
+
         def fwd_pipeline(stage_param_lists, mb_inputs, rng):
             def make_stage(pure):
                 def f(params, rng_data, *xs):
@@ -263,12 +496,19 @@ class PipelineTrainer:
 
             fns = [make_stage(p) for p in stage_fns]
             return pipeline_apply_hetero(
-                fns, stage_param_lists, tuple(mb_inputs), act_sd, axis,
+                fns, stage_param_lists, tuple(mb_inputs), local_act, axis,
                 rng=rng)
 
+        if self._data_specs:
+            mb_specs = [P(None, *ds) for ds in self._data_specs]
+            out_spec = P(None, *self._act_spec)
+        else:
+            mb_specs = [P() for _ in range(n_data)]
+            out_spec = P()
         sharded_fwd = shard_map(
             fwd_pipeline, mesh=mesh,
-            in_specs=(P(), P(), P()), out_specs=P(), check_vma=False)
+            in_specs=(P(), mb_specs, P()), out_specs=out_spec,
+            check_vma=False)
 
         def step(params, opt_state, t, lr, rng, *batch):
             data, labels = batch[:n_data], batch[n_data:]

@@ -14,7 +14,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .mesh import current_mesh
 
 __all__ = ["param_spec", "batch_spec", "replicated", "fsdp_spec",
-           "apply_tp_rules", "constrain_batch", "DATA_AXES"]
+           "apply_tp_rules", "constrain_batch", "constrain_seq", "DATA_AXES"]
 
 # both dp and fsdp are "data" axes from the batch's point of view
 DATA_AXES = ("dp", "fsdp")
@@ -96,6 +96,9 @@ def constrain_batch(x, mesh=None):
     small-batch inference with a big mesh active)."""
     import jax
 
+    from .mesh import _manual
+    if _manual:
+        return x  # inside shard_map: arrays are per-shard, no constraints
     mesh = mesh or current_mesh()
     sharded = [a for a in DATA_AXES if mesh.shape.get(a, 1) > 1]
     if not sharded:
@@ -104,6 +107,34 @@ def constrain_batch(x, mesh=None):
     if x.ndim == 0 or x.shape[0] % total != 0:
         return x
     return jax.lax.with_sharding_constraint(x, batch_spec(x.ndim, mesh))
+
+
+def constrain_seq(x, mesh=None, seq_dim=1):
+    """Pin a (B, L, ...) activation to batch sharding on dim 0 AND `sp`
+    sharding on the sequence dim — the anchor that keeps long-context
+    activations sequence-sharded between ring-attention shard_maps (without
+    it GSPMD may all-gather L after the first elementwise op). Falls back
+    to `constrain_batch` when sp is 1 or L does not divide."""
+    import jax
+
+    from .mesh import _manual
+    if _manual:
+        return x
+    mesh = mesh or current_mesh()
+    sp = mesh.shape.get("sp", 1)
+    if sp <= 1 or x.ndim <= seq_dim or x.shape[seq_dim] % sp != 0:
+        return constrain_batch(x, mesh)
+    sharded = [a for a in DATA_AXES if mesh.shape.get(a, 1) > 1]
+    # shard dim 0 over the largest axis subset whose product divides B —
+    # pinning it to None would force an all-gather of a batch GSPMD may
+    # already have sharded
+    while sharded and x.shape[0] % int(
+            np.prod([mesh.shape[a] for a in sharded])):
+        sharded.pop()
+    spec = [tuple(sharded) if sharded else None] + [None] * (x.ndim - 1)
+    spec[seq_dim] = "sp"
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec)))
 
 
 def param_spec(param, mesh=None, mode="replicate"):

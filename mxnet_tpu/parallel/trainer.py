@@ -46,12 +46,19 @@ def call_loss(loss_fn, rng, outs, labels):
 
 class ShardedTrainer:
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, param_mode="replicate", donate=True):
+                 mesh=None, param_mode="replicate", donate=True,
+                 data_specs=None, label_specs=None):
+        """data_specs/label_specs: optional per-array PartitionSpec overrides
+        for the batch inputs (None entries fall back to the default
+        batch-on-data-axes spec) — e.g. P(('dp','fsdp'), 'sp') to shard
+        token sequences for long-context/ring-attention training."""
         from .. import optimizer as opt_mod
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh or current_mesh()
         self.param_mode = param_mode
+        self._data_specs = list(data_specs) if data_specs else []
+        self._label_specs = list(label_specs) if label_specs else []
         self._opt = opt_mod.create(optimizer, **(optimizer_params or {})) \
             if isinstance(optimizer, str) else optimizer
         self._donate = donate
@@ -154,10 +161,20 @@ class ShardedTrainer:
         in_shardings = (
             pshard, self._aux_shard, oshard,
             self._rep, self._rep, self._rep,
-        ) + tuple(_specs.batch_spec(len(shape), self.mesh) for shape in batch_shapes)
+        ) + tuple(self._batch_shardings(n_data, n_label, batch_shapes))
         out_shardings = (self._rep, pshard, self._aux_shard, oshard)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings, out_shardings=out_shardings)
+
+    # ------------------------------------------------------------------
+    def _batch_shardings(self, n_data, n_label, shapes):
+        from jax.sharding import NamedSharding
+
+        overrides = (self._data_specs + [None] * n_data)[:n_data] + \
+            (self._label_specs + [None] * n_label)[:n_label]
+        return [NamedSharding(self.mesh, ov) if ov is not None
+                else _specs.batch_spec(len(shape), self.mesh)
+                for ov, shape in zip(overrides, shapes)]
 
     # ------------------------------------------------------------------
     def step(self, data, labels):
@@ -182,8 +199,9 @@ class ShardedTrainer:
         self.num_update += 1
         t = jnp.asarray(self.num_update, jnp.float32)
         lr = jnp.asarray(self.fopt.lr_at(self.num_update), jnp.float32)
-        batch = [jax.device_put(b, _specs.batch_spec(b.ndim, self.mesh))
-                 for b in batch]
+        batch = [jax.device_put(b, s) for b, s in
+                 zip(batch, self._batch_shardings(len(data), len(labels),
+                                                  shapes))]
         loss, self.params, self.aux, self.opt_state = self._step_cache[key](
             self.params, self.aux, self.opt_state, t, lr,
             _random.next_key(), *batch)

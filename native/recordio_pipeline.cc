@@ -394,7 +394,8 @@ void* mxtpu_pipe_create(const char* rec_path, const char* idx_path,
                         int batch_size, int channels, int height, int width,
                         int num_threads, int shuffle, int rand_crop,
                         int rand_mirror, const float* mean, const float* stdv,
-                        uint64_t seed, int label_width) {
+                        uint64_t seed, int label_width, int num_parts,
+                        int part_index) {
   if (batch_size <= 0 || height <= 0 || width <= 0 || channels <= 0 ||
       channels > 3 || label_width <= 0) {
     set_error("invalid pipeline dimensions");
@@ -418,6 +419,25 @@ void* mxtpu_pipe_create(const char* rec_path, const char* idx_path,
   if (!load_index(p, idx_path)) {
     delete p;
     return nullptr;
+  }
+  if (num_parts > 1) {
+    // multi-worker input sharding (reference: iter_image_recordio_2.cc
+    // num_parts/part_index): worker i reads records [i*N/P, (i+1)*N/P) —
+    // parts are disjoint and union to exactly one epoch
+    if (part_index < 0 || part_index >= num_parts) {
+      set_error("part_index out of range");
+      delete p;
+      return nullptr;
+    }
+    const size_t n = p->offsets.size();
+    const size_t lo = n * size_t(part_index) / size_t(num_parts);
+    const size_t hi = n * size_t(part_index + 1) / size_t(num_parts);
+    if (lo >= hi) {
+      set_error("empty partition: more parts than records");
+      delete p;
+      return nullptr;
+    }
+    p->offsets.assign(p->offsets.begin() + lo, p->offsets.begin() + hi);
   }
   p->fd = open(rec_path, O_RDONLY);
   if (p->fd < 0) {
