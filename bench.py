@@ -160,9 +160,102 @@ def run_bench(on_tpu):
     if ceiling is not None:
         out["measured_matmul_ceiling_tflops"] = round(ceiling / 1e12, 1)
         out["achievable_mfu"] = round(achievable, 4)
+    if on_tpu and os.environ.get("MXNET_TPU_BENCH_EXTRA", "1") != "0":
+        # secondary rows folded into the SAME JSON line (driver contract:
+        # one line): the BASELINE.json north star is BERT-LARGE, and the
+        # second published metric is ResNet-50 img/s
+        try:
+            out.update(bench_bert_large(ceiling))
+        except Exception as e:
+            out["bert_large_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            out.update(bench_resnet50())
+        except Exception as e:
+            out["resnet50_error"] = f"{type(e).__name__}: {e}"[:200]
     if not on_tpu:
         out["error"] = "tpu backend unavailable; CPU smoke-mode number"
     return out
+
+
+def bench_bert_large(ceiling, batch=8, seq_len=512, masked=76, steps=8,
+                     warmup=2):
+    """BERT-large (24L/1024/16H), per-layer remat active (cfg default),
+    bf16 — the BASELINE.json north-star config."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.models import bert as bert_mod
+
+    n_dev = len(jax.devices())
+    parallel.make_mesh(dp=-1)
+    cfg = bert_mod.bert_large_config(dtype="bfloat16")
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "lamb",
+        {"learning_rate": 1e-3, "wd": 0.01})
+    b = bert_mod.make_synthetic_batch(cfg, batch, seq_len, masked, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k]) for k in
+              ("mlm_labels", "mlm_weights", "nsp_labels")]
+    for _ in range(warmup):
+        loss = trainer.step(data, labels)
+    float(loss.asscalar())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(data, labels)
+    float(loss.asscalar())
+    dt = time.perf_counter() - t0
+    per_chip = batch * seq_len * steps / dt / n_dev
+    flops_per_token = 6 * trainer.param_count
+    res = {"bert_large_tokens_per_sec_per_chip": round(per_chip, 2)}
+    if ceiling:
+        res["bert_large_achievable_mfu"] = round(
+            per_chip * flops_per_token / ceiling, 4)
+    print(f"# bert_large batch={batch} seq={seq_len} steps={steps} "
+          f"time={dt:.2f}s tok/s/chip={per_chip:.0f}", file=sys.stderr)
+    return res
+
+
+def bench_resnet50(batch=128, size=224, steps=10, warmup=3):
+    """ResNet-50 v1 train step, bf16, SGD+momentum (BASELINE.json second
+    published metric; full config in benchmarks/bench_resnet.py)."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.models import resnet as resnet_mod
+
+    n_dev = len(jax.devices())
+    parallel.make_mesh(dp=-1)
+    net = resnet_mod.resnet50_v1(classes=1000)
+    mx.random.seed(0)
+    net.initialize()
+    net.cast("bfloat16")
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.ShardedTrainer(
+        net, lambda out, label: lfn(out, label), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch, 3, size, size).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+    for _ in range(warmup):
+        loss = trainer.step([x], [y])
+    float(loss.asscalar())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step([x], [y])
+    float(loss.asscalar())
+    dt = time.perf_counter() - t0
+    per_chip = batch * steps / dt / n_dev
+    print(f"# resnet50 batch={batch} steps={steps} time={dt:.2f}s "
+          f"img/s/chip={per_chip:.0f}", file=sys.stderr)
+    return {"resnet50_images_per_sec_per_chip": round(per_chip, 2)}
 
 
 def main():

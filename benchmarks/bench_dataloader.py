@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Input-pipeline feed rate: can the host feed the TPU at training rate?
+
+Measures images/sec on a synthetic JPEG RecordIO file through the three
+feed paths and prints ONE JSON line:
+  * native    — C++ pipeline (`native/recordio_pipeline.cc`): decode +
+                crop/mirror + normalize + batch, thread pool + ring buffer
+  * python    — ImageRecordIter python fallback (threaded decode pool)
+  * dataloader— gluon DataLoader (thread workers) over a decoded-array
+                dataset with a python augmenter chain (the GIL-bound path
+                the VERDICT asked to measure)
+
+Interpretation lives in BASELINE.md: compare against the measured ResNet-50
+TPU step rate (img/s/chip) — the native path is the one that must keep up.
+"""
+import io as _io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def make_rec(tmp, n=512, h=256, w=256, seed=0):
+    from PIL import Image
+    from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack
+
+    rng = np.random.RandomState(seed)
+    prefix = os.path.join(tmp, "data")
+    rec = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, (h, w, 3), np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        rec.write_idx(i, pack(IRHeader(0, float(i % 10), i, 0),
+                              buf.getvalue()))
+    rec.close()
+    return prefix
+
+
+def time_iter(make, batch_size, min_images=600):
+    it = make()
+    n, t0 = 0, time.perf_counter()
+    while n < min_images:
+        try:
+            batch = next(iter([it.next()]))
+        except StopIteration:
+            it.reset()
+            continue
+        n += batch_size - batch.pad
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.io import ImageRecordIter
+
+    batch = 64
+    shape = (3, 224, 224)
+    out = {"metric": "input_pipeline_images_per_sec", "unit": "images/s"}
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = make_rec(tmp)
+
+        def native():
+            return ImageRecordIter(prefix + ".rec", shape, batch,
+                                   use_native=True, rand_crop=True,
+                                   rand_mirror=True, preprocess_threads=8)
+
+        def python_path():
+            return ImageRecordIter(prefix + ".rec", shape, batch,
+                                   use_native=False, rand_crop=True,
+                                   rand_mirror=True, preprocess_threads=8)
+
+        try:
+            out["native"] = round(time_iter(native, batch), 1)
+        except Exception as e:
+            out["native_error"] = f"{type(e).__name__}: {e}"[:200]
+        out["python"] = round(time_iter(python_path, batch), 1)
+
+        # gluon DataLoader: decoded uint8 arrays + python augmenter chain
+        from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+        from mxnet_tpu.gluon.data.vision import transforms as T
+
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, (512, 256, 256, 3), np.uint8)
+        labels = rng.randint(0, 10, (512,)).astype(np.float32)
+        from mxnet_tpu import nd
+
+        ds = ArrayDataset(imgs, labels)
+        tf = T.Compose([T.RandomResizedCrop(224), T.RandomFlipLeftRight(),
+                        T.ToTensor()])
+
+        def dl_rate(workers):
+            dl = DataLoader(ds.transform_first(lambda a: tf(nd.array(a))),
+                            batch_size=batch,
+                            num_workers=workers, shuffle=True)
+            n, t0 = 0, time.perf_counter()
+            while n < 256:
+                for x, y in dl:
+                    n += x.shape[0]
+                    if n >= 256:
+                        break
+            return round(n / (time.perf_counter() - t0), 1)
+
+        out["dataloader_w1"] = dl_rate(1)
+        out["dataloader_w8"] = dl_rate(8)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

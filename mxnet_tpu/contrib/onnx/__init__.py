@@ -1,11 +1,17 @@
 """ONNX export/import for Symbol graphs (reference:
 `python/mxnet/contrib/onnx/` mx2onnx + onnx2mx, ~10k LoC upstream).
 
-Subset scoped to the model_zoo vision family: Convolution, BatchNorm,
-Activation, Pooling (incl. global), FullyConnected, Flatten, elementwise
-add/mul, Concat, Dropout, softmax. Serialization is the in-tree wire
-codec (`_proto.py`) — the environment bakes no `onnx` package, but files
-written here follow the public ONNX IR (opset 13) byte for byte.
+Subset scoped to the model_zoo vision family PLUS the transformer-encoder
+op set: Convolution, BatchNorm, Activation (gelu decomposes to Erf),
+Pooling (incl. global), FullyConnected (flatten=False emits rank-generic
+MatMul, not 2-D-only Gemm), LayerNorm (decomposed at opset 13), Flatten,
+reshape/transpose/split/squeeze/expand_dims/slice_axis, batch_dot,
+elementwise add/sub/mul/div/pow (+ scalar forms), sqrt/erf/exp, Concat,
+Dropout, softmax. Multi-output (Group'd) graphs export/import. Still NOT
+covered: control flow, strided Slice, computed (non-initializer) shapes,
+RNN ops. Serialization is the in-tree wire codec (`_proto.py`) — the
+environment bakes no `onnx` package, but files written here follow the
+public ONNX IR (opset 13) byte for byte.
 
 API (mirrors mx.contrib.onnx):
     export_model(sym, params, input_shapes, onnx_file, input_dtype)
@@ -41,11 +47,21 @@ def _attr(attrs, key, default=None):
     return v
 
 
-def _export_node(node, in_names, out_name):
-    """One Symbol _Node -> list of NodeProto bytes."""
+def _export_node(node, in_names, out_names, consts):
+    """One Symbol _Node -> list of NodeProto bytes.
+
+    out_names: one ONNX value name per node output (Split emits several).
+    consts: list to append (name, np.ndarray) extra initializers — opset-13
+    ops take shapes/axes/scalars as tensor INPUTS, not attributes."""
     op = node.op
     a = node.attrs
     nm = node.name
+    out_name = out_names[0]
+
+    def const(tag, arr):
+        name = f"{nm}_{tag}"
+        consts.append((name, np.asarray(arr)))
+        return name
 
     def n1(op_type, attrs=None, inputs=None, outputs=None):
         return [P.node(op_type, inputs or in_names, outputs or [out_name],
@@ -65,15 +81,139 @@ def _export_node(node, in_names, out_name):
         return n1("BatchNormalization", attrs)
     if op == "Activation":
         act = _attr(a, "act_type", "relu")
-        # Gelu only exists from opset 20; exporting it under 13 would
-        # produce a file stock runtimes reject, so it fails loudly
         m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
              "softrelu": "Softplus"}
+        if act == "gelu":
+            # tanh-approximate gelu decomposed to opset-13 primitives (the
+            # Gelu op only exists from opset 20) — the SAME formulation the
+            # runtime computes (jax.nn.gelu approximate=True), so exported
+            # logits match bit-for-bit-ish:
+            # 0.5 * x * (1 + tanh(sqrt(2/pi) * (x + 0.044715 x^3)))
+            x = in_names[0]
+            xx, x3, cx3, inner, si, th, t1, xm = (
+                f"{nm}_{s}" for s in
+                ("xx", "x3", "cx3", "inner", "si", "tanh", "t1", "xm"))
+            return [
+                P.node("Mul", [x, x], [xx], name=f"{nm}_xx"),
+                P.node("Mul", [xx, x], [x3], name=f"{nm}_x3"),
+                P.node("Mul", [x3, const("c", np.float32(0.044715))], [cx3],
+                       name=f"{nm}_cx3"),
+                P.node("Add", [x, cx3], [inner], name=f"{nm}_inner"),
+                P.node("Mul", [inner, const("s2pi",
+                                            np.float32(np.sqrt(2.0 / np.pi)))],
+                       [si], name=f"{nm}_si"),
+                P.node("Tanh", [si], [th], name=f"{nm}_tanh"),
+                P.node("Add", [th, const("one", np.float32(1.0))], [t1],
+                       name=f"{nm}_t1"),
+                P.node("Mul", [x, t1], [xm], name=f"{nm}_xm"),
+                P.node("Mul", [xm, const("half", np.float32(0.5))],
+                       [out_name], name=nm),
+            ]
         if act not in m:
             raise NotImplementedError(
                 f"ONNX export: activation '{act}' not representable at "
                 "opset 13")
         return n1(m[act])
+    if op == "LayerNorm":
+        # x, gamma, beta -> decomposed normalization (LayerNormalization
+        # is opset 17; this file pins 13)
+        axis = int(_attr(a, "axis", -1))
+        eps = float(_attr(a, "eps", 1e-5))
+        x, gamma, beta = in_names[0], in_names[1], in_names[2]
+        mu, xc, sq, var, vare, std, xh, sc = (
+            f"{nm}_{s}" for s in
+            ("mu", "xc", "sq", "var", "vare", "std", "xhat", "scaled"))
+        return [
+            P.node("ReduceMean", [x], [mu], name=f"{nm}_mu",
+                   attrs={"axes": [axis], "keepdims": 1}),
+            P.node("Sub", [x, mu], [xc], name=f"{nm}_sub"),
+            P.node("Mul", [xc, xc], [sq], name=f"{nm}_sq"),
+            P.node("ReduceMean", [sq], [var], name=f"{nm}_var",
+                   attrs={"axes": [axis], "keepdims": 1}),
+            P.node("Add", [var, const("eps", np.float32(eps))], [vare],
+                   name=f"{nm}_vare"),
+            P.node("Sqrt", [vare], [std], name=f"{nm}_std"),
+            P.node("Div", [xc, std], [xh], name=f"{nm}_div"),
+            P.node("Mul", [xh, gamma], [sc], name=f"{nm}_gamma"),
+            P.node("Add", [sc, beta], [out_name], name=nm),
+        ]
+    if op in ("reshape", "Reshape"):
+        shape = [int(s) for s in _attr(a, "shape", ())]
+        bad = [s for s in shape if s < -1]
+        if bad:
+            raise NotImplementedError(
+                f"ONNX export: reshape codes {bad} unsupported (0 and -1 "
+                "share ONNX semantics; -2/-3/-4 do not)")
+        return n1("Reshape",
+                  inputs=[in_names[0], const("shape",
+                                             np.asarray(shape, np.int64))])
+    if op == "transpose":
+        axes = _attr(a, "axes", None)
+        if not axes:
+            raise NotImplementedError(
+                "ONNX export: transpose without explicit axes")
+        return n1("Transpose", {"perm": [int(x) for x in axes]})
+    if op == "batch_dot":
+        if _attr(a, "transpose_a", False) or _attr(a, "transpose_b", False):
+            raise NotImplementedError(
+                "ONNX export: batch_dot transpose flags unsupported — "
+                "insert an explicit transpose() instead")
+        return n1("MatMul")
+    if op in ("split", "SliceChannel"):
+        axis = int(_attr(a, "axis", 1))
+        if _attr(a, "squeeze_axis", False):
+            mids = [f"{o}_pre" for o in out_names]
+            nodes = [P.node("Split", in_names, mids, name=nm,
+                            attrs={"axis": axis})]
+            ax_c = const("sqz_axes", np.asarray([axis], np.int64))
+            nodes += [P.node("Squeeze", [mid, ax_c], [o],
+                             name=f"{nm}_sqz{i}")
+                      for i, (mid, o) in enumerate(zip(mids, out_names))]
+            return nodes
+        return [P.node("Split", in_names, list(out_names), name=nm,
+                       attrs={"axis": axis})]
+    if op == "expand_dims":
+        ax = int(_attr(a, "axis", 0))
+        return n1("Unsqueeze",
+                  inputs=[in_names[0],
+                          const("axes", np.asarray([ax], np.int64))])
+    if op == "squeeze":
+        ax = _attr(a, "axis", None)
+        if ax is None:
+            return n1("Squeeze")
+        axs = [int(ax)] if np.isscalar(ax) else [int(x) for x in ax]
+        return n1("Squeeze",
+                  inputs=[in_names[0],
+                          const("axes", np.asarray(axs, np.int64))])
+    if op == "slice_axis":
+        ax = int(_attr(a, "axis", 0))
+        begin = int(_attr(a, "begin", 0))
+        end = _attr(a, "end", None)
+        end = np.iinfo(np.int64).max if end in (None, "None") else int(end)
+        return n1("Slice",
+                  inputs=[in_names[0],
+                          const("starts", np.asarray([begin], np.int64)),
+                          const("ends", np.asarray([end], np.int64)),
+                          const("axes", np.asarray([ax], np.int64))])
+    if op == "sqrt":
+        return n1("Sqrt")
+    if op == "erf":
+        return n1("Erf")
+    if op == "exp":
+        return n1("Exp")
+    if op in ("_power", "broadcast_power"):
+        return n1("Pow")
+    if op in ("elemwise_sub", "broadcast_sub", "_sub"):
+        return n1("Sub")
+    if op in ("elemwise_div", "broadcast_div", "_div"):
+        return n1("Div")
+    if op in ("_plus_scalar", "_minus_scalar", "_mul_scalar", "_div_scalar",
+              "_power_scalar"):
+        onnx_op = {"_plus_scalar": "Add", "_minus_scalar": "Sub",
+                   "_mul_scalar": "Mul", "_div_scalar": "Div",
+                   "_power_scalar": "Pow"}[op]
+        s = const("scalar", np.float32(float(_attr(a, "scalar", 0.0))))
+        return n1(onnx_op, inputs=[in_names[0], s])
     if op == "LeakyReLU":
         return n1("LeakyRelu", {"alpha": float(_attr(a, "slope", 0.25))})
     if op == "Pooling":
@@ -104,10 +244,23 @@ def _export_node(node, in_names, out_name):
             nodes.append(P.node("Flatten", [data_in], [flat],
                                 name=f"{nm}_flatten", attrs={"axis": 1}))
             data_in = flat
-        gemm_in = [data_in, in_names[1]] + \
-            ([] if no_bias else [in_names[2]])
-        nodes.append(P.node("Gemm", gemm_in, [out_name], name=nm,
-                            attrs={"transB": 1, "alpha": 1.0, "beta": 1.0}))
+            gemm_in = [data_in, in_names[1]] + \
+                ([] if no_bias else [in_names[2]])
+            nodes.append(P.node("Gemm", gemm_in, [out_name], name=nm,
+                                attrs={"transB": 1, "alpha": 1.0,
+                                       "beta": 1.0}))
+            return nodes
+        # flatten=False keeps leading dims (transformer projections on
+        # (B, L, E)): Gemm is 2-D-only in ONNX, so emit
+        # MatMul(x, W^T) [+ bias]
+        wt = f"{nm}_wt"
+        nodes.append(P.node("Transpose", [in_names[1]], [wt],
+                            name=f"{nm}_transw", attrs={"perm": [1, 0]}))
+        mm_out = out_name if no_bias else f"{nm}_mm"
+        nodes.append(P.node("MatMul", [data_in, wt], [mm_out], name=nm))
+        if not no_bias:
+            nodes.append(P.node("Add", [mm_out, in_names[2]], [out_name],
+                                name=f"{nm}_bias"))
         return nodes
     if op in ("Flatten", "flatten"):
         return n1("Flatten", {"axis": 1})
@@ -130,23 +283,33 @@ def _export_node(node, in_names, out_name):
 
 def export_model(sym, params, input_shapes, onnx_file,
                  input_dtype="float32", opset=13):
-    """Write `sym` (single-output Symbol) + params to `onnx_file`.
+    """Write `sym` + params to `onnx_file`. Multi-output graphs (Group'd
+    heads, e.g. a YOLO head) export as multi-output ONNX graphs.
 
     params: dict name -> NDArray/ndarray covering every non-data argument
     and aux state. input_shapes: dict input_name -> shape (or a single
     shape for a single 'data' input)."""
     heads = sym._heads
-    if len(heads) != 1:
-        raise NotImplementedError("ONNX export: single-output graphs only")
     if not isinstance(input_shapes, dict):
         input_shapes = {"data": tuple(input_shapes)}
 
     def np_of(v):
         return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
 
+    # a node's output count = highest output index any consumer (or head)
+    # references
+    topo = list(sym._topo_nodes())
+    n_out = {id(n): 1 for n in topo}
+    for node in topo:
+        for src, idx in node.inputs:
+            n_out[id(src)] = max(n_out.get(id(src), 1), idx + 1)
+    for hn, hidx in heads:
+        n_out[id(hn)] = max(n_out.get(id(hn), 1), hidx + 1)
+
     nodes_b, initializers, seen_init = [], [], set()
+    consts = []                        # (name, np array) from decompositions
     name_of = {}                       # (_Node, out_idx) -> onnx value name
-    for node in sym._topo_nodes():
+    for node in topo:
         if node.is_var:
             if node.name in input_shapes:
                 name_of[(id(node), 0)] = node.name
@@ -165,22 +328,28 @@ def export_model(sym, params, input_shapes, onnx_file,
                 name_of[(id(node), 0)] = node.name
             continue
         in_names = [name_of[(id(src), idx)] for src, idx in node.inputs]
-        out_name = f"{node.name}_output"
-        nodes_b += _export_node(node, in_names, out_name)
-        name_of[(id(node), 0)] = out_name
+        outs = [f"{node.name}_output" if i == 0 else
+                f"{node.name}_output{i}" for i in range(n_out[id(node)])]
+        nodes_b += _export_node(node, in_names, outs, consts)
+        for i, o in enumerate(outs):
+            name_of[(id(node), i)] = o
 
-    head_node, head_idx = heads[0]
-    out_val = name_of[(id(head_node), head_idx if not head_node.is_var else 0)]
+    for cname, carr in consts:
+        if cname not in seen_init:
+            initializers.append(P.tensor(cname, carr))
+            seen_init.add(cname)
 
     dt = P.NP2ONNX[str(np.dtype(input_dtype))]
     inputs_vi = [P.value_info(n, dt, s) for n, s in input_shapes.items()]
-    # output shape via symbol shape inference
+    # output shapes via symbol shape inference
     try:
         _, out_shapes, _ = sym.infer_shape(**input_shapes)
-        out_shape = out_shapes[0]
     except Exception:
-        out_shape = ()
-    outputs_vi = [P.value_info(out_val, dt, out_shape)]
+        out_shapes = [() for _ in heads]
+    outputs_vi = []
+    for (hn, hidx), shape in zip(heads, out_shapes):
+        out_val = name_of[(id(hn), hidx if not hn.is_var else 0)]
+        outputs_vi.append(P.value_info(out_val, dt, shape))
     g = P.graph(nodes_b, "mxnet_tpu_graph", inputs_vi, outputs_vi,
                 initializers)
     data = P.model(g, opset=opset)
@@ -203,11 +372,21 @@ def _sym_pads(attrs, ndim, op):
     return begin
 
 
-def _import_node(n, sym_of, sym_mod):
+def _import_node(n, sym_of, sym_mod, inits):
+    """inits: initializer name -> np array, used to resolve opset-13
+    tensor-input constants (Reshape shapes, Slice starts, Squeeze axes,
+    scalar operands) into static attrs at import time."""
     op = n["op_type"]
     a = n["attrs"]
-    ins = [sym_of[i] for i in n["inputs"]]
+    # const-only inputs (shapes/axes/bounds) are not symbols: resolve those
+    # through const_in below; .get keeps their slots as None
+    ins = [sym_of.get(i) for i in n["inputs"]]
     name = n["name"] or None
+
+    def const_in(i):
+        """np value of input i if it is an initializer, else None."""
+        nm_ = n["inputs"][i] if i < len(n["inputs"]) else None
+        return inits.get(nm_) if nm_ is not None else None
 
     if op == "Conv":
         k = a["kernel_shape"]
@@ -247,17 +426,94 @@ def _import_node(n, sym_of, sym_mod):
             else "avg", stride=tuple(a.get("strides", [1] * len(k))),
             pad=pads, name=name, **kw)
     if op == "Gemm":
-        if a.get("transB", 0) != 1:
-            raise NotImplementedError("Gemm without transB=1")
+        if a.get("transA", 0):
+            raise NotImplementedError("Gemm with transA unsupported")
+        w = ins[1]
+        if not a.get("transB", 0):
+            # ONNX (I, O) weight -> FullyConnected's (O, I) layout
+            w = sym_mod.transpose(w, axes=(1, 0))
+        args = [ins[0], w] + ins[2:]
         return sym_mod.FullyConnected(
-            *ins, num_hidden=None, no_bias=len(ins) == 2, flatten=False,
+            *args, num_hidden=None, no_bias=len(ins) == 2, flatten=False,
             name=name)
+    if op == "MatMul":
+        return sym_mod.batch_dot(ins[0], ins[1])
     if op == "Flatten":
         return sym_mod.flatten(ins[0], name=name)
     if op == "Add":
         return ins[0] + ins[1]
     if op == "Mul":
         return ins[0] * ins[1]
+    if op == "Sub":
+        return ins[0] - ins[1]
+    if op == "Div":
+        return ins[0] / ins[1]
+    if op == "Pow":
+        return sym_mod.broadcast_power(ins[0], ins[1])
+    if op == "Sqrt":
+        return sym_mod.sqrt(ins[0], name=name)
+    if op == "Erf":
+        return sym_mod.erf(ins[0], name=name)
+    if op == "Exp":
+        return sym_mod.exp(ins[0], name=name)
+    if op == "ReduceMean":
+        axes = tuple(a.get("axes", ()))
+        return sym_mod.mean(ins[0], axis=axes or None,
+                            keepdims=bool(a.get("keepdims", 1)), name=name)
+    if op == "Transpose":
+        return sym_mod.transpose(ins[0], axes=tuple(a.get("perm", ())),
+                                 name=name)
+    if op == "Reshape":
+        shape = const_in(1)
+        if shape is None:
+            raise NotImplementedError(
+                "ONNX import: Reshape with a computed (non-initializer) "
+                "shape")
+        return sym_mod.reshape(ins[0], shape=tuple(int(s) for s in shape),
+                               name=name)
+    if op == "Split":
+        n_outs = len(n["outputs"])
+        sizes = a.get("split")
+        if sizes is not None and len(set(int(x) for x in sizes)) > 1:
+            raise NotImplementedError(
+                f"ONNX import: uneven Split sizes {list(sizes)} unsupported "
+                "(equal splits only)")
+        return sym_mod.split(ins[0], num_outputs=n_outs,
+                             axis=a.get("axis", 0), name=name)
+    if op in ("Squeeze", "Unsqueeze"):
+        axes = const_in(1)
+        if axes is None:
+            axes = a.get("axes")        # pre-13 attribute form
+        if axes is None and op == "Squeeze":
+            return sym_mod.squeeze(ins[0], name=name)
+        if axes is None:
+            raise NotImplementedError(f"ONNX import: {op} without axes")
+        axes = [int(x) for x in np.asarray(axes).ravel()]
+        out = ins[0]
+        if op == "Squeeze":
+            return sym_mod.squeeze(out, axis=tuple(axes), name=name)
+        for ax in sorted(axes):
+            out = sym_mod.expand_dims(out, axis=ax)
+        return out
+    if op == "Slice":
+        starts, ends = const_in(1), const_in(2)
+        axes = const_in(3)
+        if starts is None or ends is None:
+            raise NotImplementedError(
+                "ONNX import: Slice with computed starts/ends")
+        if const_in(4) is not None and any(
+                int(s) != 1 for s in np.asarray(const_in(4)).ravel()):
+            raise NotImplementedError("ONNX import: strided Slice")
+        starts = [int(x) for x in np.asarray(starts).ravel()]
+        ends = [int(x) for x in np.asarray(ends).ravel()]
+        axes = [int(x) for x in np.asarray(axes).ravel()] if axes is not None \
+            else list(range(len(starts)))
+        out = ins[0]
+        imax = np.iinfo(np.int64).max
+        for ax, b, e in zip(axes, starts, ends):
+            out = sym_mod.slice_axis(out, axis=ax, begin=b,
+                                     end=None if e >= imax else e)
+        return out
     if op == "Concat":
         return sym_mod.Concat(*ins, dim=a.get("axis", 1), name=name)
     if op == "Softmax":
@@ -284,22 +540,76 @@ def import_model(onnx_file):
         if n["op_type"] == "BatchNormalization":
             aux_names.update(n["inputs"][3:5])   # running mean, running var
 
+    # constants consumed as static attrs (Reshape shapes, Slice bounds,
+    # Squeeze axes) must not surface as model parameters; size-1 scalar
+    # operands of binary ops fold to python floats ONLY when every one of
+    # their uses is such an operand (a shared initializer feeding e.g. a
+    # Conv bias too must stay a real symbol) AND the name carries one of
+    # this exporter's const tags — a genuine (1,)-shaped learnable
+    # parameter must remain a parameter, not get baked in
+    consumed = set()
+    _SHAPE_INPUTS = {"Reshape": [1], "Squeeze": [1], "Unsqueeze": [1],
+                     "Slice": [1, 2, 3, 4]}
+    _CONST_TAGS = ("_scalar", "_one", "_half", "_eps", "_sqrt2", "_c",
+                   "_s2pi")
+    uses = {}
+    for n in g["nodes"]:
+        shape_slots = _SHAPE_INPUTS.get(n["op_type"], [])
+        for i, nm_ in enumerate(n["inputs"]):
+            if nm_ not in inits:
+                continue
+            if i in shape_slots:
+                kind = "shape"
+            elif n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow") and \
+                    np.asarray(inits[nm_]).size == 1:
+                kind = "scalar"
+            else:
+                kind = "other"
+            uses.setdefault(nm_, set()).add(kind)
+    for nm_, kinds in uses.items():
+        if kinds == {"shape"}:
+            consumed.add(nm_)
+        elif kinds == {"scalar"} and nm_.endswith(_CONST_TAGS):
+            consumed.add(nm_)
+
     sym_of = {}
     for vi in g["inputs"]:
         if vi["name"] not in inits:
             sym_of[vi["name"]] = sym_mod.var(vi["name"],
                                              shape=tuple(vi["shape"]) or None)
     for name in inits:
+        if name in consumed:
+            continue
         sym_of[name] = sym_mod.var(name, shape=inits[name].shape)
 
     out_sym = None
     for n in g["nodes"]:
-        s = _import_node(n, sym_of, sym_mod)
-        for o in n["outputs"]:
-            sym_of[o] = s
+        # scalar-constant operands of binary ops fold to python scalars so
+        # they import as `sym + 2.0`, not a bogus parameter
+        if n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow"):
+            vals = []
+            for nm_ in n["inputs"]:
+                if nm_ in consumed:
+                    vals.append(float(np.asarray(inits[nm_]).ravel()[0]))
+                else:
+                    vals.append(sym_of[nm_])
+            opf = {"Add": lambda x, y: x + y, "Sub": lambda x, y: x - y,
+                   "Mul": lambda x, y: x * y, "Div": lambda x, y: x / y,
+                   "Pow": lambda x, y: x ** y}[n["op_type"]]
+            s = opf(vals[0], vals[1])
+        else:
+            s = _import_node(n, sym_of, sym_mod, inits)
+        outs = n["outputs"]
+        if len(outs) == 1:
+            sym_of[outs[0]] = s
+        else:
+            for i, o in enumerate(outs):
+                sym_of[o] = s[i]
         out_sym = s
     if g["outputs"]:
-        out_sym = sym_of[g["outputs"][0]["name"]]
+        out_syms = [sym_of[o["name"]] for o in g["outputs"]]
+        out_sym = out_syms[0] if len(out_syms) == 1 \
+            else sym_mod.Group(out_syms)
 
     def to_nd(x):
         a = x
@@ -308,6 +618,6 @@ def import_model(onnx_file):
         return nd.array(a)
 
     arg_params = {k: to_nd(v) for k, v in inits.items()
-                  if k not in aux_names}
+                  if k not in aux_names and k not in consumed}
     aux_params = {k: to_nd(v) for k, v in inits.items() if k in aux_names}
     return out_sym, arg_params, aux_params

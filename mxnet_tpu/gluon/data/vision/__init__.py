@@ -1,6 +1,7 @@
 """Vision datasets + transforms (reference: `gluon/data/vision/`)."""
-from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, SyntheticGratings)
 from . import transforms
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "SyntheticGratings",
            "ImageRecordDataset", "transforms"]

@@ -17,7 +17,8 @@ import numpy as np
 from ..dataset import Dataset
 from ....ndarray import ndarray as _nd
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "SyntheticGratings"]
 
 logger = logging.getLogger("mxnet_tpu")
 
@@ -47,6 +48,57 @@ def _synthetic(shape, num_classes, n, seed):
     data = (rng.rand(n, *shape) * 255).astype(np.uint8)
     label = rng.randint(0, num_classes, size=n).astype(np.int32)
     return data, label
+
+
+class SyntheticGratings(Dataset):
+    """Deterministic LEARNABLE image classification set for zero-egress
+    convergence gates: class k is a sinusoidal grating with orientation
+    k*pi/C and frequency 3+(k mod 5), with per-instance random phase and
+    Gaussian noise, channels modulated by cos(k)/sin(k).
+
+    Published attainable accuracy (the falsifiable part): resnet18_v1
+    (classes=10, 32x32, batch 64, adam lr 2e-3) reaches >= 85% held-out
+    top-1 within 40 steps — pinned by
+    tests/train/test_quality_gates.py::test_resnet18_synthetic_gratings_gate.
+    Unlike random-label synthetic data (loss-trend-only gates), a model
+    with a broken gradient path, dead BN, or a silently dropped regularizer
+    FAILS this gate."""
+
+    def __init__(self, train=True, num_classes=10, size=32, n=None,
+                 noise=0.3, seed=None, transform=None):
+        n = n if n is not None else (512 if train else 256)
+        seed = seed if seed is not None else (0 if train else 1)
+        rng = np.random.RandomState(seed)
+        C = num_classes
+        y = rng.randint(0, C, n)
+        X = np.zeros((n, 3, size, size), np.float32)
+        yy, xx = np.mgrid[0:size, 0:size] / size
+        for i in range(n):
+            k = int(y[i])
+            theta = k * np.pi / C
+            freq = 3 + (k % 5)
+            phase = rng.uniform(0, 2 * np.pi)
+            g = np.sin(2 * np.pi * freq *
+                       (xx * np.cos(theta) + yy * np.sin(theta)) + phase)
+            X[i] = np.stack([g, g * np.cos(k), g * np.sin(k)]) + \
+                noise * rng.randn(3, size, size)
+        self._data = X.astype(np.float32)
+        self._label = y.astype(np.float32)
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        data, label = self._data[idx], self._label[idx]
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    @property
+    def arrays(self):
+        """(X (n,3,H,W) f32, y (n,) f32) — direct batch access for gates."""
+        return self._data, self._label
 
 
 class MNIST(_DownloadedDataset):

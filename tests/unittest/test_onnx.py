@@ -199,9 +199,11 @@ def test_softmax_output_exports(tmp_path):
     assert all("label" not in i for n in g["nodes"] for i in n["inputs"])
 
 
-def test_gelu_export_rejected(tmp_path):
+def test_unsupported_activation_export_rejected(tmp_path):
+    # gelu now decomposes to Erf (see the encoder round-trip); anything
+    # outside the mapped set must still fail loudly, not export garbage
     data = sym.var("data")
-    out = sym.Activation(data, act_type="gelu", name="g")
+    out = sym.Activation(data, act_type="softsign", name="g")
     with pytest.raises(NotImplementedError, match="opset"):
         onnx_mx.export_model(out, {}, {"data": (1, 4)},
                              str(tmp_path / "g.onnx"))
@@ -213,7 +215,7 @@ def test_asymmetric_pads_rejected(tmp_path):
             "inputs": ["x", "w"], "outputs": ["y"], "name": "c"}
     from mxnet_tpu.contrib.onnx import _import_node
     with pytest.raises(NotImplementedError, match="asymmetric"):
-        _import_node(node, {"x": sym.var("x"), "w": sym.var("w")}, sym)
+        _import_node(node, {"x": sym.var("x"), "w": sym.var("w")}, sym, {})
 
 
 def test_pool_defaults_and_ceil_mode_roundtrip(tmp_path):
@@ -286,3 +288,96 @@ def test_densenet_pattern_roundtrip(tmp_path):
     ref = _run(net, params, x)
     got = _run_imported(sym2, params2, x)
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def bert_encoder_symbol(B=2, L=8, units=16, heads=4):
+    """One BERT encoder block in symbol form: fused QKV, multi-head
+    attention (split/reshape/transpose/batch_dot/softmax), residual +
+    LayerNorm, gelu FFN — the transformer op set of the exporter."""
+    D = units // heads
+    x = sym.var("data", shape=(B, L, units))
+    qkv = sym.FullyConnected(x, num_hidden=3 * units, flatten=False,
+                             name="qkv")
+    qkv_s = sym.split(qkv, num_outputs=3, axis=2, name="qkv_split")
+    q, k, v = qkv_s[0], qkv_s[1], qkv_s[2]
+
+    def heads_of(t, name):
+        t = sym.reshape(t, shape=(B, L, heads, D), name=f"{name}_r")
+        return sym.transpose(t, axes=(0, 2, 1, 3), name=f"{name}_t")
+
+    qh, kh, vh = (heads_of(t, n) for t, n in
+                  zip((q, k, v), ("q", "k", "v")))
+    scores = sym.batch_dot(qh, sym.transpose(kh, axes=(0, 1, 3, 2),
+                                             name="kt")) * (1.0 / D ** 0.5)
+    probs = sym.softmax(scores, axis=-1, name="attn_probs")
+    ctx = sym.batch_dot(probs, vh)
+    ctx = sym.reshape(sym.transpose(ctx, axes=(0, 2, 1, 3), name="ctx_t"),
+                      shape=(B, L, units), name="ctx_r")
+    proj = sym.FullyConnected(ctx, num_hidden=units, flatten=False,
+                              name="proj")
+    h = sym.LayerNorm(x + proj, name="ln1")
+    ffn = sym.FullyConnected(h, num_hidden=2 * units, flatten=False,
+                             name="ffn_in")
+    ffn = sym.Activation(ffn, act_type="gelu", name="gelu")
+    ffn = sym.FullyConnected(ffn, num_hidden=units, flatten=False,
+                             name="ffn_out")
+    return sym.LayerNorm(h + ffn, name="ln2")
+
+
+def test_bert_encoder_roundtrip_logits(tmp_path):
+    shape = (2, 8, 16)
+    net = bert_encoder_symbol()
+    params = _init_params(net, shape)
+    f = str(tmp_path / "bert_enc.onnx")
+    onnx_mx.export_model(net, params, {"data": shape}, f)
+
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    params2 = dict(args2)
+    params2.update(aux2)
+
+    rs = np.random.RandomState(7)
+    x = rs.normal(size=shape).astype(np.float32)
+    ref = _run(net, params, x)
+    got = _run_imported(sym2, params2, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+
+
+def test_multi_output_roundtrip(tmp_path):
+    """YOLO-head pattern: one backbone, two detection branches, Group'd
+    multi-output graph round-trips with both logit sets matching."""
+    shape = (2, 3, 16, 16)
+    data = sym.var("data")
+    body = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                           no_bias=True, name="backbone")
+    body = sym.Activation(body, act_type="relu", name="backbone_relu")
+    big = sym.Convolution(body, kernel=(1, 1), num_filter=12, name="head_big")
+    small = sym.Convolution(sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                                        pool_type="max", name="down"),
+                            kernel=(1, 1), num_filter=12, name="head_small")
+    net = sym.Group([big, small])
+    params = _init_params(net, shape)
+    f = str(tmp_path / "multi.onnx")
+    onnx_mx.export_model(net, params, {"data": shape}, f)
+
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    assert len(sym2) == 2, "imported graph lost an output"
+    params2 = dict(args2)
+    params2.update(aux2)
+
+    rs = np.random.RandomState(3)
+    x = rs.normal(size=shape).astype(np.float32)
+
+    def run_all(net_, params_, imported):
+        ex = net_.simple_bind(ctx=mx.cpu(), data=x.shape)
+        for name, arr in ex.arg_dict.items():
+            if name != "data":
+                arr[:] = params_[name]
+        for name, arr in ex.aux_dict.items():
+            arr[:] = params_[name]
+        return [o.asnumpy() for o in ex.forward(is_train=False, data=x)]
+
+    ref = run_all(net, params, False)
+    got = run_all(sym2, params2, True)
+    assert len(ref) == len(got) == 2
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-6)
