@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Long-context BERT pretraining with the sequence axis sharded (ring
+attention over the `sp` mesh axis) — the SURVEY §5.7 north-star workload
+the reference cannot express.
+
+Two schedules:
+  * --pp 1 (default): ShardedTrainer with `data_specs` sharding the token
+    sequence over sp (ring attention inside the jitted step; composes
+    with dp/fsdp/tp)
+  * --pp S: SeqPipelineTrainer — homogeneous pipeline composing
+    pp x dp x sp in one SPMD program (encoder layer groups move across
+    the pp axis while ring attention's collectives run uniformly inside
+    the stage scan)
+
+8 virtual CPU devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python examples/bert/long_context.py --dp 2 --sp 2 --pp 2 \\
+      --seq-len 256 --steps 3
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import bert as bert_mod
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny", choices=["tiny", "long"])
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--sp", type=int, default=2)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--layers", type=int, default=2)
+    return p.parse_args()
+
+
+def main():
+    from jax.sharding import PartitionSpec as P
+
+    args = parse_args()
+    if args.config == "long":
+        cfg = bert_mod.bert_long_config()
+    else:
+        cfg = bert_mod.bert_tiny_config(
+            max_length=args.seq_len, num_layers=args.layers, dropout=0.0,
+            attn_dropout=0.0, seq_parallel=True)
+
+    if args.seq_len % args.sp:
+        raise SystemExit(f"--seq-len {args.seq_len} must be divisible by "
+                         f"--sp {args.sp}")
+    mb = 2  # num_microbatches of the pipeline schedule
+    if args.pp > 1 and args.batch_size % (args.dp * mb):
+        raise SystemExit(f"--batch-size {args.batch_size} must be divisible "
+                         f"by dp*microbatches = {args.dp * mb}")
+
+    if args.pp > 1:
+        if cfg["num_layers"] % args.pp:
+            raise SystemExit(f"--layers {cfg['num_layers']} must be "
+                             f"divisible by --pp {args.pp}")
+        parallel.make_mesh(
+            pp=args.pp, dp=args.dp, sp=args.sp,
+            devices=parallel.local_mesh_devices(
+                args.pp * args.dp * args.sp))
+        mx.random.seed(0)
+        embed = bert_mod.BERTEmbedStage(cfg)
+        per_stage = cfg["num_layers"] // args.pp
+        stages = []
+        for _ in range(args.pp):
+            from mxnet_tpu.gluon import nn
+            seq = nn.HybridSequential()
+            for _ in range(per_stage):
+                seq.add(bert_mod.BERTEncoderLayer(
+                    cfg["units"], cfg["hidden_size"], cfg["num_heads"],
+                    0.0, cfg["dtype"], attn_dropout=0.0, seq_parallel=True))
+            stages.append(seq)
+
+        from mxnet_tpu.gluon import HybridBlock, nn as gnn
+
+        class Head(HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.proj = gnn.Dense(cfg["vocab_size"],
+                                      in_units=cfg["units"], flatten=False,
+                                      weight_initializer="xavier")
+
+            def forward(self, x):
+                return self.proj(x)
+
+        head = Head()
+        for b in [embed] + stages + [head]:
+            b.initialize()
+
+        def lm_loss(logits, labels):
+            import jax
+            import jax.numpy as jnp
+            from mxnet_tpu.ndarray import apply_op
+
+            def f(lg, lb):
+                logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+                return -jnp.mean(jnp.take_along_axis(
+                    logp, lb.astype(jnp.int32)[..., None], -1))
+
+            return apply_op(f, logits, labels)
+
+        trainer = parallel.SeqPipelineTrainer(
+            embed, stages, head, lm_loss, "adam",
+            {"learning_rate": args.lr}, num_microbatches=mb,
+            data_specs=[P(("dp", "fsdp"), "sp")],
+            label_specs=[P(("dp", "fsdp"), "sp")])
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, cfg["vocab_size"],
+                           (args.batch_size, args.seq_len)).astype(np.int32)
+        labels = np.roll(toks, 1, axis=1).astype(np.int32)
+        for step in range(1, args.steps + 1):
+            t0 = time.time()
+            loss = trainer.step([nd.array(toks)], [nd.array(labels)])
+            print(f"step {step} loss {float(loss.asscalar()):.4f} "
+                  f"({time.time() - t0:.1f}s) "
+                  f"[pp={args.pp} dp={args.dp} sp={args.sp}]", flush=True)
+        return
+
+    parallel.make_mesh(
+        dp=args.dp, sp=args.sp,
+        devices=parallel.local_mesh_devices(args.dp * args.sp))
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    batch_axes = ("dp", "fsdp")
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "adam",
+        {"learning_rate": args.lr},
+        data_specs=[P(batch_axes, "sp"), P(batch_axes, "sp"),
+                    P(batch_axes), P(batch_axes)])
+    for step in range(1, args.steps + 1):
+        b = bert_mod.make_synthetic_batch(cfg, args.batch_size,
+                                          args.seq_len, num_masked=8,
+                                          seed=step)
+        data = [nd.array(b[k]) for k in
+                ("input_ids", "token_types", "valid_length",
+                 "masked_positions")]
+        labels = [nd.array(b[k]) for k in
+                  ("mlm_labels", "mlm_weights", "nsp_labels")]
+        t0 = time.time()
+        loss = trainer.step(data, labels)
+        print(f"step {step} loss {float(loss.asscalar()):.4f} "
+              f"({time.time() - t0:.1f}s) [dp={args.dp} sp={args.sp}]",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,12 @@ CASES = [
     ("bert/pretrain.py",
      ["--config", "tiny", "--batch-size", "8", "--seq-len", "32",
       "--steps", "3"], "step 3"),
+    ("bert/long_context.py",
+     ["--dp", "2", "--sp", "2", "--seq-len", "64", "--steps", "2"],
+     "step 2"),
+    ("bert/long_context.py",
+     ["--dp", "2", "--sp", "2", "--pp", "2", "--seq-len", "64",
+      "--steps", "2"], "step 2"),
     ("nmt/train_transformer.py",
      ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
       "--units", "32"], "decode token accuracy"),
