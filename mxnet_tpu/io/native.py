@@ -25,23 +25,62 @@ _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
 
+# Must match mxtpu_abi_version() in recordio_pipeline.cc.  A stale prebuilt
+# .so loads fine under ctypes and silently IGNORES trailing args added since
+# it was built (num_parts/part_index would read the full record set on every
+# worker — duplicated epochs, no error), so version skew must hard-fail.
+_ABI_VERSION = 2
+
 
 def _load():
     global _lib, _load_failed
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        # Always run make: mtime-aware, a cheap no-op when the .so is
+        # current, and the only thing that rebuilds a STALE prebuilt binary
+        # (os.path.exists alone let one load forever).  An fcntl lock
+        # serializes concurrent cold loads (launch.py workers): g++ links
+        # in place, so a peer must not dlopen a half-written .so.
+        try:
+            import fcntl
+            lock_f = open(os.path.join(_NATIVE_DIR, ".build.lock"), "w")
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+        except Exception:
+            lock_f = None
+        try:
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR],
+                subprocess.run(["make", "-C", _NATIVE_DIR, "libmxtpu_io.so"],
                                capture_output=True, check=True, timeout=120)
             except Exception:
+                if not os.path.exists(_SO_PATH):
+                    _load_failed = True
+                    return None
+                # no toolchain but a .so exists — the ABI check below decides
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError:
                 _load_failed = True
                 return None
+        finally:
+            if lock_f is not None:
+                lock_f.close()  # releases the flock
         try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError:
+            got = int(lib.mxtpu_abi_version())
+        except AttributeError:
+            got = 0  # pre-versioning binary: definitely stale
+        if got != _ABI_VERSION:
+            # set BEFORE warning: under -W error the warn raises, and the
+            # failure must stay cached (and available() must not explode)
             _load_failed = True
+            import warnings
+            try:
+                warnings.warn(
+                    "native/libmxtpu_io.so ABI v%d != expected v%d (stale "
+                    "build?); refusing to load — run `make -C native clean "
+                    "all`" % (got, _ABI_VERSION), RuntimeWarning)
+            except RuntimeWarning:
+                pass
             return None
         lib.mxtpu_pipe_create.restype = ctypes.c_void_p
         lib.mxtpu_pipe_create.argtypes = [

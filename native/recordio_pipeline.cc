@@ -388,6 +388,13 @@ void start_epoch(Pipeline* p) {
 
 extern "C" {
 
+// ABI version of this extern "C" surface. native.py refuses to load a .so
+// whose version differs from its own expectation — a stale prebuilt binary
+// otherwise accepts newer ctypes signatures and silently ignores trailing
+// args (e.g. the v2 num_parts/part_index sharding params).
+// v2 = num_parts/part_index tail on mxtpu_pipe_create.
+int mxtpu_abi_version() { return 2; }
+
 const char* mxtpu_last_error() { return g_error.c_str(); }
 
 void* mxtpu_pipe_create(const char* rec_path, const char* idx_path,

@@ -8,12 +8,19 @@ tests/nightly/dist_sync_kvstore.py)."""
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = ""                 # exactly 1 device per process
+# Env mutation ONLY when actually run as the worker process.  This module is
+# also imported by test_multiprocess.py (for make_batches); an import-time
+# os.environ["XLA_FLAGS"] = "" clobbered conftest's 8-device flag in the
+# pytest MAIN process and broke every later subprocess-spawning test that
+# needed >1 device (the round-4 red-suite root cause).
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = ""             # exactly 1 device per process
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))

@@ -50,6 +50,10 @@ def test_example_runs(script, args, expect):
     r = subprocess.run(
         [sys.executable, "-c", wrapper, path] + args,
         capture_output=True, text=True, timeout=900,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             # pinned explicitly: examples with --dp/--sp/--pp need the
+             # 8-device virtual mesh even if a sibling test polluted the
+             # inherited environment
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert r.returncode == 0, r.stderr[-2000:]
     assert expect in r.stdout, r.stdout[-2000:]

@@ -119,3 +119,43 @@ def test_npy_payload_falls_back_to_python(tmp_path):
     assert it._native is None  # sniffed non-JPEG payload
     b = it.next()
     assert b.data[0].shape == (2, 3, 24, 24)
+
+
+def test_stale_so_abi_version_refused(tmp_path):
+    """A prebuilt .so with the wrong (or missing) ABI version must be
+    refused, not silently loaded with ignored trailing args (the
+    num_parts/part_index silent-sharding-failure class)."""
+    import shutil
+    import subprocess
+    import sys
+    import textwrap
+
+    import shlex
+    cxx_env = shlex.split(os.environ.get("CXX", ""))  # CXX may be "ccache g++"
+    cxx = cxx_env or ([shutil.which("g++")] if shutil.which("g++")
+                      else [shutil.which("gcc")] if shutil.which("gcc") else None)
+    if cxx is None:
+        pytest.skip("no C/C++ compiler on PATH")
+    # .cc extension → compiled as C++ by both g++ and gcc, so extern "C"
+    src = tmp_path / "stale.cc"
+    src.write_text('extern "C" int mxtpu_abi_version(void) { return 1; }\n')
+    so = tmp_path / "libstale.so"
+    subprocess.run(cxx + ["-shared", "-fPIC", str(src), "-o", str(so)],
+                   check=True)
+    # fresh interpreter so the module-level load cache starts cold
+    code = textwrap.dedent(f"""
+        import warnings
+        import mxnet_tpu.io.native as native
+        native._SO_PATH = {str(so)!r}
+        native._NATIVE_DIR = {str(tmp_path)!r}   # make fails -> ABI check decides
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ok = native.available()
+        assert not ok, "stale ABI v1 .so was accepted"
+        assert any("ABI" in str(x.message) for x in w), [str(x.message) for x in w]
+        print("REFUSED_OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "REFUSED_OK" in r.stdout

@@ -8,7 +8,10 @@
 #                     changed area), ~2-4 min
 #   tools/check.sh  — pre-snapshot tier: FULL suite + dryrun + entry
 #
-# A red suite must never ship (VERDICT r2 #1).
+# A red suite must never ship (VERDICT r2 #1).  The fast tier is for
+# MID-ROUND commits only: every snapshot commit MUST be preceded by a green
+# FULL tier from a cold shell — round 4 shipped 2 red tests because the
+# final commit was fast-tier-gated only (VERDICT r4 weak #1).
 set -e
 cd "$(dirname "$0")/.."
 
