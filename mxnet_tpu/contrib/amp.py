@@ -3,12 +3,19 @@ op lists in `contrib/amp/lists/symbol.py`).
 
 TPU-native AMP is **bfloat16-first**: bf16 shares float32's exponent range,
 so the MXU runs at full rate without the float16 loss-scaling dance. The
-reference's three op lists survive as the cast policy:
+reference's op lists survive as the cast policy:
 
   * TARGET_OPS  — matmul/conv class ops, cast inputs to the target dtype
                   (these are the MXU FLOPs);
   * FP32_OPS    — reductions/normalizations/softmax, forced to float32;
+  * WIDEST_OPS  — mixed-operand elementwise ops run in the WIDEST floating
+                  dtype present (reference WIDEST_TYPE_CASTS);
+  * CONDITIONAL_FP32_OPS — f32 only for specific attr values (softrelu's
+                  exp-overflow class);
   * everything else — runs in whatever dtype arrives (XLA type-propagates).
+
+Lists are user-extensible: `move_op(name, 'target'|'fp32'|'widest'|None)`
+works before or after `init()` (an active policy re-wraps in place).
 
 `init()` wraps the op registry once; dynamic loss scaling (`scale_loss`,
 `LossScaler`) is provided for float16 parity and defaults to a constant
@@ -24,7 +31,8 @@ import numpy as np
 from .. import ops as _ops
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale", "LossScaler",
-           "convert_hybrid_block", "list_target_ops", "list_fp32_ops"]
+           "convert_hybrid_block", "list_target_ops", "list_fp32_ops",
+           "list_widest_ops", "move_op"]
 
 # The MXU-bound ops (reference: FP16_FUNCS — ops whitelisted to run in
 # reduced precision because they are tensor-core/MXU friendly).
@@ -44,6 +52,24 @@ FP32_OPS = [
     "nansum", "nanprod",
 ]
 
+# Mixed-operand elementwise ops run in the WIDEST floating dtype among
+# their inputs (reference: WIDEST_TYPE_CASTS in contrib/amp/lists/
+# symbol.py) — a bf16 activation meeting an f32 residual must not silently
+# truncate the f32 side.
+WIDEST_OPS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "add_n", "maximum", "minimum", "broadcast_maximum",
+    "broadcast_minimum", "where", "concat", "Concat", "stack",
+]
+
+# fp32 only under specific attr values (reference: CONDITIONAL_FP32_FUNCS):
+# (op, attr, [values]) — e.g. softrelu overflows exp() in half precision.
+CONDITIONAL_FP32_OPS = [
+    ("Activation", "act_type", ["softrelu"]),
+    ("LeakyReLU", "act_type", ["elu", "selu"]),
+]
+
 _initialized = False
 _target_dtype = None
 
@@ -54,6 +80,41 @@ def list_target_ops():
 
 def list_fp32_ops():
     return list(FP32_OPS)
+
+
+def list_widest_ops():
+    return list(WIDEST_OPS)
+
+
+def move_op(name, to):
+    """Move `name` between cast lists: to in ('target', 'fp32', 'widest',
+    None) — None removes it from every list (runs in arriving dtype).
+    Callable before OR after init(); an active policy re-wraps in place.
+    (Reference workflow: users edit amp/lists/symbol.py's lists before
+    amp.init; this is the supported in-process form.)"""
+    if to not in ("target", "fp32", "widest", None):
+        raise ValueError(f"unknown amp list {to!r}")
+    for lst in (TARGET_OPS, FP32_OPS, WIDEST_OPS):
+        if name in lst:
+            lst.remove(name)
+    dest = {"target": TARGET_OPS, "fp32": FP32_OPS,
+            "widest": WIDEST_OPS}.get(to)
+    if dest is not None:
+        dest.append(name)
+    if _initialized and name in _ops.OPS:
+        fn = _ops.OPS[name]
+        orig = getattr(fn, "_amp_original", fn)
+        _ops.OPS[name] = _rewrap(orig, to)
+
+
+def _rewrap(orig, to):
+    if to == "target":
+        return _wrap(orig, _target_dtype)
+    if to == "fp32":
+        return _wrap(orig, jnp.float32)
+    if to == "widest":
+        return _wrap_widest(orig)
+    return orig
 
 
 def _cast_args(args, dtype):
@@ -84,6 +145,47 @@ def _wrap(fn, dtype, restore_dtype=None):
     return wrapped
 
 
+def _wrap_widest(fn):
+    """Cast every floating arg to the widest floating dtype present."""
+    def wrapped(*args, **kwargs):
+        fl = [jnp.asarray(a).dtype for a in args
+              if hasattr(a, "dtype")
+              and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)]
+        if fl:
+            widest = fl[0]
+            for d in fl[1:]:
+                widest = jnp.promote_types(widest, d)
+            args = _cast_args(args, widest)
+        return fn(*args, **kwargs)
+    wrapped.op_name = getattr(fn, "op_name", None)
+    wrapped._amp_original = fn
+    return wrapped
+
+
+def _wrap_conditional(fn, attr, values):
+    """f32 when the `attr` argument matches one of `values` — bound
+    through the op's real signature so a POSITIONAL act_type counts too."""
+    import inspect
+    try:
+        sig = inspect.signature(getattr(fn, "_amp_original", fn))
+    except (TypeError, ValueError):
+        sig = None
+
+    def wrapped(*args, **kwargs):
+        val = kwargs.get(attr)
+        if val is None and sig is not None:
+            try:
+                val = sig.bind_partial(*args, **kwargs).arguments.get(attr)
+            except TypeError:
+                pass
+        if str(val) in values:
+            return fn(*_cast_args(args, jnp.float32), **kwargs)
+        return fn(*args, **kwargs)
+    wrapped.op_name = getattr(fn, "op_name", None)
+    wrapped._amp_original = fn
+    return wrapped
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          fp32_ops=None, conditional_fp32_ops=None):
     """Install the mixed-precision cast policy over the op registry
@@ -102,6 +204,14 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
     for name in (fp32_ops or FP32_OPS):
         if name in _ops.OPS:
             _ops.OPS[name] = _wrap(_ops.OPS[name], jnp.float32)
+    for name in WIDEST_OPS:
+        if name in _ops.OPS:
+            _ops.OPS[name] = _wrap_widest(_ops.OPS[name])
+    for entry in (conditional_fp32_ops or CONDITIONAL_FP32_OPS):
+        name, attr, values = entry
+        if name in _ops.OPS:
+            _ops.OPS[name] = _wrap_conditional(
+                _ops.OPS[name], attr, [str(v) for v in values])
     _initialized = True
 
 

@@ -5,11 +5,17 @@ Subset scoped to the model_zoo vision family PLUS the transformer-encoder
 op set: Convolution, BatchNorm, Activation (gelu decomposes to Erf),
 Pooling (incl. global), FullyConnected (flatten=False emits rank-generic
 MatMul, not 2-D-only Gemm), LayerNorm (decomposed at opset 13), Flatten,
-reshape/transpose/split/squeeze/expand_dims/slice_axis, batch_dot,
-elementwise add/sub/mul/div/pow (+ scalar forms), sqrt/erf/exp, Concat,
-Dropout, softmax. Multi-output (Group'd) graphs export/import. Still NOT
-covered: control flow, strided Slice, computed (non-initializer) shapes,
-RNN ops. Serialization is the in-tree wire codec (`_proto.py`) — the
+reshape/transpose/split/squeeze/expand_dims/slice_axis, STRIDED slice
+(negative steps included), batch_dot, elementwise add/sub/mul/div/pow
+(+ scalar forms), sqrt/erf/exp, Concat, Dropout, softmax, and RNN:
+LSTM/GRU export+import with the flat cuDNN vector re-laid-out to ONNX
+W/R/B (gate reorder, per-layer nodes). Import constant-propagates
+Shape/Gather/Concat/Cast/arith chains (the PyTorch-exporter flatten
+idiom) at the graph's static input shapes. Multi-output (Group'd) graphs
+export/import. Still NOT covered: control flow (Loop/If), bidirectional
+or vanilla-activation RNN, GRU with linear_before_reset=0, genuinely
+dynamic shapes (a Shape chain that static inference cannot resolve
+raises). Serialization is the in-tree wire codec (`_proto.py`) — the
 environment bakes no `onnx` package, but files written here follow the
 public ONNX IR (opset 13) byte for byte.
 
@@ -47,12 +53,62 @@ def _attr(attrs, key, default=None):
     return v
 
 
-def _export_node(node, in_names, out_names, consts):
+# RNN weight re-layout (reference: the mx2onnx RNN converters in upstream
+# python/mxnet/contrib/onnx/mx2onnx/_op_translations.py).  This build's RNN
+# op packs a flat cuDNN-ordered vector (ops/rnn_ops.py:unpack_rnn_params):
+# per layer wi then wh (gate-major), then ALL biases (bi, bh per layer).
+# Gate orders:  ours LSTM [i,f,g,o] / ONNX [i,o,f,c];  ours GRU [r,z,n]
+# (linear_before_reset=1 semantics) / ONNX [z,r,h].
+_LSTM_TO_ONNX = [0, 3, 1, 2]     # rows of ours -> ONNX order
+_LSTM_FROM_ONNX = [0, 2, 3, 1]
+_GRU_TO_ONNX = [1, 0, 2]
+_GRU_FROM_ONNX = [1, 0, 2]
+
+
+def _gate_reorder(mat, order, H):
+    """Reorder the gate-major leading axis of a (G*H, ...) or (G*H,) array."""
+    g = len(order)
+    blocks = mat.reshape((g, H) + mat.shape[1:])
+    return blocks[order].reshape(mat.shape)
+
+
+def _rnn_unpack_np(flat, ngates, num_layers, input_size, state_size):
+    """numpy mirror of ops.rnn_ops.unpack_rnn_params (unidirectional)."""
+    H, out, off = state_size, [], 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H
+        wi = flat[off:off + ngates * H * isz].reshape(ngates * H, isz)
+        off += ngates * H * isz
+        wh = flat[off:off + ngates * H * H].reshape(ngates * H, H)
+        off += ngates * H * H
+        out.append({"wi": wi, "wh": wh})
+    for layer in range(num_layers):
+        out[layer]["bi"] = flat[off:off + ngates * H]
+        off += ngates * H
+        out[layer]["bh"] = flat[off:off + ngates * H]
+        off += ngates * H
+    if off != flat.size:
+        raise ValueError(f"RNN flat param size {flat.size} != expected {off}")
+    return out
+
+
+def _rnn_pack_np(layers, ngates, state_size):
+    """Inverse of _rnn_unpack_np: per-layer dicts -> flat cuDNN vector."""
+    parts = [np.concatenate([l["wi"].ravel(), l["wh"].ravel()])
+             for l in layers]
+    parts += [np.concatenate([l["bi"].ravel(), l["bh"].ravel()])
+              for l in layers]
+    return np.concatenate(parts).astype(np.float32)
+
+
+def _export_node(node, in_names, out_names, consts, param_values=None):
     """One Symbol _Node -> list of NodeProto bytes.
 
     out_names: one ONNX value name per node output (Split emits several).
     consts: list to append (name, np.ndarray) extra initializers — opset-13
-    ops take shapes/axes/scalars as tensor INPUTS, not attributes."""
+    ops take shapes/axes/scalars as tensor INPUTS, not attributes.
+    param_values: name -> np array of the model params — needed by ops whose
+    ONNX form re-lays-out weights (RNN's flat cuDNN vector)."""
     op = node.op
     a = node.attrs
     nm = node.name
@@ -161,16 +217,23 @@ def _export_node(node, in_names, out_names, consts):
         return n1("MatMul")
     if op in ("split", "SliceChannel"):
         axis = int(_attr(a, "axis", 1))
+        # output count from the node's OWN num_outputs attr, never from how
+        # many outputs consumers reference: a split with an unused trailing
+        # output would otherwise export fewer (therefore LARGER) pieces —
+        # silently wrong shapes in stock runtimes
+        k = int(_attr(a, "num_outputs", len(out_names)))
+        outs = list(out_names) + [f"{nm}_unused{i}"
+                                  for i in range(len(out_names), k)]
         if _attr(a, "squeeze_axis", False):
-            mids = [f"{o}_pre" for o in out_names]
+            mids = [f"{o}_pre" for o in outs]
             nodes = [P.node("Split", in_names, mids, name=nm,
                             attrs={"axis": axis})]
             ax_c = const("sqz_axes", np.asarray([axis], np.int64))
             nodes += [P.node("Squeeze", [mid, ax_c], [o],
                              name=f"{nm}_sqz{i}")
-                      for i, (mid, o) in enumerate(zip(mids, out_names))]
+                      for i, (mid, o) in enumerate(zip(mids, outs))]
             return nodes
-        return [P.node("Split", in_names, list(out_names), name=nm,
+        return [P.node("Split", in_names, outs, name=nm,
                        attrs={"axis": axis})]
     if op == "expand_dims":
         ax = int(_attr(a, "axis", 0))
@@ -195,6 +258,31 @@ def _export_node(node, in_names, out_names, consts):
                           const("starts", np.asarray([begin], np.int64)),
                           const("ends", np.asarray([end], np.int64)),
                           const("axes", np.asarray([ax], np.int64))])
+    if op == "slice":
+        # general (possibly STRIDED / negative-step) slice: begin/end/step
+        # tuples over the leading axes, None = "whole extent in step
+        # direction" — ONNX Slice encodes that as INT64_MAX/MIN sentinels
+        begin = _attr(a, "begin", ())
+        end = _attr(a, "end", ())
+        step = _attr(a, "step", None) or [None] * len(begin)
+        IMAX, IMIN = np.iinfo(np.int64).max, np.iinfo(np.int64).min
+        starts, ends, steps = [], [], []
+        for b, e, s in zip(begin, end, step):
+            s = 1 if s in (None, "None") else int(s)
+            if s == 0:
+                raise ValueError("slice step 0")
+            starts.append((0 if s > 0 else IMAX) if b in (None, "None")
+                          else int(b))
+            ends.append((IMAX if s > 0 else IMIN) if e in (None, "None")
+                        else int(e))
+            steps.append(s)
+        axes = list(range(len(starts)))
+        return n1("Slice",
+                  inputs=[in_names[0],
+                          const("starts", np.asarray(starts, np.int64)),
+                          const("ends", np.asarray(ends, np.int64)),
+                          const("axes", np.asarray(axes, np.int64)),
+                          const("steps", np.asarray(steps, np.int64))])
     if op == "sqrt":
         return n1("Sqrt")
     if op == "erf":
@@ -277,8 +365,111 @@ def _export_node(node, in_names, out_names, consts):
         return n1("Softmax", {"axis": -1}, inputs=[in_names[0]])
     if op == "Dropout":
         return n1("Dropout", inputs=[in_names[0]])
+    if op == "RNN":
+        return _export_rnn(node, in_names, out_names, consts, param_values)
     raise NotImplementedError(f"ONNX export: op '{op}' not in the "
                               "supported subset")
+
+
+def _export_rnn(node, in_names, out_names, consts, param_values):
+    """RNN (lstm/gru, unidirectional) -> one ONNX LSTM/GRU node per layer.
+
+    The flat cuDNN parameter vector is split per layer and gate-reordered
+    into ONNX W/R/B initializers; the original flat initializer becomes
+    unreferenced and is dropped by export_model's reachability filter.
+    Initial states must be all-zeros initializers (omitted on the ONNX
+    side, where absent means zero) or explicit nonzero initializers."""
+    a, nm = node.attrs, node.name
+    mode = _attr(a, "mode", "lstm")
+    if mode not in ("lstm", "gru"):
+        raise NotImplementedError(
+            f"ONNX export: RNN mode '{mode}' (vanilla) has no opset-13 "
+            "node with matching semantics — use lstm/gru")
+    if _attr(a, "bidirectional", False):
+        raise NotImplementedError(
+            "ONNX export: bidirectional RNN unsupported (unidirectional "
+            "only)")
+    H = int(_attr(a, "state_size"))
+    L = int(_attr(a, "num_layers", 1))
+    ngates = 4 if mode == "lstm" else 3
+    if param_values is None or in_names[1] not in param_values:
+        raise NotImplementedError(
+            "ONNX export: RNN requires its parameter vector as an "
+            "initializer (got a computed input)")
+    flat = np.asarray(param_values[in_names[1]], np.float32).ravel()
+    # solve the input size from the flat length (layer 0 is the only one
+    # whose input dim differs)
+    rest = (L - 1) * ngates * H * (2 * H + 2)
+    I = (flat.size - rest) // (ngates * H) - H - 2
+    layers = _rnn_unpack_np(flat, ngates, L, I, H)
+
+    order = _LSTM_TO_ONNX if mode == "lstm" else _GRU_TO_ONNX
+    onnx_op = "LSTM" if mode == "lstm" else "GRU"
+
+    def state_value(idx):
+        """(L, N, H) initial-state array or None when all zeros/absent."""
+        if idx >= len(in_names):
+            return None
+        name = in_names[idx]
+        v = param_values.get(name)
+        if v is None:
+            raise NotImplementedError(
+                "ONNX export: RNN initial state must be an initializer "
+                f"(got computed input '{name}')")
+        v = np.asarray(v)
+        return None if not v.any() else v
+
+    h0 = state_value(2)
+    c0 = state_value(3) if mode == "lstm" else None
+
+    def const(tag, arr):
+        name = f"{nm}_{tag}"
+        consts.append((name, np.asarray(arr)))
+        return name
+
+    nodes, x = [], in_names[0]
+    h_outs, c_outs = [], []
+    for l, ly in enumerate(layers):
+        W = const(f"W{l}", _gate_reorder(ly["wi"], order, H)[None])
+        R = const(f"R{l}", _gate_reorder(ly["wh"], order, H)[None])
+        B = const(f"B{l}", np.concatenate(
+            [_gate_reorder(ly["bi"], order, H),
+             _gate_reorder(ly["bh"], order, H)])[None])
+        ins = [x, W, R, B]
+        if h0 is not None or c0 is not None:
+            # state arrays are (L, N, H); ONNX wants (1, N, H) per node.
+            # When only one of h0/c0 is nonzero the other is explicit zeros.
+            N = (h0 if h0 is not None else c0).shape[1]
+            zeros = np.zeros((1, N, H), np.float32)
+            ins.append("")                      # sequence_lens: absent
+            ins.append(const(f"h0_{l}",
+                             h0[l][None] if h0 is not None else zeros))
+            if mode == "lstm":
+                ins.append(const(f"c0_{l}",
+                                 c0[l][None] if c0 is not None else zeros))
+        y, yh, yc = f"{nm}_l{l}_Y", f"{nm}_l{l}_Yh", f"{nm}_l{l}_Yc"
+        attrs = {"hidden_size": H}
+        if mode == "gru":
+            attrs["linear_before_reset"] = 1    # our GRU cell's semantics
+        nodes.append(P.node(onnx_op, ins, [y, yh] +
+                            ([yc] if mode == "lstm" else []),
+                            name=f"{nm}_l{l}", attrs=attrs))
+        h_outs.append(yh)
+        c_outs.append(yc)
+        # Y is (T, dirs=1, N, H): squeeze the direction axis for the next
+        # layer / the final output
+        sq = out_names[0] if l == L - 1 else f"{nm}_l{l}_sq"
+        nodes.append(P.node("Squeeze",
+                            [y, const(f"sqax{l}", np.asarray([1], np.int64))],
+                            [sq], name=f"{nm}_l{l}_squeeze"))
+        x = sq
+    if len(out_names) > 1:                       # state_outputs=True
+        nodes.append(P.node("Concat", h_outs, [out_names[1]],
+                            name=f"{nm}_hn", attrs={"axis": 0}))
+        if mode == "lstm" and len(out_names) > 2:
+            nodes.append(P.node("Concat", c_outs, [out_names[2]],
+                                name=f"{nm}_cn", attrs={"axis": 0}))
+    return nodes
 
 
 def export_model(sym, params, input_shapes, onnx_file,
@@ -306,9 +497,11 @@ def export_model(sym, params, input_shapes, onnx_file,
     for hn, hidx in heads:
         n_out[id(hn)] = max(n_out.get(id(hn), 1), hidx + 1)
 
-    nodes_b, initializers, seen_init = [], [], set()
+    param_np = {k: np_of(v) for k, v in params.items()}
+    nodes_b, init_arrays, seen_init = [], {}, set()
     consts = []                        # (name, np array) from decompositions
     name_of = {}                       # (_Node, out_idx) -> onnx value name
+    referenced = set()                 # value names consumed by some node
     for node in topo:
         if node.is_var:
             if node.name in input_shapes:
@@ -322,22 +515,34 @@ def export_model(sym, params, input_shapes, onnx_file,
                     raise ValueError(
                         f"ONNX export: no value for argument '{node.name}'")
                 if node.name not in seen_init:
-                    initializers.append(P.tensor(node.name,
-                                                 np_of(params[node.name])))
+                    init_arrays[node.name] = param_np[node.name]
                     seen_init.add(node.name)
                 name_of[(id(node), 0)] = node.name
             continue
         in_names = [name_of[(id(src), idx)] for src, idx in node.inputs]
         outs = [f"{node.name}_output" if i == 0 else
                 f"{node.name}_output{i}" for i in range(n_out[id(node)])]
-        nodes_b += _export_node(node, in_names, outs, consts)
+        for nb in _export_node(node, in_names, outs, consts,
+                               param_values=param_np):
+            nodes_b.append(nb)
+            referenced.update(P.node_input_names(nb))
         for i, o in enumerate(outs):
             name_of[(id(node), i)] = o
 
+    const_names = []
     for cname, carr in consts:
         if cname not in seen_init:
-            initializers.append(P.tensor(cname, carr))
+            init_arrays[cname] = np.asarray(carr)
             seen_init.add(cname)
+            const_names.append(cname)
+
+    # drop initializers no emitted node consumes (e.g. an RNN flat
+    # parameter vector replaced by per-layer W/R/B re-layouts)
+    out_value_names = set()
+    for hn, hidx in heads:
+        out_value_names.add(name_of[(id(hn), hidx if not hn.is_var else 0)])
+    initializers = [P.tensor(k, v) for k, v in init_arrays.items()
+                    if k in referenced or k in out_value_names]
 
     dt = P.NP2ONNX[str(np.dtype(input_dtype))]
     inputs_vi = [P.value_info(n, dt, s) for n, s in input_shapes.items()]
@@ -352,7 +557,12 @@ def export_model(sym, params, input_shapes, onnx_file,
         outputs_vi.append(P.value_info(out_val, dt, shape))
     g = P.graph(nodes_b, "mxnet_tpu_graph", inputs_vi, outputs_vi,
                 initializers)
-    data = P.model(g, opset=opset)
+    # record which initializers are decomposition constants so the importer
+    # folds EXACTLY these (never a real parameter that happens to share a
+    # name suffix) — written even when EMPTY: the key's presence is what
+    # tells the importer to trust it over the legacy suffix heuristic
+    meta = {"mxnet_tpu_consts": "\n".join(const_names)}
+    data = P.model(g, opset=opset, metadata=meta)
     with open(onnx_file, "wb") as f:
         f.write(data)
     return onnx_file
@@ -372,10 +582,16 @@ def _sym_pads(attrs, ndim, op):
     return begin
 
 
-def _import_node(n, sym_of, sym_mod, inits):
+def _import_node(n, sym_of, sym_mod, inits, ctx=None):
     """inits: initializer name -> np array, used to resolve opset-13
     tensor-input constants (Reshape shapes, Slice starts, Squeeze axes,
-    scalar operands) into static attrs at import time."""
+    scalar operands) into static attrs at import time.
+
+    ctx (optional): import-wide state — 'known' (constant-propagated
+    values, e.g. Shape→Gather→Concat chains), 'extra_params' (synthesized
+    initializers such as repacked RNN vectors), 'folded_inits'
+    (initializers consumed structurally, excluded from arg_params),
+    'static_shape' (Symbol -> static shape via infer_shape)."""
     op = n["op_type"]
     a = n["attrs"]
     # const-only inputs (shapes/axes/bounds) are not symbols: resolve those
@@ -384,9 +600,15 @@ def _import_node(n, sym_of, sym_mod, inits):
     name = n["name"] or None
 
     def const_in(i):
-        """np value of input i if it is an initializer, else None."""
+        """np value of input i if it is an initializer or a constant-
+        propagated value, else None."""
         nm_ = n["inputs"][i] if i < len(n["inputs"]) else None
-        return inits.get(nm_) if nm_ is not None else None
+        if nm_ is None:
+            return None
+        v = inits.get(nm_)
+        if v is None and ctx is not None:
+            v = ctx["known"].get(nm_)
+        return v
 
     if op == "Conv":
         k = a["kernel_shape"]
@@ -497,31 +719,136 @@ def _import_node(n, sym_of, sym_mod, inits):
         return out
     if op == "Slice":
         starts, ends = const_in(1), const_in(2)
-        axes = const_in(3)
+        axes, steps = const_in(3), const_in(4)
         if starts is None or ends is None:
             raise NotImplementedError(
                 "ONNX import: Slice with computed starts/ends")
-        if const_in(4) is not None and any(
-                int(s) != 1 for s in np.asarray(const_in(4)).ravel()):
-            raise NotImplementedError("ONNX import: strided Slice")
         starts = [int(x) for x in np.asarray(starts).ravel()]
         ends = [int(x) for x in np.asarray(ends).ravel()]
         axes = [int(x) for x in np.asarray(axes).ravel()] if axes is not None \
             else list(range(len(starts)))
-        out = ins[0]
-        imax = np.iinfo(np.int64).max
-        for ax, b, e in zip(axes, starts, ends):
-            out = sym_mod.slice_axis(out, axis=ax, begin=b,
-                                     end=None if e >= imax else e)
-        return out
+        steps = [int(x) for x in np.asarray(steps).ravel()] \
+            if steps is not None else [1] * len(starts)
+        imax, imin = np.iinfo(np.int64).max, np.iinfo(np.int64).min
+        if all(s == 1 for s in steps):
+            out = ins[0]
+            for ax, b, e in zip(axes, starts, ends):
+                out = sym_mod.slice_axis(out, axis=ax, begin=b,
+                                         end=None if e >= imax else e)
+            return out
+        # STRIDED slice: the general `slice` op takes begin/end/step tuples
+        # over axes 0..max(axes); INT64 sentinels map back to None
+        if any(ax < 0 for ax in axes):
+            raise NotImplementedError(
+                "ONNX import: strided Slice with negative axes")
+        rank = max(axes) + 1
+        begin = [None] * rank
+        end_t = [None] * rank
+        step_t = [None] * rank
+        for ax, b, e, s in zip(axes, starts, ends, steps):
+            begin[ax] = None if (s > 0 and b == 0) or \
+                (s < 0 and b >= imax) else b
+            end_t[ax] = None if (s > 0 and e >= imax) or \
+                (s < 0 and e <= imin + 1) else e
+            step_t[ax] = s
+        return sym_mod.slice(ins[0], begin=tuple(begin), end=tuple(end_t),
+                             step=tuple(step_t), name=name)
     if op == "Concat":
         return sym_mod.Concat(*ins, dim=a.get("axis", 1), name=name)
     if op == "Softmax":
         return sym_mod.softmax(ins[0], axis=a.get("axis", -1), name=name)
     if op == "Dropout":
         return ins[0]
+    if op in ("LSTM", "GRU"):
+        return _import_rnn(n, ins, sym_mod, const_in, ctx, name)
     raise NotImplementedError(f"ONNX import: op '{op}' not in the "
                               "supported subset")
+
+
+def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
+    """One ONNX LSTM/GRU node -> sym.RNN with a repacked flat cuDNN
+    parameter vector (inverse of _export_rnn's re-layout)."""
+    op, a = n["op_type"], n["attrs"]
+    if a.get("direction", b"forward") not in ("forward", b"forward"):
+        raise NotImplementedError(
+            f"ONNX import: {op} direction "
+            f"'{a.get('direction')}' unsupported (forward only)")
+    if a.get("activations"):
+        raise NotImplementedError(
+            f"ONNX import: {op} with custom activations unsupported")
+    if op == "GRU" and not a.get("linear_before_reset", 0):
+        raise NotImplementedError(
+            "ONNX import: GRU with linear_before_reset=0 differs from this "
+            "runtime's cell (cuDNN semantics) — re-export with "
+            "linear_before_reset=1")
+    if len(n["inputs"]) > 4 and n["inputs"][4]:
+        raise NotImplementedError(
+            f"ONNX import: {op} with sequence_lens unsupported — running "
+            "padded sequences to full length would silently change Y/Y_h")
+    H = int(a["hidden_size"])
+    mode = "lstm" if op == "LSTM" else "gru"
+    ngates = 4 if mode == "lstm" else 3
+    W, R, B = const_in(1), const_in(2), const_in(3)
+    if W is None or R is None:
+        raise NotImplementedError(
+            f"ONNX import: {op} weights must be initializers")
+    W, R = np.asarray(W, np.float32), np.asarray(R, np.float32)
+    if W.shape[0] != 1:
+        raise NotImplementedError(
+            f"ONNX import: {op} num_directions {W.shape[0]} unsupported")
+    W, R = W[0], R[0]
+    if B is None:
+        B = np.zeros((2 * ngates * H,), np.float32)
+    else:
+        B = np.asarray(B, np.float32)[0]
+    order = _LSTM_FROM_ONNX if mode == "lstm" else _GRU_FROM_ONNX
+    layer = {"wi": _gate_reorder(W, order, H),
+             "wh": _gate_reorder(R, order, H),
+             "bi": _gate_reorder(B[:ngates * H], order, H),
+             "bh": _gate_reorder(B[ngates * H:], order, H)}
+    flat = _rnn_pack_np([layer], ngates, H)
+
+    pname = f"{name or 'rnn'}_parameters"
+    ctx["extra_params"][pname] = flat
+    p_sym = sym_mod.var(pname, shape=flat.shape)
+    for i in (1, 2, 3):
+        if i < len(n["inputs"]) and n["inputs"][i]:
+            ctx["folded_inits"].add(n["inputs"][i])
+
+    # initial states: absent/empty -> zeros at the data's static batch size
+    T, N, _ = ctx["static_shape"](ins[0])
+
+    def state_sym(slot, tag):
+        nm_ = n["inputs"][slot] if slot < len(n["inputs"]) else ""
+        if nm_:
+            v = const_in(slot)
+            if v is None:
+                raise NotImplementedError(
+                    f"ONNX import: {op} computed initial state")
+            ctx["folded_inits"].add(nm_)
+            arr = np.asarray(v, np.float32)
+        else:
+            arr = np.zeros((1, N, H), np.float32)
+        sname = f"{name or 'rnn'}_{tag}"
+        ctx["extra_params"][sname] = arr
+        return sym_mod.var(sname, shape=arr.shape)
+
+    h0 = state_sym(5, "state")
+    kw = {"state_size": H, "num_layers": 1, "mode": mode,
+          "state_outputs": True}
+    if mode == "lstm":
+        c0 = state_sym(6, "state_cell")
+        out = sym_mod.RNN(ins[0], p_sym, h0, c0, **kw)
+        y, hn, cn = out[0], out[1], out[2]
+    else:
+        out = sym_mod.RNN(ins[0], p_sym, h0, **kw)
+        y, hn, cn = out[0], out[1], None
+    # ONNX Y is (T, num_dirs=1, N, H); ours is (T, N, H)
+    y4 = sym_mod.expand_dims(y, axis=1)
+    outs = [y4, hn] + ([cn] if mode == "lstm" else [])
+    n_declared = max(1, len([o for o in n["outputs"] if o]))
+    # single declared output -> a Symbol (the caller stores it unwrapped)
+    return y4 if n_declared == 1 else outs[:n_declared]
 
 
 def import_model(onnx_file):
@@ -549,9 +876,20 @@ def import_model(onnx_file):
     # parameter must remain a parameter, not get baked in
     consumed = set()
     _SHAPE_INPUTS = {"Reshape": [1], "Squeeze": [1], "Unsqueeze": [1],
-                     "Slice": [1, 2, 3, 4]}
+                     "Slice": [1, 2, 3, 4], "Gather": [1],
+                     "LSTM": [1, 2, 3], "GRU": [1, 2, 3]}
     _CONST_TAGS = ("_scalar", "_one", "_half", "_eps", "_sqrt2", "_c",
                    "_s2pi")
+    # this exporter records its decomposition constants in metadata; for
+    # OUR files that exact set governs scalar folding — a genuine learnable
+    # parameter whose name merely ENDS like a const tag is never folded.
+    # Foreign files (no such metadata) fall back to the suffix heuristic.
+    # only files that actually CARRY the key use the exact set — older
+    # mxnet_tpu exports (no metadata) keep the suffix heuristic
+    meta_consts = None
+    if "mxnet_tpu_consts" in m.get("metadata", {}):
+        meta_consts = set(
+            m["metadata"]["mxnet_tpu_consts"].split("\n")) - {""}
     uses = {}
     for n in g["nodes"]:
         shape_slots = _SHAPE_INPUTS.get(n["op_type"], [])
@@ -569,8 +907,15 @@ def import_model(onnx_file):
     for nm_, kinds in uses.items():
         if kinds == {"shape"}:
             consumed.add(nm_)
-        elif kinds == {"scalar"} and nm_.endswith(_CONST_TAGS):
-            consumed.add(nm_)
+        elif kinds == {"scalar"}:
+            if meta_consts is not None:
+                if nm_ in meta_consts:
+                    consumed.add(nm_)
+            elif nm_.endswith(_CONST_TAGS):
+                consumed.add(nm_)
+
+    input_shapes = {vi["name"]: tuple(vi["shape"]) for vi in g["inputs"]
+                    if vi["name"] not in inits and vi["shape"]}
 
     sym_of = {}
     for vi in g["inputs"]:
@@ -582,8 +927,128 @@ def import_model(onnx_file):
             continue
         sym_of[name] = sym_mod.var(name, shape=inits[name].shape)
 
+    def static_shape(s):
+        """Static shape of a built Symbol via the graph's input shapes —
+        the importer's answer to Shape nodes and RNN state sizing."""
+        kwargs = {}
+        for arg in s.list_arguments():
+            if arg in input_shapes:
+                kwargs[arg] = input_shapes[arg]
+            elif arg in inits:
+                kwargs[arg] = inits[arg].shape
+            elif arg in ctx["extra_params"]:
+                kwargs[arg] = ctx["extra_params"][arg].shape
+        try:
+            _, out_shapes, _ = s.infer_shape(**kwargs)
+            return tuple(int(d) for d in out_shapes[0])
+        except Exception as e:
+            raise NotImplementedError(
+                "ONNX import: could not statically infer a shape the graph "
+                f"computes at runtime ({e}) — dynamic shapes unsupported")
+
+    ctx = {"known": {}, "extra_params": {}, "folded_inits": set(),
+           "static_shape": static_shape}
+    known = ctx["known"]
+
+    def known_in(nm_):
+        return inits.get(nm_) if nm_ in inits else known.get(nm_)
+
+    def fold_shape_chain(n):
+        """Constant-propagate the shape-computation ops (Shape / Gather /
+        Concat / Cast / arith / Slice / Squeeze / Unsqueeze / Constant)
+        when every tensor input is statically known. Returns True when the
+        node was folded into ctx['known']."""
+        op = n["op_type"]
+        a = n["attrs"]
+        outs = [o for o in n["outputs"] if o]
+        if op == "Constant":
+            val = a.get("value")
+            if val is None:
+                return False
+            known[outs[0]] = np.asarray(val)
+            return True
+        if op == "Shape":
+            src = n["inputs"][0]
+            if src in inits:
+                shp = inits[src].shape
+            elif known_in(src) is not None:
+                shp = np.asarray(known_in(src)).shape
+            elif src in sym_of and sym_of[src] is not None:
+                shp = static_shape(sym_of[src])
+            else:
+                return False
+            known[outs[0]] = np.asarray(shp, np.int64)
+            return True
+        vals = [known_in(nm_) for nm_ in n["inputs"] if nm_]
+        if any(v is None for v in vals) or not vals:
+            return False
+        if op == "Gather":
+            known[outs[0]] = np.take(np.asarray(vals[0]),
+                                     np.asarray(vals[1], np.int64),
+                                     axis=int(a.get("axis", 0)))
+        elif op == "Concat":
+            known[outs[0]] = np.concatenate(
+                [np.atleast_1d(np.asarray(v)) for v in vals],
+                axis=int(a.get("axis", 0)))
+        elif op == "Cast":
+            known[outs[0]] = np.asarray(vals[0]).astype(
+                P.ONNX2NP.get(int(a.get("to", 7)), np.int64))
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            f = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                 "Div": lambda x, y: np.asarray(x) // np.asarray(y)
+                 if np.issubdtype(np.asarray(x).dtype, np.integer)
+                 else np.divide(x, y)}[op]
+            known[outs[0]] = f(np.asarray(vals[0]), np.asarray(vals[1]))
+        elif op == "Squeeze":
+            known[outs[0]] = np.squeeze(np.asarray(vals[0]))
+        elif op == "Unsqueeze":
+            axes = np.asarray(vals[1]).ravel() if len(vals) > 1 \
+                else np.asarray(a.get("axes", [0]))
+            v = np.asarray(vals[0])
+            for ax in sorted(int(x) for x in axes):
+                v = np.expand_dims(v, ax)
+            known[outs[0]] = v
+        elif op == "Slice":
+            starts = np.asarray(vals[1]).ravel()
+            ends = np.asarray(vals[2]).ravel()
+            v = np.asarray(vals[0])
+            known[outs[0]] = v[int(starts[0]):int(ends[0])] \
+                if v.ndim == 1 else None
+            if known[outs[0]] is None:
+                del known[outs[0]]
+                return False
+        elif op == "ReduceProd":
+            known[outs[0]] = np.asarray(
+                np.prod(np.asarray(vals[0])), np.int64).reshape(
+                    [1] if a.get("keepdims", 1) else [])
+        else:
+            return False
+        return True
+
+    _FOLDABLE = ("Constant", "Shape", "Gather", "Concat", "Cast", "Add",
+                 "Sub", "Mul", "Div", "Squeeze", "Unsqueeze", "Slice",
+                 "ReduceProd")
+    runtime_used = set()               # initializers real symbol nodes read
     out_sym = None
     for n in g["nodes"]:
+        if n["op_type"] in _FOLDABLE and fold_shape_chain(n):
+            # initializers a folded node consumed are shape-machinery, not
+            # model parameters (unless some real node also reads them)
+            ctx["folded_inits"].update(nm_ for nm_ in n["inputs"]
+                                       if nm_ in inits)
+            continue
+        # a node whose tensor input is a computed shape VALUE (not just a
+        # static attr slot) would need materialization — detect and reject
+        # loudly rather than KeyError below
+        shape_slots = _SHAPE_INPUTS.get(n["op_type"], [])
+        for i, nm_ in enumerate(n["inputs"]):
+            if (nm_ and nm_ not in sym_of and nm_ in known
+                    and i not in shape_slots
+                    and n["op_type"] not in ("Add", "Sub", "Mul", "Div",
+                                             "Pow", "Reshape")):
+                raise NotImplementedError(
+                    f"ONNX import: computed value '{nm_}' consumed as a "
+                    f"runtime tensor by {n['op_type']}")
         # scalar-constant operands of binary ops fold to python scalars so
         # they import as `sym + 2.0`, not a bogus parameter
         if n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow"):
@@ -591,20 +1056,38 @@ def import_model(onnx_file):
             for nm_ in n["inputs"]:
                 if nm_ in consumed:
                     vals.append(float(np.asarray(inits[nm_]).ravel()[0]))
+                elif nm_ not in sym_of and nm_ in known:
+                    # constant-propagated operand (Shape→Gather feeding
+                    # position arithmetic): fold scalars, reject tensors
+                    v = np.asarray(known[nm_])
+                    if v.size != 1:
+                        raise NotImplementedError(
+                            f"ONNX import: computed tensor '{nm_}' consumed "
+                            f"by runtime {n['op_type']}")
+                    vals.append(float(v.ravel()[0]))
                 else:
                     vals.append(sym_of[nm_])
+                    if nm_ in inits:
+                        runtime_used.add(nm_)
             opf = {"Add": lambda x, y: x + y, "Sub": lambda x, y: x - y,
                    "Mul": lambda x, y: x * y, "Div": lambda x, y: x / y,
                    "Pow": lambda x, y: x ** y}[n["op_type"]]
             s = opf(vals[0], vals[1])
         else:
-            s = _import_node(n, sym_of, sym_mod, inits)
+            for i, nm_ in enumerate(n["inputs"]):
+                if nm_ in inits and i not in shape_slots:
+                    runtime_used.add(nm_)
+            s = _import_node(n, sym_of, sym_mod, inits, ctx)
         outs = n["outputs"]
         if len(outs) == 1:
             sym_of[outs[0]] = s
         else:
+            if not isinstance(s, (list, tuple)) and hasattr(s, "__getitem__"):
+                s = [s[i] for i in range(len(outs))]
             for i, o in enumerate(outs):
-                sym_of[o] = s[i]
+                if o and i < len(s):
+                    sym_of[o] = s[i]
+            s = s[0]
         out_sym = s
     if g["outputs"]:
         out_syms = [sym_of[o["name"]] for o in g["outputs"]]
@@ -612,12 +1095,14 @@ def import_model(onnx_file):
             else sym_mod.Group(out_syms)
 
     def to_nd(x):
-        a = x
+        a = np.asarray(x)
         if a.dtype == np.int64:
             a = a.astype(np.int32)
         return nd.array(a)
 
+    drop = consumed | (ctx["folded_inits"] - runtime_used)
     arg_params = {k: to_nd(v) for k, v in inits.items()
-                  if k not in aux_names and k not in consumed}
+                  if k not in aux_names and k not in drop}
+    arg_params.update({k: to_nd(v) for k, v in ctx["extra_params"].items()})
     aux_params = {k: to_nd(v) for k, v in inits.items() if k in aux_names}
     return out_sym, arg_params, aux_params

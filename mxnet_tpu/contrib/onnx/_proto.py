@@ -131,9 +131,10 @@ ONNX2NP = {v: k for k, v in NP2ONNX.items()}
 def tensor(name, arr):
     """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
     import numpy as np
-    arr = np.ascontiguousarray(arr)
+    shape = np.asarray(arr).shape      # BEFORE ascontiguousarray: it
+    arr = np.ascontiguousarray(arr)    # promotes 0-d scalars to 1-d
     b = b""
-    for d in arr.shape:
+    for d in shape:
         b += w_varint(1, d)
     b += w_varint(2, NP2ONNX[str(arr.dtype)])
     b += w_string(8, name)
@@ -171,6 +172,17 @@ def attribute(name, value):
     return b
 
 
+def node_input_names(node_bytes):
+    """Input value names of one serialized NodeProto (field 1)."""
+    r = Reader(node_bytes)
+    names = []
+    while not r.eof():
+        f, _, v = r.field()
+        if f == 1:
+            names.append(v.decode())
+    return names
+
+
 def node(op_type, inputs, outputs, name="", attrs=None):
     """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
     b = b""
@@ -191,7 +203,7 @@ def value_info(name, dtype_enum, shape):
     Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
     Dimension{dim_value=1}."""
     dims = b""
-    for d in shape:
+    for d in (shape or ()):            # None shape = unknown rank
         dims += w_bytes(1, w_varint(1, d))
     tt = w_varint(1, dtype_enum) + w_bytes(2, dims)
     tp = w_bytes(1, tt)
@@ -213,14 +225,18 @@ def graph(nodes, name, inputs, outputs, initializers):
     return b
 
 
-def model(graph_bytes, opset=13, producer="mxnet_tpu"):
-    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8.
+def model(graph_bytes, opset=13, producer="mxnet_tpu", metadata=None):
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8,
+    metadata_props=14 (StringStringEntryProto: key=1, value=2).
     OperatorSetIdProto: domain=1, version=2."""
     opset_b = w_string(1, "") + w_varint(2, opset)
-    return (w_varint(1, 8)                  # IR version 8
-            + w_string(2, producer)
-            + w_bytes(7, graph_bytes)
-            + w_bytes(8, opset_b))
+    out = (w_varint(1, 8)                  # IR version 8
+           + w_string(2, producer)
+           + w_bytes(7, graph_bytes)
+           + w_bytes(8, opset_b))
+    for k, v in (metadata or {}).items():
+        out += w_bytes(14, w_string(1, k) + w_string(2, v))
+    return out
 
 
 # -- reader side ------------------------------------------------------------
@@ -229,7 +245,7 @@ def model(graph_bytes, opset=13, producer="mxnet_tpu"):
 def parse_model(data):
     """-> dict with 'graph' (parsed GraphProto dict), 'opset', 'producer'."""
     r = Reader(data)
-    out = {"opset": None, "producer": "", "graph": None}
+    out = {"opset": None, "producer": "", "graph": None, "metadata": {}}
     while not r.eof():
         f, w, v = r.field()
         if f == 7:
@@ -242,6 +258,16 @@ def parse_model(data):
                     out["opset"] = v2
         elif f == 2:
             out["producer"] = v.decode()
+        elif f == 14:
+            rr = Reader(v)
+            k = val = ""
+            while not rr.eof():
+                f2, _, v2 = rr.field()
+                if f2 == 1:
+                    k = v2.decode()
+                elif f2 == 2:
+                    val = v2.decode()
+            out["metadata"][k] = val
     return out
 
 

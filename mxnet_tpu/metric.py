@@ -12,7 +12,7 @@ from .ndarray import NDArray
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
            "RMSE", "CrossEntropy", "Perplexity", "Loss", "PearsonCorrelation",
            "CompositeEvalMetric", "CustomMetric", "create", "np_metric",
-           "VOC07MApMetric"]
+           "VOC07MApMetric", "BLEU"]
 
 _registry = Registry("metric")
 register = _registry.register
@@ -380,3 +380,65 @@ class VOC07MApMetric(EvalMetric):
         if not aps:
             return self.name, float("nan")
         return self.name, float(np.mean(aps))
+
+
+@register("bleu")
+class BLEU(EvalMetric):
+    """Corpus BLEU-N with brevity penalty (reference behavior:
+    gluon-nlp scripts/nmt/bleu.py `compute_bleu`, the NMT quality metric).
+
+    `update(labels, preds)`: one reference and one hypothesis per sentence,
+    each a 1-D sequence of token ids (or a list of them). Counts accumulate
+    across updates; `get()` returns the CORPUS score (not an average of
+    sentence scores). `smooth` adds +1 smoothing (Lin & Och) to orders with
+    zero matches — without it any zero n-gram count makes the score 0."""
+
+    def __init__(self, max_n=4, smooth=False, name="bleu", **kwargs):
+        self.max_n = int(max_n)
+        self.smooth = smooth
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self._match = [0] * getattr(self, "max_n", 4)
+        self._total = [0] * getattr(self, "max_n", 4)
+        self._hyp_len = 0
+        self._ref_len = 0
+
+    @staticmethod
+    def _ngrams(seq, n):
+        counts = {}
+        for i in range(len(seq) - n + 1):
+            g = tuple(seq[i:i + n])
+            counts[g] = counts.get(g, 0) + 1
+        return counts
+
+    def update(self, labels, preds):
+        for ref, hyp in zip(_as_list(labels), _as_list(preds)):
+            ref = [int(t) for t in _as_np(ref).reshape(-1)]
+            hyp = [int(t) for t in _as_np(hyp).reshape(-1)]
+            self._hyp_len += len(hyp)
+            self._ref_len += len(ref)
+            for n in range(1, self.max_n + 1):
+                h = self._ngrams(hyp, n)
+                r = self._ngrams(ref, n)
+                self._match[n - 1] += sum(min(c, r.get(g, 0))
+                                          for g, c in h.items())
+                self._total[n - 1] += max(len(hyp) - n + 1, 0)
+            self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        import math
+        log_p = 0.0
+        for m, t in zip(self._match, self._total):
+            if self.smooth:
+                m, t = m + 1, t + 1
+            if m == 0 or t == 0:
+                return self.name, 0.0
+            log_p += math.log(m / t) / self.max_n
+        bp = 1.0 if self._hyp_len >= self._ref_len else math.exp(
+            1.0 - self._ref_len / max(self._hyp_len, 1))
+        return self.name, bp * math.exp(log_p)

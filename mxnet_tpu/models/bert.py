@@ -241,7 +241,12 @@ class BERTModel(HybridBlock):
 class BERTEmbedStage(HybridBlock):
     """BERT embeddings as pipeline stage 0 (word + type + position + LN).
     sp-aware like BERTModel: under a shard_map that controls `sp` it embeds
-    this device's sequence shard with the correct global positions."""
+    this device's sequence shard with the correct global positions.
+
+    `token_types` is optional: the pipeline activation carrier moves a
+    single tensor between stages, so segment-free LM pretraining passes
+    tokens only — but two-segment pretraining CAN pass token_types and get
+    the same embedding sum as BERTModel."""
 
     def __init__(self, cfg, **kwargs):
         super().__init__(**kwargs)
@@ -249,17 +254,22 @@ class BERTEmbedStage(HybridBlock):
         self._seq_parallel = cfg.get("seq_parallel", False)
         self.word_embed = nn.Embedding(cfg["vocab_size"], units, dtype=dtype,
                                        weight_initializer="xavier")
+        self.token_type_embed = nn.Embedding(
+            cfg.get("type_vocab_size", 2), units, dtype=dtype,
+            weight_initializer="xavier")
         self.position_embed = Parameter(
             "position_weight", shape=(cfg["max_length"], units), dtype=dtype,
             init="xavier")
         self.position_embed.shard_hint = "embedding"
         self.embed_ln = nn.LayerNorm(in_channels=units)
 
-    def forward(self, inputs):
+    def forward(self, inputs, token_types=None):
         from ..parallel import in_manual
         L = inputs.shape[1]
         sp_manual = self._seq_parallel and in_manual("sp")
         x = self.word_embed(inputs)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
         x = x + _positions(self.position_embed, L, sp_manual).expand_dims(axis=0)
         return self.embed_ln(x)
 

@@ -1,0 +1,98 @@
+"""Long-context proof at actually-long length (SURVEY §5.7; VERDICT r4 #3).
+
+Two pins that make `bert_long_config` (seq 8192) live code rather than a
+dead config:
+
+  * the 8k config compiles AND steps at sp=8 on the 8-device mesh, with a
+    decreasing pretrain loss (thin width — the LENGTH is the point)
+  * ring attention's compiled fwd+bwd temp memory scales LINEARLY in L
+    (O(L_local * chunk) per ring step), pinned the same way
+    test_fused_lamb pins the LAMB temp — via compiled memory_analysis —
+    and never materializes anything like the (L, L) dense score matrix
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import bert as bert_mod
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _ring_temp_bytes(mesh, L, chunk=128, B=1, H=2, D=64):
+    q = jnp.zeros((B, H, L, D), jnp.float32)
+
+    def loss(q, k, v):
+        fn = jax.shard_map(
+            lambda a, b, c: parallel.ring_attention(
+                a, b, c, "sp", causal=True, chunk=chunk),
+            mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None), check_vma=False)
+        return jnp.sum(fn(q, k, v))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return g.lower(q, q, q).compile().memory_analysis().temp_size_in_bytes
+
+
+def test_ring_memory_linear_in_length():
+    """Per-device temp for ring fwd+bwd must scale ~linearly in L (the
+    O(L_local) claim): quadratic would grow 16x from 2k to 8k."""
+    mesh = parallel.make_mesh(sp=8)
+    t2k = _ring_temp_bytes(mesh, 2048)
+    t8k = _ring_temp_bytes(mesh, 8192)
+    ratio = t8k / t2k
+    assert ratio < 6.0, (
+        f"ring temp grew {ratio:.1f}x from L=2048 to L=8192 "
+        f"({t2k} -> {t8k} bytes): not O(L_local)")
+    # and far below the dense score matrix: one (B,H,L,L) f32 at 8k is
+    # 536 MB (the compiled dense fwd+bwd measures ~4x that); ring is ~17 MB
+    B, H, L = 1, 2, 8192
+    assert t8k < B * H * L * L * 4 / 16, (
+        f"ring temp {t8k} bytes is within 16x of one dense score matrix")
+
+
+def test_bert_long_config_8k_sp8_trains():
+    """bert_long_config at its REAL max_length (8192), sp=8: the step must
+    compile, run, and learn. Width is shrunk (the length is what this test
+    pins); seq_parallel/remat/attn_dropout wiring comes from the stock
+    config. ~60s on the CPU mesh."""
+    parallel.make_mesh(sp=8)
+    cfg = bert_mod.bert_long_config(vocab_size=512, units=64,
+                                    hidden_size=128, num_layers=2,
+                                    num_heads=4, dropout=0.0)
+    assert cfg["max_length"] == 8192
+    assert cfg["seq_parallel"] and cfg["remat"]
+    assert cfg["attn_dropout"] == 0.0
+
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    data_specs = [P(None, "sp"), P(None, "sp"), P(None), P(None)]
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "adam", {"learning_rate": 1e-3},
+        data_specs=data_specs)
+
+    L = cfg["max_length"]
+    # SAME batch both steps: the decrease assertion is then deterministic
+    # (different batches would race one adam step against inter-batch noise)
+    b = bert_mod.make_synthetic_batch(cfg, batch_size=2, seq_len=L,
+                                      num_masked=32, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length",
+             "masked_positions")]
+    labels = [nd.array(b[k]) for k in
+              ("mlm_labels", "mlm_weights", "nsp_labels")]
+    losses = [float(trainer.step(data, labels).asscalar())
+              for _ in range(2)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], (
+        f"loss did not decrease over the 8k steps: {losses}")

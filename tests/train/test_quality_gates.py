@@ -130,3 +130,109 @@ def test_resnet18_synthetic_gratings_gate():
         assert acc >= 0.85, f"val top-1 {acc:.3f} < 0.85 gate"
     finally:
         parallel.set_mesh(None)
+
+
+def test_bert_pair_copy_mlm_gate():
+    """Falsifiable BERT gate (VERDICT r4 #4, cloning the SyntheticGratings
+    pattern): a deterministic pair-structured language — even positions
+    hold random tokens, each odd position holds a fixed permutation of its
+    left neighbour — where only ODD positions are masked, so the visible
+    partner makes 100% masked-token accuracy attainable. Solving it
+    REQUIRES attention (marginals give 1/30 ~ 3%): broken attention
+    masking, dead position embeddings, or a silent optimizer regression
+    all fail the >=95% held-out gate. Learns with a grokking-style cliff
+    at ~step 270 (seeded; deterministic)."""
+    from mxnet_tpu.models import bert as bert_mod
+
+    V, C, L, M = 64, 30, 32, 8
+    MASK = V - 1
+    perm = np.random.RandomState(123).permutation(C)
+
+    def make_batch(B, seed):
+        rng = np.random.RandomState(seed)
+        even = rng.randint(0, C, (B, L // 2))
+        seq = np.empty((B, L), np.int32)
+        seq[:, 0::2] = even
+        seq[:, 1::2] = perm[even]
+        odd = np.arange(1, L, 2)
+        pos = np.stack([rng.choice(odd, M, replace=False)
+                        for _ in range(B)]).astype(np.int32)
+        labels = np.take_along_axis(seq, pos, 1)
+        inp = seq.copy()
+        np.put_along_axis(inp, pos, MASK, 1)
+        return dict(
+            input_ids=inp, token_types=np.zeros((B, L), np.int32),
+            valid_length=np.full((B,), L, np.int32), masked_positions=pos,
+            mlm_labels=labels, mlm_weights=np.ones((B, M), np.float32),
+            nsp_labels=np.zeros((B,), np.int32))
+
+    parallel.make_mesh(dp=1, devices=parallel.local_mesh_devices(1))
+    cfg = bert_mod.bert_tiny_config(vocab_size=V, max_length=L)
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "adam", {"learning_rate": 3e-3})
+    for step in range(450):
+        b = make_batch(32, seed=step)
+        data = [nd.array(b[k]) for k in
+                ("input_ids", "token_types", "valid_length",
+                 "masked_positions")]
+        labels = [nd.array(b[k]) for k in
+                  ("mlm_labels", "mlm_weights", "nsp_labels")]
+        trainer.step(data, labels)
+    trainer.sync_to_block()
+    hb = make_batch(64, seed=10_000)      # held out: unseen sequences
+    mlm, _ = model(nd.array(hb["input_ids"]), nd.array(hb["token_types"]),
+                   nd.array(hb["valid_length"]),
+                   nd.array(hb["masked_positions"]))
+    acc = (mlm.asnumpy().argmax(-1) == hb["mlm_labels"]).mean()
+    assert acc >= 0.95, f"held-out masked accuracy {acc:.3f} < 0.95 gate"
+
+
+def test_nmt_reversal_bleu_gate():
+    """Falsifiable NMT gate (VERDICT r4 #4): target = REVERSED source, so
+    the decoder's encoder-attention must learn a position-dependent
+    alignment (a copy task would pass with a broken position signal;
+    reversal does not). Greedy decode on held-out sentences must reach
+    corpus BLEU >= 0.95 — attainable 1.0, observed 1.0 at 250 steps."""
+    from mxnet_tpu.metric import BLEU
+    from mxnet_tpu.models.transformer import (TransformerNMT,
+                                              label_smoothing_loss)
+
+    BOS, EOS = 1, 2
+    V, SL, B = 24, 8, 32
+
+    def make_batch(seed):
+        rng = np.random.RandomState(seed)
+        src = rng.randint(3, V, (B, SL))
+        tgt = src[:, ::-1]
+        tgt_in = np.concatenate([np.full((B, 1), BOS), tgt], 1)
+        tgt_out = np.concatenate([tgt, np.full((B, 1), EOS)], 1)
+        return (src.astype(np.int32), tgt_in.astype(np.int32),
+                tgt_out.astype(np.int32))
+
+    model = TransformerNMT(src_vocab=V, tgt_vocab=V, units=48,
+                           hidden_size=192, num_layers=2, num_heads=4,
+                           dropout=0.0, max_length=SL + 2)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = Trainer(model.collect_params(), "adam",
+                      {"learning_rate": 3e-3})
+    for step in range(250):
+        src, ti, to = make_batch(step)
+        with autograd.record():
+            loss = label_smoothing_loss(
+                model(nd.array(src), nd.array(ti)), nd.array(to))
+        loss.backward()
+        trainer.step(1)
+
+    src, _, _ = make_batch(99_999)        # held out
+    ref = src[:, ::-1]
+    hyp = np.asarray(model.greedy_decode(nd.array(src), bos=BOS, eos=EOS,
+                                         max_len=SL + 1))
+    bleu = BLEU()
+    for r, h in zip(ref, hyp):
+        bleu.update([r], [h[1:SL + 1]])   # strip the leading BOS
+    score = bleu.get()[1]
+    assert score >= 0.95, f"reversal BLEU {score:.3f} < 0.95 gate"

@@ -210,3 +210,45 @@ def test_quantized_dense_keeps_fused_activation():
     assert (got >= 0).all(), "relu dropped by QuantizedDense"
     err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-8)
     assert err < 0.05, f"int8 dense+relu error {err}"
+
+
+def test_amp_widest_type_cast(amp_initialized):
+    """WIDEST_OPS (reference WIDEST_TYPE_CASTS): a bf16 operand meeting an
+    f32 operand runs the op in f32 — no silent truncation of the f32
+    side."""
+    a = nd.ones((2, 3)).astype("bfloat16")
+    b = nd.ones((2, 3))                      # float32
+    out = nd.broadcast_add(a, b)
+    assert str(out.dtype) == "float32", out.dtype
+    # both-bf16 stays bf16 (no gratuitous upcast)
+    out16 = nd.broadcast_add(a, a)
+    assert str(out16.dtype) == "bfloat16", out16.dtype
+
+
+def test_amp_conditional_fp32(amp_initialized):
+    """CONDITIONAL_FP32_OPS: softrelu (exp overflow risk) runs f32 even on
+    bf16 input; relu through the same op keeps the arriving dtype."""
+    x = nd.ones((2, 3)).astype("bfloat16")
+    soft = nd.Activation(x, act_type="softrelu")
+    assert str(soft.dtype) == "float32", soft.dtype
+    soft_pos = nd.Activation(x, "softrelu")   # positional act_type too
+    assert str(soft_pos.dtype) == "float32", soft_pos.dtype
+    rel = nd.Activation(x, act_type="relu")
+    assert str(rel.dtype) == "bfloat16", rel.dtype
+
+
+def test_amp_move_op_between_lists(amp_initialized):
+    """User-extensible lists (VERDICT r4 #8): moving `mean` from the fp32
+    list to the target list flips its cast behavior in place, and moving
+    it back restores it."""
+    x = nd.ones((2, 3)).astype("bfloat16")
+    assert str(nd.mean(x).dtype) == "float32"      # FP32_OPS default
+    amp.move_op("mean", "target")
+    try:
+        assert "mean" in amp.list_target_ops()
+        assert "mean" not in amp.list_fp32_ops()
+        assert str(nd.mean(x).dtype) == "bfloat16"
+    finally:
+        amp.move_op("mean", "fp32")
+    assert str(nd.mean(x).dtype) == "float32"
+    assert "mean" in amp.list_fp32_ops()

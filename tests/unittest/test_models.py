@@ -176,3 +176,27 @@ def test_nms():
     kept = set(int(i) for i, sc in zip(np.asarray(idx), np.asarray(s)) if sc > 0)
     assert 0 in kept and 3 in kept
     assert 1 not in kept  # suppressed by box 0
+
+
+def test_bert_embed_stage_token_types():
+    """BERTEmbedStage accepts optional token_types (ADVICE r4): segment
+    embeddings must shift the output, and omitting them must still work
+    (the single-tensor pipeline carrier case)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import bert as bert_mod
+
+    cfg = bert_mod.bert_tiny_config(max_length=16)
+    mx.random.seed(0)
+    stage = bert_mod.BERTEmbedStage(cfg)
+    stage.initialize()
+    toks = nd.array(np.arange(8, dtype=np.int32).reshape(1, 8))
+    base = stage(toks).asnumpy()
+    types = nd.array(np.ones((1, 8), np.int32))
+    with_types = stage(toks, types).asnumpy()
+    assert base.shape == with_types.shape
+    assert not np.allclose(base, with_types), \
+        "token_type embedding had no effect"
+    zero_types = stage(toks, nd.array(np.zeros((1, 8), np.int32))).asnumpy()
+    assert not np.allclose(with_types, zero_types)

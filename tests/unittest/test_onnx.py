@@ -381,3 +381,146 @@ def test_multi_output_roundtrip(tmp_path):
     assert len(ref) == len(got) == 2
     for r, g in zip(ref, got):
         np.testing.assert_allclose(g, r, rtol=2e-5, atol=2e-6)
+
+
+def test_strided_slice_roundtrip(tmp_path):
+    """General `slice` with steps (incl. negative) survives the trip —
+    the YOLO-style focus/reorg slicing pattern (VERDICT r4 #5)."""
+    data = sym.var("data")
+    a = sym.slice(data, begin=(None, None, 0, 1), end=(None, None, None, None),
+                  step=(None, None, 2, 2), name="s1")
+    b = sym.slice(data, begin=(None, None, None, None),
+                  end=(None, None, None, None), step=(None, None, 1, -1),
+                  name="s2")
+    out = sym.Concat(a + a,
+                     sym.slice(b, begin=(None, None, 0, None),
+                               end=(None, None, None, None),
+                               step=(None, None, 2, 2), name="s3"),
+                     dim=1, name="cat")
+    shape = (2, 3, 8, 8)
+    f = str(tmp_path / "strided.onnx")
+    onnx_mx.export_model(out, {}, {"data": shape}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = nd.array(np.random.RandomState(0).randn(*shape).astype(np.float32))
+    y1 = _run(out, {}, x)
+    y2 = _run(sym2, {**args2, **aux2}, x)
+    np.testing.assert_allclose(y1, y2, rtol=1e-6, atol=1e-6)
+
+
+def test_computed_shape_import(tmp_path):
+    """Shape->Gather->Concat->Reshape chains (the PyTorch-exporter flatten
+    idiom) import by constant propagation at the graph's static shapes."""
+    nodes = [
+        P.node("Shape", ["data"], ["shp"], name="shape0"),
+        P.node("Gather", ["shp", "idx0"], ["d0"], name="g0",
+               attrs={"axis": 0}),
+        P.node("Unsqueeze", ["d0", "ax0"], ["d0u"], name="u0"),
+        P.node("Concat", ["d0u", "minus1"], ["newshape"], name="c0",
+               attrs={"axis": 0}),
+        P.node("Reshape", ["data", "newshape"], ["flat"], name="r0"),
+        P.node("MatMul", ["flat", "w"], ["out"], name="mm"),
+    ]
+    rs = np.random.RandomState(0)
+    w = rs.randn(12, 4).astype(np.float32)
+    inits = [P.tensor("idx0", np.asarray(0, np.int64)),
+             P.tensor("ax0", np.asarray([0], np.int64)),
+             P.tensor("minus1", np.asarray([-1], np.int64)),
+             P.tensor("w", w)]
+    g = P.graph(nodes, "computed",
+                [P.value_info("data", P.TENSOR_FLOAT, (2, 3, 4))],
+                [P.value_info("out", P.TENSOR_FLOAT, (2, 4))], inits)
+    f = str(tmp_path / "computed.onnx")
+    with open(f, "wb") as fh:
+        fh.write(P.model(g))
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    y = _run(sym2, {**args2, **aux2}, nd.array(x))
+    np.testing.assert_allclose(y, x.reshape(2, -1) @ w, rtol=1e-5,
+                               atol=1e-6)
+    assert set(args2) == {"w"}, set(args2)   # shape consts never params
+
+
+@pytest.mark.parametrize("mode,layers", [("lstm", 1), ("lstm", 2),
+                                         ("gru", 1)])
+def test_rnn_roundtrip(tmp_path, mode, layers):
+    """LSTM/GRU export+import (VERDICT r4 #5): the flat cuDNN parameter
+    vector re-lays-out into per-layer ONNX W/R/B (gate orders
+    ours-[i,f,g,o]/[r,z,n] vs ONNX-[i,o,f,c]/[z,r,h]) and packs back —
+    outputs must match through the DeepAR-style stack."""
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, N, I, H = 5, 3, 6, 8
+    rs = np.random.RandomState(0)
+    data = sym.var("data")
+    ngates = {"lstm": 4, "gru": 3}[mode]
+    psize = rnn_param_size(mode, layers, I, H)
+    p = sym.var("rnn_param", shape=(psize,))
+    h0 = sym.var("rnn_state", shape=(layers, N, H))
+    params = {"rnn_param": nd.array(
+        rs.randn(psize).astype(np.float32) * 0.3),
+        "rnn_state": nd.array(np.zeros((layers, N, H), np.float32))}
+    if mode == "lstm":
+        c0 = sym.var("rnn_state_cell", shape=(layers, N, H))
+        params["rnn_state_cell"] = nd.array(
+            np.zeros((layers, N, H), np.float32))
+        y = sym.RNN(data, p, h0, c0, state_size=H, num_layers=layers,
+                    mode=mode, name="rnn0")
+    else:
+        y = sym.RNN(data, p, h0, state_size=H, num_layers=layers,
+                    mode=mode, name="rnn0")
+    # DeepAR-ish head: project the per-step hidden state
+    wproj = sym.var("proj_weight")
+    out = sym.FullyConnected(y, wproj, num_hidden=2, flatten=False,
+                             no_bias=True, name="proj")
+    params["proj_weight"] = nd.array(rs.randn(2, H).astype(np.float32) * 0.3)
+
+    f = str(tmp_path / f"{mode}{layers}.onnx")
+    onnx_mx.export_model(out, params, {"data": (T, N, I)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = nd.array(rs.randn(T, N, I).astype(np.float32))
+    y1 = _run(out, params, x)
+    y2 = _run(sym2, {**args2, **aux2}, x)
+    np.testing.assert_allclose(y1, y2, rtol=2e-5, atol=2e-6)
+    # the flat vector must NOT survive as an importable param by its old
+    # name; the repacked one must
+    assert "rnn_param" not in args2
+    assert any(k.endswith("_parameters") for k in args2), set(args2)
+
+
+def test_split_unused_output_exports_all_pieces(tmp_path):
+    """ADVICE r4: a split whose trailing output is unreferenced must still
+    export num_outputs pieces — fewer pieces would mean larger splits and
+    silently wrong values."""
+    data = sym.var("data")
+    parts = sym.split(data, num_outputs=3, axis=1, name="sp")
+    out = parts[0] + parts[1]          # parts[2] deliberately unused
+    shape = (2, 6, 4)
+    f = str(tmp_path / "split.onnx")
+    onnx_mx.export_model(out, {}, {"data": shape}, f)
+    with open(f, "rb") as fh:
+        m = P.parse_model(fh.read())
+    split_nodes = [n for n in m["graph"]["nodes"]
+                   if n["op_type"] == "Split"]
+    assert len(split_nodes) == 1
+    assert len(split_nodes[0]["outputs"]) == 3, split_nodes[0]["outputs"]
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = nd.array(np.random.RandomState(1).randn(*shape).astype(np.float32))
+    np.testing.assert_allclose(_run(out, {}, x),
+                               _run(sym2, {**args2, **aux2}, x), rtol=1e-6)
+
+
+def test_scalar_param_with_const_like_name_not_folded(tmp_path):
+    """ADVICE r4: a genuine (1,)-shaped learnable parameter named like a
+    decomposition constant (ends in '_c') must survive import as a param —
+    the exporter's metadata lists the REAL consts exactly."""
+    data = sym.var("data")
+    gain = sym.var("gain_c")           # adversarial name
+    out = sym.broadcast_mul(data, gain, name="scale")
+    params = {"gain_c": nd.array(np.asarray([2.5], np.float32))}
+    f = str(tmp_path / "scalarparam.onnx")
+    onnx_mx.export_model(out, params, {"data": (2, 3)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    assert "gain_c" in args2, set(args2)
+    x = nd.array(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(_run(sym2, {**args2, **aux2}, x),
+                               np.full((2, 3), 2.5, np.float32), rtol=1e-6)

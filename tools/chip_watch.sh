@@ -32,6 +32,7 @@ probe() {
     2>/dev/null | grep -q OK
 }
 
+rm -f "$FLAG"   # a stale flag from a previous watch run must not skip a new window
 echo "$(ts) watch started (interval=${PROBE_INTERVAL}s timeout=${PROBE_TIMEOUT}s)" >> "$LOG"
 while true; do
   if probe; then
