@@ -1,10 +1,18 @@
 """DataLoader (reference: `python/mxnet/gluon/data/dataloader.py`).
 
 The reference forks `num_workers` Python processes with shared-memory NDArray
-return. TPU-native: decode/augment is host CPU work feeding one device queue,
-so we use a thread pool (numpy releases the GIL for the heavy parts) plus a
-double-buffered prefetcher — the same structure as the reference's
-`PrefetcherIter` (`src/io/iter_prefetcher.h`) without the process boundary.
+return; this build keeps BOTH execution models:
+
+  * `num_workers>0, thread_pool=False` (reference default): forked worker
+    PROCESSES — the only way a GIL-bound python transform chain scales
+    past one core.  Workers run the dataset+batchify on numpy and ship
+    numpy back; device arrays are created in the parent.  The transform
+    chain must stay host-side (numpy) inside workers — a forked child must
+    never touch jax/XLA (the runtime's threads do not survive fork), and
+    the worker raises a clear error if a sample does.
+  * `thread_pool=True`: the thread-pool prefetcher (numpy releases the
+    GIL for the heavy parts) — same structure as the reference's
+    `PrefetcherIter` (`src/io/iter_prefetcher.h`), zero process overhead.
 """
 from __future__ import annotations
 
@@ -18,7 +26,7 @@ from ...ndarray import ndarray as _nd
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -31,6 +39,62 @@ def default_batchify_fn(data):
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
     return _nd.array(arr)
+
+
+def numpy_batchify_fn(data):
+    """Worker-process batchify: stacks to NUMPY (device arrays cannot be
+    created in a forked child — jax state does not survive fork)."""
+    if isinstance(data[0], tuple):
+        return tuple(numpy_batchify_fn(list(x)) for x in zip(*data))
+    if isinstance(data[0], NDArray):
+        raise TypeError(
+            "DataLoader worker produced an NDArray: with num_workers>0 the "
+            "transform chain must stay host-side (numpy) — jax/XLA cannot "
+            "run in a forked worker. Use numpy transforms (gluon.data."
+            "vision.transforms are numpy-backed) or thread_pool=True.")
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _to_device_tree(batch):
+    if isinstance(batch, tuple):
+        return tuple(_to_device_tree(b) for b in batch)
+    return batch if isinstance(batch, NDArray) else _nd.array(batch)
+
+
+def _assert_numpy_tree(batch):
+    """Reject device arrays produced inside a forked worker — whatever the
+    batchify_fn, the answer crossing the fork must be host numpy."""
+    if isinstance(batch, tuple):
+        for b in batch:
+            _assert_numpy_tree(b)
+        return
+    if isinstance(batch, NDArray):
+        raise TypeError(
+            "DataLoader worker produced an NDArray: with num_workers>0 the "
+            "transform/batchify chain must stay host-side (numpy) — "
+            "jax/XLA cannot run in a forked worker. Use numpy transforms "
+            "or thread_pool=True.")
+
+
+def _worker_loop(dataset, batchify_fn, key_q, data_q, seed):
+    """Forked worker body: indices in, (idx, numpy batch | error) out."""
+    # fork copies the parent RNG state into EVERY worker: reseed per worker
+    # or all workers draw identical crop/flip augmentation streams
+    np.random.seed(seed)
+    while True:
+        item = key_q.get()
+        if item is None:
+            return
+        idx, indices = item
+        try:
+            batch = batchify_fn([dataset[i] for i in indices])
+            _assert_numpy_tree(batch)
+            data_q.put((idx, batch, None))
+        except Exception as e:          # noqa: BLE001 — relayed to parent
+            data_q.put((idx, None, f"{type(e).__name__}: {e}"))
 
 
 class DataLoader:
@@ -48,6 +112,7 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._thread_pool = thread_pool
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
 
     def __len__(self):
@@ -60,6 +125,9 @@ class DataLoader:
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
+            return
+        if not self._thread_pool:
+            yield from self._iter_processes()
             return
         # threaded prefetch pipeline
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
@@ -80,3 +148,79 @@ class DataLoader:
                     break
                 yield fut.result()
             t.join()
+
+    def _iter_processes(self):
+        """Forked-worker pipeline (reference: _MultiWorkerIter): tasks fan
+        out to `num_workers` processes, results reorder by batch index so
+        iteration order matches num_workers=0 exactly."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")    # fork: closures/lambdas in
+        #                                 transforms need no pickling
+        key_q = ctx.Queue()
+        data_q = ctx.Queue()
+        bfn = self._batchify_fn
+        if bfn is default_batchify_fn:
+            bfn = numpy_batchify_fn     # device arrays can't cross fork
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        workers = [ctx.Process(target=_worker_loop,
+                               args=(self._dataset, bfn, key_q, data_q,
+                                     (base_seed + i) % (2 ** 32)),
+                               daemon=True)
+                   for i in range(self._num_workers)]
+        # jax warns that fork from a multithreaded process can deadlock —
+        # true IF the child touches jax, which the numpy-only worker
+        # contract (numpy_batchify_fn raises on NDArray) forbids. Same
+        # accepted caveat as the reference's fork+CUDA DataLoader.
+        import warnings
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=RuntimeWarning)
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=DeprecationWarning)
+            for w in workers:
+                w.start()
+        try:
+            batches = iter(enumerate(self._batch_sampler))
+            sent = recvd = 0
+            buf = {}
+            for _ in range(max(self._prefetch, 1)):
+                item = next(batches, None)
+                if item is None:
+                    break
+                key_q.put(item)
+                sent += 1
+            next_yield = 0
+            while True:
+                if next_yield in buf:
+                    yield _to_device_tree(buf.pop(next_yield))
+                    next_yield += 1
+                    continue
+                if recvd >= sent:       # nothing in flight, nothing buffered
+                    break
+                while True:             # bounded get: a worker that died
+                    try:                # without replying must not hang us
+                        idx, batch, err = data_q.get(timeout=5)
+                        break
+                    except queue.Empty:
+                        dead = [w for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker (pid {dead[0].pid}) "
+                                f"died with exit code {dead[0].exitcode} "
+                                "without reporting a result") from None
+                recvd += 1
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                buf[idx] = batch
+                item = next(batches, None)
+                if item is not None:
+                    key_q.put(item)
+                    sent += 1
+        finally:
+            for _ in workers:
+                key_q.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()

@@ -19,32 +19,47 @@ class Compose(HybridSequential):
             self.add(t)
 
 
-class Cast(HybridBlock):
+class Cast(Block):
     def __init__(self, dtype="float32"):
         super().__init__()
         self._dtype = dtype
 
-    def hybrid_forward(self, F, x):
-        return F.cast(x, dtype=self._dtype)
+    def forward(self, x):
+        if isinstance(x, np.ndarray):          # worker-process (numpy) path
+            return x.astype(self._dtype)
+        return _nd.cast(x, dtype=self._dtype)
 
 
-class ToTensor(HybridBlock):
-    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: ToTensor)."""
+class ToTensor(Block):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: ToTensor).
+    Type-preserving: numpy in → numpy out (the process-worker path),
+    NDArray in → NDArray out."""
 
-    def hybrid_forward(self, F, x):
-        x = F.cast(x, dtype="float32") / 255.0
+    def forward(self, x):
+        if isinstance(x, np.ndarray):
+            x = x.astype(np.float32) / 255.0
+            return x.transpose(2, 0, 1) if x.ndim == 3 \
+                else x.transpose(0, 3, 1, 2)
+        x = _nd.cast(x, dtype="float32") / 255.0
         if x.ndim == 3:
-            return F.transpose(x, axes=(2, 0, 1))
-        return F.transpose(x, axes=(0, 3, 1, 2))
+            return _nd.transpose(x, axes=(2, 0, 1))
+        return _nd.transpose(x, axes=(0, 3, 1, 2))
 
 
-class Normalize(HybridBlock):
+class Normalize(Block):
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = _nd.array(np.asarray(mean, np.float32).reshape(-1, 1, 1))
-        self._std = _nd.array(np.asarray(std, np.float32).reshape(-1, 1, 1))
+        self._mean_np = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std_np = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self._mean = None              # device copies made lazily — keeps
+        self._std = None               # __init__ fork-safe (no jax arrays)
 
-    def hybrid_forward(self, F, x):
+    def forward(self, x):
+        if isinstance(x, np.ndarray):
+            return (x - self._mean_np) / self._std_np
+        if self._mean is None:
+            self._mean = _nd.array(self._mean_np)
+            self._std = _nd.array(self._std_np)
         return (x - self._mean) / self._std
 
 
@@ -85,7 +100,8 @@ class Resize(Block):
             out = _np_bilinear(data, h, w)
         else:
             out = np.stack([_np_bilinear(d, h, w) for d in data])
-        return _nd.array(out.astype(dtype))
+        out = out.astype(dtype)
+        return _nd.array(out) if isinstance(x, NDArray) else out
 
 
 class CenterCrop(Block):
@@ -128,8 +144,8 @@ class RandomResizedCrop(Block):
                 y0 = np.random.randint(0, H - h + 1)
                 data = data[y0:y0 + h, x0:x0 + w, :]
                 break
-        out = _np_bilinear(data, self._size[1], self._size[0])
-        return _nd.array(out.astype(dtype))
+        out = _np_bilinear(data, self._size[1], self._size[0]).astype(dtype)
+        return _nd.array(out) if isinstance(x, NDArray) else out
 
 
 class RandomFlipLeftRight(Block):

@@ -449,10 +449,13 @@ def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
     The model-facing fused path (replaces the reference's interleaved-matmul
     attention ops for new code).
 
-    seq_parallel: shard the sequence over the mesh's `sp` axis and run ring
-    attention (SURVEY §5.7 long-context path). No-op when the active mesh
-    has sp=1, so the same model config runs anywhere. Attention-probability
-    dropout is not supported under the ring (raises)."""
+    seq_parallel: shard the sequence over the mesh's `sp` axis. True or
+    "ring" runs ring attention (K/V rotate on ICI — SURVEY §5.7 long-
+    context path); "ulysses" runs the all-to-all head↔sequence reshard
+    (wins when num_heads >= sp and the per-device sequence is short).
+    No-op when the active mesh has sp=1, so the same model config runs
+    anywhere. Attention-probability dropout is not supported under either
+    sp mode (raises)."""
     B, L, E3 = qkv.shape
     H = num_heads
     D = E3 // 3 // H
@@ -471,12 +474,18 @@ def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
                 "sequence parallelism; configure the model with "
                 "attn_dropout=0 (hidden dropout is unaffected)")
         from ..parallel.ring_attention import ring_attention, sp_self_attention
+        if seq_parallel == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+            inner = ulysses_attention
+        else:                           # True / "ring"
+            inner = ring_attention
         if in_manual("sp"):
             # already inside a shard_map that controls sp (pipeline stage):
-            # arrays are per-shard, use the ring collectives directly
-            out = ring_attention(q, k, v, "sp", mask=mask, causal=causal)
+            # arrays are per-shard, use the sp collectives directly
+            out = inner(q, k, v, "sp", mask=mask, causal=causal)
         else:
-            out = sp_self_attention(q, k, v, mask=mask, causal=causal)
+            out = sp_self_attention(q, k, v, mask=mask, causal=causal,
+                                    inner=inner)
     else:
         out = flash_attention_op(q, k, v, mask=mask, causal=causal,
                                  dropout=dropout, _training=_training)

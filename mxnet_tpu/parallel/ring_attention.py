@@ -334,13 +334,16 @@ def ring_self_attention(q, k, v, mask=None, causal=False, mesh=None,
 
 
 def sp_self_attention(q, k, v, mask=None, causal=False, mesh=None,
-                      axis_name="sp"):
+                      axis_name="sp", inner=None):
     """Ring attention inside a FULL training mesh: shard_map over every mesh
     axis with batch kept on the data axes, heads on `tp` (when divisible)
     and the sequence on `axis_name`, so it composes with dp/fsdp/tp GSPMD
     sharding in a jitted train step (the flagship sp path — SURVEY §5.7).
 
-    q,k,v: GLOBAL (B, H, L, D); mask: global (B, L)."""
+    q,k,v: GLOBAL (B, H, L, D); mask: global (B, L).
+    inner: the per-shard attention (q, k, v, axis_name, mask=, causal=) —
+    defaults to `ring_attention`; pass `ulysses.ulysses_attention` for the
+    all-to-all head↔sequence reshard instead of the ring."""
     from jax import shard_map
 
     mesh = mesh or current_mesh()
@@ -361,17 +364,17 @@ def sp_self_attention(q, k, v, mask=None, causal=False, mesh=None,
     hspec = "tp" if (tp > 1 and H % tp == 0) else None
     qspec = P(bspec, hspec, axis_name, None)
     mspec = P(bspec, axis_name)
+    attn = inner or ring_attention
 
     if mask is not None:
         fn = shard_map(
-            lambda q_, k_, v_, m_: ring_attention(
+            lambda q_, k_, v_, m_: attn(
                 q_, k_, v_, axis_name, mask=m_, causal=causal),
             mesh=mesh, in_specs=(qspec, qspec, qspec, mspec), out_specs=qspec,
             check_vma=False)
         return fn(q, k, v, mask)
     fn = shard_map(
-        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
-                                          causal=causal),
+        lambda q_, k_, v_: attn(q_, k_, v_, axis_name, causal=causal),
         mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
         check_vma=False)
     return fn(q, k, v)

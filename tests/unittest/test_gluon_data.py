@@ -64,3 +64,60 @@ def test_totensor_normalize():
     norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))
     out2 = norm(out)
     np.testing.assert_allclose(out2.asnumpy(), 1.0)
+
+
+def test_dataloader_process_workers_order_and_values():
+    """num_workers>0 with thread_pool=False (the reference default) runs
+    forked worker PROCESSES; iteration order and values must match
+    num_workers=0 exactly, closures in transforms included (fork)."""
+    import numpy as np
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    y = np.arange(16, dtype=np.float32)
+    scale = 3.0                                   # captured by the closure
+    ds = ArrayDataset(X, y).transform_first(lambda x: x * scale)
+    ref = [(d.asnumpy(), l.asnumpy())
+           for d, l in DataLoader(ds, batch_size=5, num_workers=0)]
+    got = [(d.asnumpy(), l.asnumpy())
+           for d, l in DataLoader(ds, batch_size=5, num_workers=3)]
+    assert len(ref) == len(got) == 4
+    for (rd, rl), (gd, gl) in zip(ref, got):
+        np.testing.assert_array_equal(rd, gd)
+        np.testing.assert_array_equal(rl, gl)
+
+
+def test_dataloader_process_worker_error_propagates():
+    import numpy as np
+    import pytest
+    from mxnet_tpu.gluon.data import SimpleDataset, DataLoader
+
+    def bad(x):
+        raise ValueError("boom in worker")
+
+    ds = SimpleDataset(list(np.arange(8, dtype=np.float32))).transform(bad)
+    with pytest.raises(RuntimeError, match="boom in worker"):
+        list(DataLoader(ds, batch_size=4, num_workers=2))
+
+
+def test_dataloader_process_workers_numpy_transform_chain():
+    """The standard transforms Compose (RandomResizedCrop/Flip/ToTensor/
+    Normalize) is numpy-type-preserving, so it runs inside forked worker
+    processes end to end."""
+    import numpy as np
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (12, 32, 32, 3), np.uint8)
+    labels = np.arange(12, dtype=np.float32)
+    tf = T.Compose([T.RandomResizedCrop(16), T.RandomFlipLeftRight(),
+                    T.ToTensor(), T.Normalize(mean=0.5, std=0.25)])
+    ds = ArrayDataset(imgs, labels).transform_first(tf)
+    batches = list(DataLoader(ds, batch_size=4, num_workers=2))
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == (4, 3, 16, 16)
+    assert str(x.dtype) == "float32"
+    got_labels = np.concatenate([b[1].asnumpy() for b in batches])
+    np.testing.assert_array_equal(np.sort(got_labels), labels)
