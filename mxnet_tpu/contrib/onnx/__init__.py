@@ -11,11 +11,13 @@ reshape/transpose/split/squeeze/expand_dims/slice_axis, STRIDED slice
 LSTM/GRU export+import with the flat cuDNN vector re-laid-out to ONNX
 W/R/B (gate reorder, per-layer nodes). Import constant-propagates
 Shape/Gather/Concat/Cast/arith chains (the PyTorch-exporter flatten
-idiom) at the graph's static input shapes. Multi-output (Group'd) graphs
-export/import. Still NOT covered: control flow (Loop/If), bidirectional
-or vanilla-activation RNN, GRU with linear_before_reset=0, genuinely
-dynamic shapes (a Shape chain that static inference cannot resolve
-raises). Serialization is the in-tree wire codec (`_proto.py`) — the
+idiom) at the graph's static input shapes; nearest-Resize maps to/from
+UpSampling. Multi-output (Group'd) graphs export/import. RNN covers
+unidirectional AND bidirectional LSTM/GRU. Still NOT covered: control
+flow (Loop/If), vanilla-activation RNN, GRU with linear_before_reset=0,
+sequence_lens on RNN nodes, genuinely dynamic shapes (a Shape chain that
+static inference cannot resolve raises).
+Serialization is the in-tree wire codec (`_proto.py`) — the
 environment bakes no `onnx` package, but files written here follow the
 public ONNX IR (opset 13) byte for byte.
 
@@ -72,20 +74,23 @@ def _gate_reorder(mat, order, H):
     return blocks[order].reshape(mat.shape)
 
 
-def _rnn_unpack_np(flat, ngates, num_layers, input_size, state_size):
-    """numpy mirror of ops.rnn_ops.unpack_rnn_params (unidirectional)."""
+def _rnn_unpack_np(flat, ngates, num_layers, input_size, state_size,
+                   dirs=1):
+    """numpy mirror of ops.rnn_ops.unpack_rnn_params: one dict per
+    (layer, direction), layer-major then direction (fwd, bwd)."""
     H, out, off = state_size, [], 0
     for layer in range(num_layers):
-        isz = input_size if layer == 0 else H
-        wi = flat[off:off + ngates * H * isz].reshape(ngates * H, isz)
-        off += ngates * H * isz
-        wh = flat[off:off + ngates * H * H].reshape(ngates * H, H)
-        off += ngates * H * H
-        out.append({"wi": wi, "wh": wh})
-    for layer in range(num_layers):
-        out[layer]["bi"] = flat[off:off + ngates * H]
+        for _ in range(dirs):
+            isz = input_size if layer == 0 else H * dirs
+            wi = flat[off:off + ngates * H * isz].reshape(ngates * H, isz)
+            off += ngates * H * isz
+            wh = flat[off:off + ngates * H * H].reshape(ngates * H, H)
+            off += ngates * H * H
+            out.append({"wi": wi, "wh": wh})
+    for ent in out:
+        ent["bi"] = flat[off:off + ngates * H]
         off += ngates * H
-        out[layer]["bh"] = flat[off:off + ngates * H]
+        ent["bh"] = flat[off:off + ngates * H]
         off += ngates * H
     if off != flat.size:
         raise ValueError(f"RNN flat param size {flat.size} != expected {off}")
@@ -365,6 +370,19 @@ def _export_node(node, in_names, out_names, consts, param_values=None):
         return n1("Softmax", {"axis": -1}, inputs=[in_names[0]])
     if op == "Dropout":
         return n1("Dropout", inputs=[in_names[0]])
+    if op == "UpSampling":
+        if _attr(a, "sample_type", "nearest") != "nearest":
+            raise NotImplementedError(
+                "ONNX export: only nearest UpSampling")
+        s = float(_attr(a, "scale", 2))
+        # asymmetric+floor nearest == np.repeat semantics (the op's impl)
+        return n1("Resize",
+                  inputs=[in_names[0], "",
+                          const("scales",
+                                np.asarray([1.0, 1.0, s, s], np.float32))],
+                  attrs={"mode": "nearest",
+                         "coordinate_transformation_mode": "asymmetric",
+                         "nearest_mode": "floor"})
     if op == "RNN":
         return _export_rnn(node, in_names, out_names, consts, param_values)
     raise NotImplementedError(f"ONNX export: op '{op}' not in the "
@@ -385,10 +403,8 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
         raise NotImplementedError(
             f"ONNX export: RNN mode '{mode}' (vanilla) has no opset-13 "
             "node with matching semantics — use lstm/gru")
-    if _attr(a, "bidirectional", False):
-        raise NotImplementedError(
-            "ONNX export: bidirectional RNN unsupported (unidirectional "
-            "only)")
+    bidir = bool(_attr(a, "bidirectional", False))
+    dirs = 2 if bidir else 1
     H = int(_attr(a, "state_size"))
     L = int(_attr(a, "num_layers", 1))
     ngates = 4 if mode == "lstm" else 3
@@ -398,10 +414,10 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
             "initializer (got a computed input)")
     flat = np.asarray(param_values[in_names[1]], np.float32).ravel()
     # solve the input size from the flat length (layer 0 is the only one
-    # whose input dim differs)
-    rest = (L - 1) * ngates * H * (2 * H + 2)
-    I = (flat.size - rest) // (ngates * H) - H - 2
-    layers = _rnn_unpack_np(flat, ngates, L, I, H)
+    # whose input dim differs; layers >0 consume dirs*H features)
+    rest = (L - 1) * dirs * ngates * H * (dirs * H + H + 2)
+    I = (flat.size - rest) // (dirs * ngates * H) - H - 2
+    layers = _rnn_unpack_np(flat, ngates, L, I, H, dirs=dirs)
 
     order = _LSTM_TO_ONNX if mode == "lstm" else _GRU_TO_ONNX
     onnx_op = "LSTM" if mode == "lstm" else "GRU"
@@ -429,26 +445,34 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
 
     nodes, x = [], in_names[0]
     h_outs, c_outs = [], []
-    for l, ly in enumerate(layers):
-        W = const(f"W{l}", _gate_reorder(ly["wi"], order, H)[None])
-        R = const(f"R{l}", _gate_reorder(ly["wh"], order, H)[None])
-        B = const(f"B{l}", np.concatenate(
-            [_gate_reorder(ly["bi"], order, H),
-             _gate_reorder(ly["bh"], order, H)])[None])
+    for l in range(L):
+        ents = [layers[l * dirs + d] for d in range(dirs)]
+        W = const(f"W{l}", np.stack(
+            [_gate_reorder(e["wi"], order, H) for e in ents]))
+        R = const(f"R{l}", np.stack(
+            [_gate_reorder(e["wh"], order, H) for e in ents]))
+        B = const(f"B{l}", np.stack(
+            [np.concatenate([_gate_reorder(e["bi"], order, H),
+                             _gate_reorder(e["bh"], order, H)])
+             for e in ents]))
         ins = [x, W, R, B]
         if h0 is not None or c0 is not None:
-            # state arrays are (L, N, H); ONNX wants (1, N, H) per node.
+            # state arrays are (L*dirs, N, H); ONNX wants (dirs, N, H).
             # When only one of h0/c0 is nonzero the other is explicit zeros.
             N = (h0 if h0 is not None else c0).shape[1]
-            zeros = np.zeros((1, N, H), np.float32)
+            zeros = np.zeros((dirs, N, H), np.float32)
             ins.append("")                      # sequence_lens: absent
             ins.append(const(f"h0_{l}",
-                             h0[l][None] if h0 is not None else zeros))
+                             h0[l * dirs:(l + 1) * dirs]
+                             if h0 is not None else zeros))
             if mode == "lstm":
                 ins.append(const(f"c0_{l}",
-                                 c0[l][None] if c0 is not None else zeros))
+                                 c0[l * dirs:(l + 1) * dirs]
+                                 if c0 is not None else zeros))
         y, yh, yc = f"{nm}_l{l}_Y", f"{nm}_l{l}_Yh", f"{nm}_l{l}_Yc"
         attrs = {"hidden_size": H}
+        if bidir:
+            attrs["direction"] = "bidirectional"
         if mode == "gru":
             attrs["linear_before_reset"] = 1    # our GRU cell's semantics
         nodes.append(P.node(onnx_op, ins, [y, yh] +
@@ -456,13 +480,23 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
                             name=f"{nm}_l{l}", attrs=attrs))
         h_outs.append(yh)
         c_outs.append(yc)
-        # Y is (T, dirs=1, N, H): squeeze the direction axis for the next
-        # layer / the final output
-        sq = out_names[0] if l == L - 1 else f"{nm}_l{l}_sq"
-        nodes.append(P.node("Squeeze",
-                            [y, const(f"sqax{l}", np.asarray([1], np.int64))],
-                            [sq], name=f"{nm}_l{l}_squeeze"))
-        x = sq
+        # Y is (T, dirs, N, H) -> (T, N, dirs*H) for the next layer / the
+        # final output: squeeze when dirs=1, transpose+reshape when 2
+        nxt = out_names[0] if l == L - 1 else f"{nm}_l{l}_flat"
+        if dirs == 1:
+            nodes.append(P.node(
+                "Squeeze", [y, const(f"sqax{l}", np.asarray([1], np.int64))],
+                [nxt], name=f"{nm}_l{l}_squeeze"))
+        else:
+            tr = f"{nm}_l{l}_tr"
+            nodes.append(P.node("Transpose", [y], [tr],
+                                name=f"{nm}_l{l}_transpose",
+                                attrs={"perm": [0, 2, 1, 3]}))
+            nodes.append(P.node(
+                "Reshape",
+                [tr, const(f"rs{l}", np.asarray([0, 0, dirs * H], np.int64))],
+                [nxt], name=f"{nm}_l{l}_reshape"))
+        x = nxt
     if len(out_names) > 1:                       # state_outputs=True
         nodes.append(P.node("Concat", h_outs, [out_names[1]],
                             name=f"{nm}_hn", attrs={"axis": 0}))
@@ -759,6 +793,39 @@ def _import_node(n, sym_of, sym_mod, inits, ctx=None):
         return sym_mod.softmax(ins[0], axis=a.get("axis", -1), name=name)
     if op == "Dropout":
         return ins[0]
+    if op == "Resize":
+        mode = a.get("mode", b"nearest")
+        if mode not in ("nearest", b"nearest"):
+            raise NotImplementedError(
+                f"ONNX import: Resize mode {mode!r} unsupported (nearest "
+                "only)")
+        # UpSampling == np.repeat. Exactly two attr combinations equal it
+        # for integer scales: asymmetric+floor, and the ONNX DEFAULTS
+        # half_pixel+round_prefer_floor. Anything else (ceil,
+        # align_corners, ...) would import silently WRONG — raise instead.
+        ctm = a.get("coordinate_transformation_mode", b"half_pixel")
+        ctm = ctm.decode() if isinstance(ctm, bytes) else ctm
+        nmode = a.get("nearest_mode", b"round_prefer_floor")
+        nmode = nmode.decode() if isinstance(nmode, bytes) else nmode
+        if (ctm, nmode) not in (("asymmetric", "floor"),
+                                ("half_pixel", "round_prefer_floor")):
+            raise NotImplementedError(
+                f"ONNX import: Resize with coordinate_transformation_mode="
+                f"{ctm!r} nearest_mode={nmode!r} does not match repeat "
+                "semantics")
+        scales = const_in(2)
+        if scales is None or np.asarray(scales).size == 0:
+            raise NotImplementedError(
+                "ONNX import: Resize without a scales initializer "
+                "(sizes-based or computed Resize unsupported)")
+        sc = np.asarray(scales, np.float64).ravel()
+        if len(sc) != 4 or sc[0] != 1 or sc[1] != 1 or sc[2] != sc[3] \
+                or sc[2] != round(sc[2]):
+            raise NotImplementedError(
+                f"ONNX import: Resize scales {sc.tolist()} unsupported "
+                "(integer NCHW spatial upscale only)")
+        return sym_mod.UpSampling(ins[0], scale=int(sc[2]),
+                                  sample_type="nearest", name=name)
     if op in ("LSTM", "GRU"):
         return _import_rnn(n, ins, sym_mod, const_in, ctx, name)
     raise NotImplementedError(f"ONNX import: op '{op}' not in the "
@@ -769,10 +836,13 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
     """One ONNX LSTM/GRU node -> sym.RNN with a repacked flat cuDNN
     parameter vector (inverse of _export_rnn's re-layout)."""
     op, a = n["op_type"], n["attrs"]
-    if a.get("direction", b"forward") not in ("forward", b"forward"):
+    direction = a.get("direction", b"forward")
+    if isinstance(direction, bytes):
+        direction = direction.decode()
+    if direction not in ("forward", "bidirectional"):
         raise NotImplementedError(
-            f"ONNX import: {op} direction "
-            f"'{a.get('direction')}' unsupported (forward only)")
+            f"ONNX import: {op} direction '{direction}' unsupported")
+    bidir = direction == "bidirectional"
     if a.get("activations"):
         raise NotImplementedError(
             f"ONNX import: {op} with custom activations unsupported")
@@ -793,20 +863,22 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
         raise NotImplementedError(
             f"ONNX import: {op} weights must be initializers")
     W, R = np.asarray(W, np.float32), np.asarray(R, np.float32)
-    if W.shape[0] != 1:
+    dirs = 2 if bidir else 1
+    if W.shape[0] != dirs:
         raise NotImplementedError(
-            f"ONNX import: {op} num_directions {W.shape[0]} unsupported")
-    W, R = W[0], R[0]
+            f"ONNX import: {op} num_directions {W.shape[0]} does not match "
+            f"direction '{direction}'")
     if B is None:
-        B = np.zeros((2 * ngates * H,), np.float32)
+        B = np.zeros((dirs, 2 * ngates * H), np.float32)
     else:
-        B = np.asarray(B, np.float32)[0]
+        B = np.asarray(B, np.float32)
     order = _LSTM_FROM_ONNX if mode == "lstm" else _GRU_FROM_ONNX
-    layer = {"wi": _gate_reorder(W, order, H),
-             "wh": _gate_reorder(R, order, H),
-             "bi": _gate_reorder(B[:ngates * H], order, H),
-             "bh": _gate_reorder(B[ngates * H:], order, H)}
-    flat = _rnn_pack_np([layer], ngates, H)
+    entries = [{"wi": _gate_reorder(W[d], order, H),
+                "wh": _gate_reorder(R[d], order, H),
+                "bi": _gate_reorder(B[d][:ngates * H], order, H),
+                "bh": _gate_reorder(B[d][ngates * H:], order, H)}
+               for d in range(dirs)]
+    flat = _rnn_pack_np(entries, ngates, H)
 
     pname = f"{name or 'rnn'}_parameters"
     ctx["extra_params"][pname] = flat
@@ -828,14 +900,14 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
             ctx["folded_inits"].add(nm_)
             arr = np.asarray(v, np.float32)
         else:
-            arr = np.zeros((1, N, H), np.float32)
+            arr = np.zeros((dirs, N, H), np.float32)
         sname = f"{name or 'rnn'}_{tag}"
         ctx["extra_params"][sname] = arr
         return sym_mod.var(sname, shape=arr.shape)
 
     h0 = state_sym(5, "state")
     kw = {"state_size": H, "num_layers": 1, "mode": mode,
-          "state_outputs": True}
+          "state_outputs": True, "bidirectional": bidir}
     if mode == "lstm":
         c0 = state_sym(6, "state_cell")
         out = sym_mod.RNN(ins[0], p_sym, h0, c0, **kw)
@@ -843,8 +915,14 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
     else:
         out = sym_mod.RNN(ins[0], p_sym, h0, **kw)
         y, hn, cn = out[0], out[1], None
-    # ONNX Y is (T, num_dirs=1, N, H); ours is (T, N, H)
-    y4 = sym_mod.expand_dims(y, axis=1)
+    # ONNX Y is (T, num_dirs, N, H); ours is (T, N, dirs*H)
+    if bidir:
+        T_len = ctx["static_shape"](y)[0]
+        y4 = sym_mod.transpose(
+            sym_mod.reshape(y, shape=(T_len, N, dirs, H)),
+            axes=(0, 2, 1, 3))
+    else:
+        y4 = sym_mod.expand_dims(y, axis=1)
     outs = [y4, hn] + ([cn] if mode == "lstm" else [])
     n_declared = max(1, len([o for o in n["outputs"] if o]))
     # single declared output -> a Symbol (the caller stores it unwrapped)
@@ -877,7 +955,8 @@ def import_model(onnx_file):
     consumed = set()
     _SHAPE_INPUTS = {"Reshape": [1], "Squeeze": [1], "Unsqueeze": [1],
                      "Slice": [1, 2, 3, 4], "Gather": [1],
-                     "LSTM": [1, 2, 3], "GRU": [1, 2, 3]}
+                     "LSTM": [1, 2, 3], "GRU": [1, 2, 3],
+                     "Resize": [1, 2, 3]}
     _CONST_TAGS = ("_scalar", "_one", "_half", "_eps", "_sqrt2", "_c",
                    "_s2pi")
     # this exporter records its decomposition constants in metadata; for

@@ -26,7 +26,17 @@ from ...ndarray import ndarray as _nd
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn",
+           "in_worker"]
+
+_IN_WORKER = False
+
+
+def in_worker():
+    """True inside a forked DataLoader worker process. Dataset __getitem__
+    implementations use this to return host numpy instead of device
+    arrays — jax/XLA must not run in a forked child."""
+    return _IN_WORKER
 
 
 def default_batchify_fn(data):
@@ -81,6 +91,8 @@ def _assert_numpy_tree(batch):
 
 def _worker_loop(dataset, batchify_fn, key_q, data_q, seed):
     """Forked worker body: indices in, (idx, numpy batch | error) out."""
+    global _IN_WORKER
+    _IN_WORKER = True                   # datasets switch to numpy returns
     # fork copies the parent RNG state into EVERY worker: reseed per worker
     # or all workers draw identical crop/flip augmentation streams
     np.random.seed(seed)
@@ -198,17 +210,31 @@ class DataLoader:
                     continue
                 if recvd >= sent:       # nothing in flight, nothing buffered
                     break
-                while True:             # bounded get: a worker that died
-                    try:                # without replying must not hang us
+                import os as _os
+                stall_limit = float(_os.environ.get(
+                    "MXNET_TPU_DATALOADER_TIMEOUT", "300"))
+                waited = 0.0
+                while True:             # bounded get: a worker that died OR
+                    try:                # deadlocked must not hang us forever
                         idx, batch, err = data_q.get(timeout=5)
                         break
                     except queue.Empty:
+                        waited += 5
                         dead = [w for w in workers if not w.is_alive()]
                         if dead:
                             raise RuntimeError(
                                 f"DataLoader worker (pid {dead[0].pid}) "
                                 f"died with exit code {dead[0].exitcode} "
                                 "without reporting a result") from None
+                        if waited >= stall_limit:
+                            raise RuntimeError(
+                                f"DataLoader workers produced no batch for "
+                                f"{waited:.0f}s — likely a jax/XLA call "
+                                "deadlocked inside a forked worker (keep "
+                                "transforms numpy-only, or use "
+                                "thread_pool=True). Override the limit "
+                                "with MXNET_TPU_DATALOADER_TIMEOUT."
+                            ) from None
                 recvd += 1
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")

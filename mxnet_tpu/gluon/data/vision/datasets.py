@@ -36,7 +36,10 @@ class _DownloadedDataset(Dataset):
         return len(self._label)
 
     def __getitem__(self, idx):
-        data = _nd.array(self._data[idx])
+        from ..dataloader import in_worker
+        # forked DataLoader workers must stay off the device: hand the
+        # (numpy-type-preserving) transform chain host arrays there
+        data = self._data[idx] if in_worker() else _nd.array(self._data[idx])
         label = self._label[idx]
         if self._transform is not None:
             return self._transform(data, label)
@@ -197,7 +200,8 @@ class ImageRecordDataset(Dataset):
         header, img_bytes = recordio.unpack(raw)
         img = recordio.imdecode(img_bytes, self._flag)
         label = header.label
-        data = _nd.array(img)
+        from ..dataloader import in_worker
+        data = img if in_worker() else _nd.array(img)
         if self._transform is not None:
             return self._transform(data, label)
         return data, label

@@ -121,3 +121,20 @@ def test_dataloader_process_workers_numpy_transform_chain():
     assert str(x.dtype) == "float32"
     got_labels = np.concatenate([b[1].asnumpy() for b in batches])
     np.testing.assert_array_equal(np.sort(got_labels), labels)
+
+
+def test_dataloader_process_workers_builtin_vision_dataset():
+    """Built-in vision datasets hand numpy to forked workers (in_worker()
+    switches __getitem__ off the device path) — CIFAR-style training with
+    num_workers>0 must work, not deadlock or raise."""
+    import numpy as np
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import SyntheticGratings, transforms as T
+
+    tf = T.Compose([T.ToTensor()])
+    ds = SyntheticGratings(train=False).transform_first(tf)
+    batches = list(DataLoader(ds, batch_size=32, num_workers=2))
+    assert sum(b[0].shape[0] for b in batches) == len(ds)
+    ref = list(DataLoader(ds, batch_size=32, num_workers=0))
+    np.testing.assert_allclose(batches[0][0].asnumpy(),
+                               ref[0][0].asnumpy(), rtol=1e-6)
