@@ -22,7 +22,8 @@ from ..gluon import nn as _nn
 from ..gluon.block import HybridBlock
 
 __all__ = ["quantize_params", "QuantizedDense", "QuantizedConv2D",
-    "quantize_block", "CalibrationCollector", "quantize_model"]
+    "quantize_block", "CalibrationCollector", "quantize_model",
+    "quantize_symbol_model"]
 
 INT8_MAX = 127.0
 
@@ -262,10 +263,169 @@ def _swap_quantizable(block, collector, mode, prefix=""):
 
 def quantize_model(sym=None, arg_params=None, aux_params=None, net=None,
                    calib_data=None, calib_mode="naive", **kwargs):
-    """Reference-shaped entry point. The symbolic path quantizes a gluon
-    net; pass `net=` (preferred) or convert the symbol first."""
-    if net is None:
+    """Reference-shaped entry point (reference: contrib/quantization.py
+    quantize_model). Two paths:
+      * net=block          -> gluon path, returns the quantized block
+      * sym= + arg_params= -> symbolic graph rewrite, returns
+                              (qsym, qarg_params, aux_params)"""
+    if net is not None:
+        return quantize_block(net, calib_data, calib_mode)
+    if sym is None or arg_params is None:
+        raise ValueError("pass net=, or sym= plus arg_params=")
+    return quantize_symbol_model(sym, arg_params, aux_params,
+                                 calib_data=calib_data,
+                                 calib_mode=calib_mode, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# symbolic path (reference: `python/mxnet/contrib/quantization.py`
+# quantize_model over a Symbol + params — the Module-era API)
+# --------------------------------------------------------------------------
+
+
+def quantize_symbol_model(sym, arg_params, aux_params=None, calib_data=None,
+                          calib_mode="naive", data_name="data",
+                          excluded_sym_names=(), quantized_dtype="int8",
+                          num_calib_examples=None, ctx=None, label_names=None,
+                          logger=None):
+    """Graph-rewrite quantization of a Symbol: every FullyConnected /
+    Convolution(2D) whose weight is a known parameter becomes a
+    `_contrib_quantized_dense` / `_contrib_quantized_conv2d` node with an
+    offline-quantized int8 weight + per-output-channel scale params.
+
+    calib_data: iterable of input batches (numpy or NDArray). When given,
+    a calibration executor captures every quantizable node's INPUT
+    activation (via the internal heads, so residual graphs calibrate
+    correctly) and bakes static act_scales; else activations quantize
+    dynamically per batch.
+
+    Reference-compat kwargs: `excluded_sym_names` skips nodes by name,
+    `num_calib_examples` caps calibration batches, `quantized_dtype` must
+    be int8/auto (uint8 has no MXU path), `ctx`/`label_names`/`logger`
+    are accepted and ignored (the executor is placement-free here).
+
+    Returns (qsym, qarg_params, aux_params)."""
+    if quantized_dtype not in ("int8", "auto"):
         raise NotImplementedError(
-            "symbolic quantize_model is not supported; pass a gluon block "
-            "via net= (see quantize_block)")
-    return quantize_block(net, calib_data, calib_mode)
+            f"quantized_dtype {quantized_dtype!r} unsupported (int8 only — "
+            "the MXU's native low-precision integer path)")
+    excluded = set(excluded_sym_names or ())
+    from ..symbol import Symbol, _Node
+    from .. import nd as _ndm
+    from .. import context as _ctx
+
+    aux_params = aux_params or {}
+
+    def np_of(v):
+        return v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+    topo = sym._topo_nodes()
+    quant_ids = {}                     # id(node) -> weight var node
+    for node in topo:
+        if node.op not in ("FullyConnected", "Convolution"):
+            continue
+        if node.name in excluded:
+            continue
+        if len(node.inputs) < 2:
+            continue
+        wsrc, _ = node.inputs[1]
+        if not (wsrc.is_var and wsrc.name in arg_params):
+            continue
+        if node.op == "Convolution":
+            w_shape = np_of(arg_params[wsrc.name]).shape
+            if len(w_shape) != 4:      # 2-D convs only (NCHW int8 path)
+                continue
+        quant_ids[id(node)] = wsrc
+
+    # ---- calibration pass over the ORIGINAL graph's internal heads ----
+    act_scales = {}
+    if calib_data is not None and quant_ids:
+        nodes = [n for n in topo if id(n) in quant_ids]
+        heads = Symbol([n.inputs[0] for n in nodes])
+        batches = list(calib_data)
+        if num_calib_examples is not None:
+            batches = batches[:max(1, int(num_calib_examples))]
+        first = np_of(batches[0])
+        ex = heads.simple_bind(ctx=_ctx.cpu(), grad_req="null",
+                               **{data_name: first.shape})
+        for name, arr in ex.arg_dict.items():
+            if name != data_name and name in arg_params:
+                arr[:] = arg_params[name]
+        for name, arr in ex.aux_dict.items():
+            if name in aux_params:
+                arr[:] = aux_params[name]
+        collector = CalibrationCollector(calib_mode)
+        for batch in batches:
+            outs = ex.forward(is_train=False, **{data_name: np_of(batch)})
+            for n, out in zip(nodes, outs):
+                collector.collect(n.name, out)
+        act_scales = {n.name: collector.scale(n.name) for n in nodes}
+
+    # ---- rebuild the DAG with quantized replacements ----
+    qargs = {k: v for k, v in arg_params.items()}
+    memo = {}
+    pinned_vars = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_var:
+            memo[id(node)] = node
+            return node
+        new_inputs = [(rebuild(s), i) for s, i in node.inputs]
+        if id(node) in quant_ids:
+            wname = quant_ids[id(node)].name
+            w = np_of(arg_params[wname]).astype(np.float32)
+            scale = _per_channel_scales(w.reshape(w.shape[0], -1),
+                                        calib_mode)
+            w_q = np.clip(np.round(
+                w / scale.reshape((-1,) + (1,) * (w.ndim - 1))),
+                -127, 127).astype(np.int8)
+            wq_var = _Node(None, f"{node.name}_weight_quantized",
+                           shape=w_q.shape, dtype="int8")
+            ws_var = _Node(None, f"{node.name}_weight_scale",
+                           shape=scale.shape, dtype="float32")
+            qargs.pop(wname, None)
+            qargs[wq_var.name] = _ndm.array(w_q)
+            qargs[ws_var.name] = _ndm.array(scale.astype(np.float32))
+            ins = [new_inputs[0], (wq_var, 0), (ws_var, 0)]
+            if len(new_inputs) > 2:    # bias travels unquantized (f32) —
+                bsrc, bidx = new_inputs[2]
+                # pin its shape on the var: the generic (schema-less)
+                # quantized op cannot BACK-infer input shapes the way the
+                # Convolution/FC schema rules did. Keyed by NAME so a var
+                # shared by several consumers rebuilds exactly once (two
+                # same-name nodes would corrupt list_arguments()).
+                if bsrc.is_var and bsrc.name in arg_params:
+                    nb = pinned_vars.get(bsrc.name)
+                    if nb is None:
+                        nb = _Node(None, bsrc.name,
+                                   shape=np_of(arg_params[bsrc.name]).shape)
+                        pinned_vars[bsrc.name] = nb
+                    memo[id(bsrc)] = nb
+                    bsrc = nb
+                ins.append((bsrc, bidx))
+            a = node.attrs
+            act = float(act_scales.get(node.name, -1.0) or -1.0)
+            if node.op == "FullyConnected":
+                attrs = {"act_scale": act,
+                         "num_hidden": a.get("num_hidden") or w.shape[0],
+                         "flatten": bool(a.get("flatten", True))}
+                qnode = _Node("_contrib_quantized_dense",
+                              f"{node.name}_quantized", ins, attrs)
+            else:
+                attrs = {"act_scale": act,
+                         "stride": a.get("stride"),
+                         "pad": a.get("pad"),
+                         "dilate": a.get("dilate"),
+                         "num_group": int(a.get("num_group", 1))}
+                qnode = _Node("_contrib_quantized_conv2d",
+                              f"{node.name}_quantized", ins, attrs)
+            memo[id(node)] = qnode
+            return qnode
+        nnode = _Node(node.op, node.name, new_inputs, node.attrs)
+        memo[id(node)] = nnode
+        return nnode
+
+    qsym = Symbol([(rebuild(n), i) for n, i in sym._heads])
+    return qsym, qargs, dict(aux_params)

@@ -490,3 +490,62 @@ def fused_self_attention(qkv, mask=None, num_heads=1, causal=False,
         out = flash_attention_op(q, k, v, mask=mask, causal=causal,
                                  dropout=dropout, _training=_training)
     return out.transpose(0, 2, 1, 3).reshape(B, L, H * D)
+
+
+# --------------------------------------------------------------------------
+# int8 inference ops (reference: `src/operator/quantization/
+# quantized_fully_connected.cc` / `quantized_conv.cc`). Weight arrives
+# pre-quantized (int8 + per-output-channel f32 scales — the offline half
+# done by contrib.quantization.quantize_model); activation quantizes on the
+# fly with a calibrated static scale when act_scale > 0, else per-batch
+# dynamic. int8 x int8 -> int32 accumulate is the MXU's native int8 path.
+# --------------------------------------------------------------------------
+
+
+def _quantize_act(data, act_scale):
+    """(f32 data, calibrated scale or <=0) -> (int8 data, f32 scale).
+    The ONE activation-quantize implementation both int8 ops share."""
+    if act_scale and float(act_scale) > 0:
+        s_x = jnp.float32(act_scale)
+    else:
+        s_x = jnp.maximum(jnp.abs(data).max(), 1e-8) / 127.0
+    return jnp.clip(jnp.round(data / s_x), -127, 127).astype(jnp.int8), s_x
+
+
+@register("_contrib_quantized_dense")
+def quantized_dense(data, weight_q, weight_scale, bias=None, act_scale=-1.0,
+                    num_hidden=0, flatten=False, relu=False):
+    data = data.astype(jnp.float32)
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    x_q, s_x = _quantize_act(data, act_scale)
+    acc = lax.dot_general(
+        x_q, weight_q.astype(jnp.int8).T,
+        (((data.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_x * weight_scale)
+    if bias is not None:
+        out = out + bias
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out
+
+
+@register("_contrib_quantized_conv2d")
+def quantized_conv2d(data, weight_q, weight_scale, bias=None, act_scale=-1.0,
+                     stride=None, pad=None, dilate=None, num_group=1,
+                     relu=False):
+    data = data.astype(jnp.float32)
+    x_q, s_x = _quantize_act(data, act_scale)
+    acc = lax.conv_general_dilated(
+        x_q, weight_q.astype(jnp.int8), _pair(stride or 1),
+        [(p, p) for p in _pair(pad or 0)],
+        rhs_dilation=_pair(dilate or 1), feature_group_count=int(num_group),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (s_x * weight_scale)[None, :, None, None]
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)[None, :, None, None]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out

@@ -76,6 +76,10 @@ class Executor:
     @classmethod
     def _simple_bind(cls, sym, ctx, grad_req, shapes):
         shape_dict = sym._infer_shapes_dict(shapes)
+        # honor explicit var dtype hints (e.g. int8 quantized weights —
+        # allocating them f32 would silently 4x their inference footprint)
+        dtype_of = {n.name: n._dtype for n in sym._var_nodes()
+                    if n._dtype is not None}
         arg_dict, grad_dict, aux_dict = {}, {}, {}
         req = {}
         for name in sym.list_arguments():
@@ -83,7 +87,8 @@ class Executor:
                 raise MXNetError(
                     f"simple_bind: cannot infer shape of '{name}'; "
                     f"provide it explicitly")
-            arr = _nd.zeros(shape_dict[name])
+            arr = _nd.zeros(shape_dict[name],
+                            dtype=dtype_of.get(name, "float32"))
             arg_dict[name] = arr
             r = grad_req if isinstance(grad_req, str) \
                 else grad_req.get(name, "write")

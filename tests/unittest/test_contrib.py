@@ -252,3 +252,116 @@ def test_amp_move_op_between_lists(amp_initialized):
         amp.move_op("mean", "fp32")
     assert str(nd.mean(x).dtype) == "float32"
     assert "mean" in amp.list_fp32_ops()
+
+
+def test_symbolic_quantize_model_conv_net():
+    """Symbolic quantize_model (the former NotImplementedError wall):
+    Conv/FC nodes rewrite to _contrib_quantized_* with offline int8
+    weights + per-channel scales; calibrated outputs track fp32 closely;
+    the original weight params are gone from qarg_params."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import symbol as sym
+
+    data = sym.var("data")
+    x = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=8,
+                        name="c0")
+    x = sym.Activation(x, act_type="relu", name="r0")
+    x = sym.Convolution(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                        num_filter=16, no_bias=True, name="c1")
+    x = sym.Activation(x, act_type="relu", name="r1")
+    x = sym.Pooling(x, global_pool=True, pool_type="avg", name="gap")
+    x = sym.flatten(x, name="fl")
+    out = sym.FullyConnected(x, num_hidden=10, name="fc")
+
+    shape = (4, 3, 16, 16)
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = out.infer_shape(data=shape)
+    args = {}
+    for name, shp in zip(out.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        args[name] = nd.array((rs.normal(0, 0.2, shp)).astype(np.float32))
+
+    def run(net, params, x_in):
+        ex = net.simple_bind(ctx=mx.cpu(), data=x_in.shape)
+        for name, arr in ex.arg_dict.items():
+            if name != "data":
+                arr[:] = params[name]
+        return ex.forward(is_train=False, data=x_in)[0].asnumpy()
+
+    x_in = rs.normal(0, 1, shape).astype(np.float32)
+    ref = run(out, args, x_in)
+
+    calib = [rs.normal(0, 1, shape).astype(np.float32) for _ in range(3)]
+    qsym, qargs, qaux = quantization.quantize_model(
+        sym=out, arg_params=args, calib_data=calib)
+    ops = {n.op for n in qsym._topo_nodes() if not n.is_var}
+    assert "_contrib_quantized_conv2d" in ops
+    assert "_contrib_quantized_dense" in ops
+    assert "Convolution" not in ops and "FullyConnected" not in ops
+    assert "c0_weight" not in qargs and "fc_weight" not in qargs
+    assert str(qargs["c0_weight_quantized"].dtype) == "int8"
+    assert "c0_bias" in qargs            # bias stays f32
+
+    got = run(qsym, qargs, x_in)
+    assert got.shape == ref.shape
+    # int8 tolerance: logits within ~2% of the fp32 dynamic range
+    span = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.04 * span, \
+        (np.abs(got - ref).max(), span)
+
+    # dynamic (uncalibrated) path must run too
+    qsym2, qargs2, _ = quantization.quantize_model(sym=out, arg_params=args)
+    got2 = run(qsym2, qargs2, x_in)
+    assert np.abs(got2 - ref).max() < 0.04 * span
+
+
+def test_symbolic_quantize_reference_kwargs_and_shared_bias():
+    """Reference-shaped call compatibility (ctx/excluded_sym_names/...),
+    scalar conv attrs, exclusion honored, shared bias var stays UNIQUE in
+    list_arguments, and the bound int8 weight is stored int8."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import symbol as sym
+
+    rs = np.random.RandomState(2)
+    data = sym.var("data")
+    x = sym.Convolution(data, kernel=(3, 3), stride=2, pad=1, num_filter=4,
+                        name="c0")                     # SCALAR attrs
+    x = sym.flatten(sym.Pooling(x, global_pool=True, pool_type="avg"))
+    shared_b = sym.var("shared_bias")
+    f1 = sym.FullyConnected(x, num_hidden=4, bias=shared_b, name="f1")
+    f2 = sym.FullyConnected(x, num_hidden=4, bias=shared_b, name="f2")
+    out = f1 + f2
+    shape = (2, 3, 12, 12)
+    arg_shapes, _, _ = out.infer_shape(data=shape)
+    args = {n: nd.array(rs.normal(0, 0.2, s).astype(np.float32))
+            for n, s in zip(out.list_arguments(), arg_shapes) if n != "data"}
+
+    qsym, qargs, _ = quantization.quantize_model(
+        sym=out, arg_params=args, ctx=mx.cpu(),
+        excluded_sym_names=["f2"], quantized_dtype="auto",
+        calib_data=[rs.normal(0, 1, shape).astype(np.float32)] * 4,
+        num_calib_examples=2)
+    ops = [n.op for n in qsym._topo_nodes() if not n.is_var]
+    assert "FullyConnected" in ops          # f2 excluded -> stays float
+    assert ops.count("_contrib_quantized_dense") == 1
+    assert "_contrib_quantized_conv2d" in ops
+    names = qsym.list_arguments()
+    assert names.count("shared_bias") == 1, names
+
+    x_in = rs.normal(0, 1, shape).astype(np.float32)
+
+    def run(net, params):
+        ex = net.simple_bind(ctx=mx.cpu(), data=shape)
+        for name, arr in ex.arg_dict.items():
+            if name != "data":
+                arr[:] = params[name]
+        return ex, ex.forward(is_train=False, data=x_in)[0].asnumpy()
+
+    _, ref = run(out, args)
+    ex_q, got = run(qsym, qargs)
+    assert str(ex_q.arg_dict["c0_weight_quantized"].dtype) == "int8"
+    span = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.05 * span
