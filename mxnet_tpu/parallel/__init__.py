@@ -15,6 +15,7 @@ from .pipeline import (pipeline_apply, pipeline_shard_map,
                        pipeline_apply_hetero, PipelineTrainer,
                        SeqPipelineTrainer)
 from .distributed import init_distributed, is_distributed
+from .elastic import AutoCheckpoint
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .moe import moe_apply, moe_ffn
 
@@ -27,4 +28,4 @@ __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding"
            "pipeline_apply_hetero", "PipelineTrainer", "SeqPipelineTrainer",
            "init_distributed",
            "is_distributed", "ulysses_attention", "ulysses_self_attention",
-           "moe_apply", "moe_ffn"]
+           "moe_apply", "moe_ffn", "AutoCheckpoint"]

@@ -1,0 +1,168 @@
+"""Preemption-safe training: automatic checkpoint + resume.
+
+The reference has no elastic/failure-recovery subsystem (SURVEY §5.3 —
+its answer is manual `Module.save_checkpoint` plus operator discipline).
+On TPU this deserves to be first-class: preemptible/spot TPU slices get a
+SIGTERM grace window, and multi-host jobs restart from the latest step
+rather than from scratch.
+
+`AutoCheckpoint` wraps any trainer exposing `step / save_states /
+load_states / num_update` — ShardedTrainer, PipelineTrainer and
+SeqPipelineTrainer all do (the pipeline classes via
+PipelineCheckpointMixin). Checkpoints include the global RNG stream, so
+a resumed run replays the same dropout/shuffle draws:
+
+    ckpt = AutoCheckpoint(trainer, "/ckpts/run1", every_steps=500)
+    start = ckpt.restore_latest() or 0          # 0 on a fresh run
+    for step in range(start, total_steps):
+        loss = ckpt.step(data, labels)          # periodic + preemption save
+        if ckpt.preempted:
+            break                               # saved; exit cleanly
+
+Design points:
+  * saves happen only at STEP BOUNDARIES — a signal handler merely sets a
+    flag (async-signal-safe); saving from the signal frame mid-dispatch
+    could serialize half-updated device state.
+  * checkpoints are step-numbered orbax directories; a `DONE` marker file
+    written AFTER `save_states` returns makes partially-written
+    checkpoints (killed mid-save) invisible to `restore_latest`.
+  * retention keeps the newest `keep` complete checkpoints; deletion runs
+    on process 0 only (orbax shards are written per-host, the directory
+    layout is shared).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import weakref
+
+import jax
+
+__all__ = ["AutoCheckpoint"]
+
+_MARKER = "DONE"
+
+
+class AutoCheckpoint:
+    def __init__(self, trainer, directory, every_steps=500, keep=2,
+                 on_preemption=True, signals=(signal.SIGTERM,)):
+        self.trainer = trainer
+        self.directory = str(directory)
+        self.every_steps = int(every_steps)
+        self.keep = int(keep)
+        self._save_pending = False     # cleared once the boundary save runs
+        self._preempted = False        # sticky: "a signal arrived"
+        self._prev_handlers = {}
+        os.makedirs(self.directory, exist_ok=True)
+        if on_preemption:
+            # the handler holds only a WEAK reference: the process-global
+            # signal table must not keep the trainer (the largest object
+            # in the program) alive after the AutoCheckpoint is dropped
+            ref = weakref.ref(self)
+
+            def _handler(signum, frame, _ref=ref):
+                obj = _ref()
+                if obj is not None:
+                    obj._save_pending = True
+                    obj._preempted = True
+            for sig in signals:
+                try:
+                    self._prev_handlers[sig] = signal.signal(sig, _handler)
+                except (ValueError, OSError):
+                    pass               # non-main thread / restricted env
+
+    @property
+    def preempted(self):
+        """Sticky: True once a preemption signal has arrived (the boundary
+        save does NOT clear it — training loops break on it). Use
+        clear_preempted() if the grace window was rescinded."""
+        return self._preempted
+
+    def clear_preempted(self):
+        self._preempted = False
+        self._save_pending = False
+
+    def close(self):
+        """Restore previous signal handlers."""
+        for sig, h in self._prev_handlers.items():
+            try:
+                signal.signal(sig, h)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- steps
+    def step(self, *args, **kwargs):
+        loss = self.trainer.step(*args, **kwargs)
+        n = int(self.trainer.num_update)
+        if self._save_pending or (
+                self.every_steps > 0 and n % self.every_steps == 0):
+            self.save()
+            self._save_pending = False  # one boundary save per signal —
+            #                             NOT one per subsequent step
+        return loss
+
+    # --------------------------------------------------------- checkpoints
+    def _step_dir(self, n):
+        return os.path.join(self.directory, f"step_{n:010d}")
+
+    def save(self):
+        """Checkpoint now (also called automatically by step())."""
+        n = int(self.trainer.num_update)
+        d = self._step_dir(n)
+        self.trainer.save_states(d)
+        # marker AFTER a successful save: restore_latest ignores dirs
+        # without it, so a kill mid-save can never be resumed from
+        if jax.process_index() == 0:
+            with open(os.path.join(d, _MARKER), "w") as f:
+                f.write(str(n))
+        self._retain()
+        return d
+
+    def _complete_steps(self):
+        out = []
+        try:
+            entries = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for e in entries:
+            if e.startswith("step_") and os.path.exists(
+                    os.path.join(self.directory, e, _MARKER)):
+                try:
+                    out.append(int(e[len("step_"):]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _retain(self):
+        if jax.process_index() != 0 or self.keep <= 0:
+            return
+        steps = self._complete_steps()
+        for n in steps[:-self.keep]:
+            shutil.rmtree(self._step_dir(n), ignore_errors=True)
+
+    def restore_latest(self):
+        """Load the newest COMPLETE checkpoint into the trainer. Returns
+        its step number, or None when no usable checkpoint exists."""
+        steps = self._complete_steps()
+        for n in reversed(steps):
+            try:
+                self.trainer.load_states(self._step_dir(n))
+                return n
+            except Exception:          # corrupt tail: fall back one
+                continue
+        return None

@@ -142,7 +142,10 @@ def pipeline_apply_hetero(stage_fns, stage_params, microbatch_inputs,
     return _schedule(n, sid, M, axis_name, step, state0)
 
 
-class SeqPipelineTrainer:
+from .trainer import PipelineCheckpointMixin
+
+
+class SeqPipelineTrainer(PipelineCheckpointMixin):
     """Pipeline x data x sequence parallelism in one SPMD program.
 
     The composition the hetero PipelineTrainer cannot express: ring
@@ -331,7 +334,7 @@ class SeqPipelineTrainer:
             p.data()._data = v
 
 
-class PipelineTrainer:
+class PipelineTrainer(PipelineCheckpointMixin):
     """Train a list of gluon stage blocks over the `pp` mesh axis.
 
     stages[0] consumes the raw per-microbatch inputs and produces the

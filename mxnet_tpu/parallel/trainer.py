@@ -245,27 +245,16 @@ class ShardedTrainer:
         }
 
     def save_states(self, directory):
-        """Write params + optimizer state + step count as an orbax
-        sharded checkpoint (works multi-host: each process writes only
-        its local shards)."""
-        import os
-        import orbax.checkpoint as ocp
-        state = self._state_pytree()
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(os.path.join(str(directory), "state")),
-                   state, force=True)
-        ckptr.wait_until_finished()
+        """Write params + optimizer state + step count + the global RNG
+        stream as an orbax sharded checkpoint (works multi-host: each
+        process writes only its local shards)."""
+        _ckpt_save(self, directory)
 
     def load_states(self, directory):
         """Restore a save_states() checkpoint onto the current mesh —
         resharding to the current topology happens automatically via the
         restore shardings."""
-        import os
-        import orbax.checkpoint as ocp
-        target = self._state_pytree()
-        ckptr = ocp.StandardCheckpointer()
-        state = ckptr.restore(
-            os.path.abspath(os.path.join(str(directory), "state")), target)
+        state = _ckpt_restore(self, directory)
         if self._fused:
             self.params = jax.device_put(
                 self._fl.flatten(state["params"]), self._rep)
@@ -285,3 +274,75 @@ class ShardedTrainer:
         if self._fused:
             return sum(self._fl.sizes)
         return sum(int(jnp.size(p)) for p in self.params)
+
+
+# -- shared checkpoint plumbing (ShardedTrainer + pipeline trainers) -------
+
+
+def _ckpt_save(trainer, directory):
+    """Orbax save of the trainer's _state_pytree PLUS the global RNG
+    stream, so a resumed run replays the same dropout/shuffle draws
+    (trajectory-exact resume)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from .. import random as _random
+
+    state = trainer._state_pytree()
+    state["rng_key"] = jax.random.key_data(_random.get_state())
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(os.path.join(str(directory), "state")),
+               state, force=True)
+    ckptr.wait_until_finished()
+
+
+def _ckpt_restore(trainer, directory):
+    """Restore + re-seed the global RNG. Returns the state pytree for the
+    trainer to apply its fields from."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from .. import random as _random
+
+    target = trainer._state_pytree()
+    target["rng_key"] = jax.random.key_data(_random.get_state())
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(
+        os.path.abspath(os.path.join(str(directory), "state")), target)
+    _random.set_state(state["rng_key"])
+    return state
+
+
+class PipelineCheckpointMixin:
+    """save_states/load_states for the pipeline trainers: their state is a
+    flat param list + per-param opt-state tuples + the step count (no aux
+    — BatchNorm stats inside pipeline stages raise at construction)."""
+
+    def _state_pytree(self):
+        return {
+            "params": list(self.params),
+            "opt_state": [list(st) for st in self.opt_state],
+            "num_update": jnp.asarray(self.num_update),
+        }
+
+    def _ensure_setup(self):
+        # the hetero PipelineTrainer defers _setup() to its first step (to
+        # resolve deferred param shapes from a probe batch); restoring into
+        # a FRESH trainer must materialize params first. Works only when
+        # every stage block has explicit shapes — deferred-shape stages
+        # need one step before load_states.
+        if not getattr(self, "_ready", True) and not hasattr(self, "params"):
+            self._setup()
+            self._ready = True
+
+    def save_states(self, directory):
+        _ckpt_save(self, directory)
+
+    def load_states(self, directory):
+        self._ensure_setup()
+        state = _ckpt_restore(self, directory)
+        self.params = list(state["params"])
+        self.opt_state = [tuple(st) for st in state["opt_state"]]
+        self.num_update = int(state["num_update"])

@@ -55,6 +55,17 @@ def get_state():
     return _global_key()
 
 
+def set_state(key):
+    """Restore a key captured by get_state() (accepts a typed key or the
+    raw key_data a checkpoint stores)."""
+    import jax
+
+    if not jax.dtypes.issubdtype(getattr(key, "dtype", None), jax.dtypes.prng_key):
+        import jax.numpy as jnp
+        key = jax.random.wrap_key_data(jnp.asarray(key), impl=_impl())
+    _global["key"] = key
+
+
 class key_scope:
     """Within this scope, `next_key()` folds a counter into `key` instead of
     consuming global state — safe under jax tracing."""

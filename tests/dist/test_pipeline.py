@@ -207,3 +207,29 @@ def test_homogeneous_pipeline_still_works():
     for i in range(4):
         ref = jnp.tanh(ref @ ws[i])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5)
+
+
+def test_pipeline_trainer_save_load_states(tmp_path):
+    """PipelineCheckpointMixin: a pipeline trainer checkpoints and a FRESH
+    differently-seeded trainer resumes the exact trajectory."""
+    batches = _batches(6)
+    parallel.make_mesh(pp=4, devices=parallel.local_mesh_devices(4))
+    stages, head = _make_stages(seed=5)
+    tr = parallel.PipelineTrainer(stages, _loss, "sgd",
+                                  {"learning_rate": 0.1}, head=head,
+                                  num_microbatches=4)
+    for t, l in batches[:3]:
+        tr.step([nd.array(t)], [nd.array(l)])
+    tr.save_states(tmp_path / "pp_ck")
+    expect = [float(tr.step([nd.array(t)], [nd.array(l)]).asscalar())
+              for t, l in batches[3:]]
+
+    stages2, head2 = _make_stages(seed=77)       # must be overwritten
+    tr2 = parallel.PipelineTrainer(stages2, _loss, "sgd",
+                                   {"learning_rate": 0.1}, head=head2,
+                                   num_microbatches=4)
+    tr2.load_states(tmp_path / "pp_ck")
+    assert tr2.num_update == 3
+    resumed = [float(tr2.step([nd.array(t)], [nd.array(l)]).asscalar())
+               for t, l in batches[3:]]
+    np.testing.assert_allclose(resumed, expect, rtol=1e-5)
