@@ -41,6 +41,11 @@ def parse_args():
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--checkpoint", default="",
                    help="prefix for periodic sharded checkpoints")
+    p.add_argument("--auto-checkpoint-dir", default="",
+                   help="enable preemption-safe training: periodic + "
+                        "SIGTERM-triggered orbax checkpoints in this "
+                        "directory, resuming from the latest on restart")
+    p.add_argument("--auto-checkpoint-every", type=int, default=50)
     return p.parse_args()
 
 
@@ -77,15 +82,34 @@ def main():
     labels = [nd.array(b[k])
               for k in ("mlm_labels", "mlm_weights", "nsp_labels")]
 
+    stepper = trainer
+    start = 0
+    if args.auto_checkpoint_dir:
+        # preemption-safe flow: resume from the newest complete checkpoint,
+        # save periodically AND on SIGTERM (spot/preemptible TPU slices)
+        stepper = parallel.AutoCheckpoint(
+            trainer, args.auto_checkpoint_dir,
+            every_steps=args.auto_checkpoint_every)
+        start = stepper.restore_latest() or 0
+        if start:
+            print(f"resumed from step {start}")
+
     tic = time.time()
-    for step in range(1, args.steps + 1):
-        loss = trainer.step(data, labels)
+    for step in range(start + 1, args.steps + 1):
+        loss = stepper.step(data, labels)
         if step % 10 == 0 or step == args.steps:
-            toks = args.batch_size * args.seq_len * step
+            toks = args.batch_size * args.seq_len * (step - start)
             print(f"step {step}: loss={float(loss.asscalar()):.4f} "
                   f"({toks / (time.time() - tic):.0f} tokens/s)")
         if args.checkpoint and step % 50 == 0:
             trainer.save_checkpoint(f"{args.checkpoint}-{step:06d}")
+        if getattr(stepper, "preempted", False):
+            # flush explicitly: the signal may have landed AFTER step()'s
+            # internal boundary check (e.g. during the asscalar() sync),
+            # in which case no save has happened yet for this step
+            saved = stepper.save()
+            print(f"preempted: checkpoint saved at {saved}; exiting cleanly")
+            break
 
 
 if __name__ == "__main__":

@@ -202,9 +202,15 @@ class ShardedTrainer:
         batch = [jax.device_put(b, s) for b, s in
                  zip(batch, self._batch_shardings(len(data), len(labels),
                                                   shapes))]
-        loss, self.params, self.aux, self.opt_state = self._step_cache[key](
-            self.params, self.aux, self.opt_state, t, lr,
-            _random.next_key(), *batch)
+        # StepTraceAnnotation: jax.profiler device traces group work by
+        # train step (the reference profiler's per-iteration ranges —
+        # SURVEY §5.1); free when no trace is active
+        with jax.profiler.StepTraceAnnotation("train_step",
+                                              step_num=self.num_update):
+            loss, self.params, self.aux, self.opt_state = \
+                self._step_cache[key](
+                    self.params, self.aux, self.opt_state, t, lr,
+                    _random.next_key(), *batch)
         return NDArray(loss)
 
     # ------------------------------------------------------------------
