@@ -5,3 +5,5 @@ from . import random  # noqa: F401
 from . import contrib  # noqa: F401
 from . import sparse  # noqa: F401
 from .sparse import RowSparseNDArray, CSRNDArray  # noqa: F401
+
+from .sparse import cast_storage  # noqa: F401,E402  (reference op name)

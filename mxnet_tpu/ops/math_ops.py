@@ -372,3 +372,31 @@ def linalg_maketrian(A, offset=0, lower=True):
 def linalg_extracttrian(A, offset=0, lower=True):
     rows, cols = _trian_indices(A.shape[-1], offset, lower)
     return A[..., rows, cols]
+
+
+@register("digamma")
+def digamma(data):
+    return jax.scipy.special.digamma(data)
+
+
+@register("log_sigmoid")
+def log_sigmoid(data):
+    return jax.nn.log_sigmoid(data)
+
+
+@register("mish")
+def mish(data):
+    # x * tanh(softplus(x)) — reference: mish activation op
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply (reference: `src/operator/tensor/
+    la_op.cc` linalg_trmm): B <- alpha * op(tri(A)) * B (or B * op(A))."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out

@@ -96,6 +96,9 @@ def pad(data, mode="constant", pad_width=None, constant_value=0.0):
     return jnp.pad(data, pw, mode=jmode)
 
 
+alias("Pad", "pad")            # reference CamelCase name
+
+
 @register("stack")
 def stack(*args, axis=0):
     return jnp.stack(args, axis=axis)
@@ -288,3 +291,19 @@ def boolean_mask(data, index, axis=0):
     shape = [1] * data.ndim
     shape[axis] = -1
     return gathered * keep.reshape(shape).astype(data.dtype)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs into rhs's shape (reference: tensor/matrix_op.cc
+    reshape_like, incl. the partial-axis-range form)."""
+    if lhs_begin is None and rhs_begin is None and lhs_end is None \
+            and rhs_end is None:
+        return lhs.reshape(rhs.shape)
+    lb = int(lhs_begin or 0)
+    le = lhs.ndim if lhs_end is None else int(lhs_end)
+    rb = int(rhs_begin or 0)
+    re_ = rhs.ndim if rhs_end is None else int(rhs_end)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return lhs.reshape(new_shape)

@@ -556,3 +556,52 @@ def test_crop_requires_positive_window():
     x = nd.ones((1, 1, 4, 4))
     with pytest.raises(ValueError, match="positive"):
         F.Crop(x)
+
+
+def test_r5_op_additions():
+    """digamma / log_sigmoid / mish / linalg_trmm / reshape_like /
+    cast_storage / Pad alias (reference parity fills, r5)."""
+    import scipy.special as sps
+
+    x = nd.array(np.asarray([0.5, 1.0, 2.5], np.float32))
+    np.testing.assert_allclose(nd.digamma(x).asnumpy(),
+                               sps.digamma([0.5, 1.0, 2.5]), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.log_sigmoid(x).asnumpy(),
+        np.log(1 / (1 + np.exp(-x.asnumpy()))), rtol=1e-5)
+    sp = np.log1p(np.exp(x.asnumpy()))
+    np.testing.assert_allclose(nd.mish(x).asnumpy(),
+                               x.asnumpy() * np.tanh(sp), rtol=1e-5)
+
+    rng = np.random.RandomState(0)
+    A = rng.randn(4, 4).astype(np.float32)
+    B = rng.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(A), nd.array(B), lower=True).asnumpy(),
+        np.tril(A) @ B, rtol=1e-5)
+    B2 = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.linalg_trmm(nd.array(A), nd.array(B2), rightside=True,
+                       transpose=True, lower=False, alpha=2.0).asnumpy(),
+        2.0 * (B2 @ np.triu(A).T), rtol=1e-5)
+
+    l = nd.array(rng.randn(2, 6).astype(np.float32))
+    r = nd.array(np.zeros((3, 4), np.float32))
+    assert nd.reshape_like(l, r).shape == (3, 4)
+    l2 = nd.array(rng.randn(2, 3, 4).astype(np.float32))
+    r2 = nd.array(np.zeros((6, 7), np.float32))
+    out = nd.reshape_like(l2, r2, lhs_begin=0, lhs_end=2, rhs_begin=0,
+                          rhs_end=1)
+    assert out.shape == (6, 4)
+
+    dense = nd.array(np.asarray([[0, 1], [0, 0], [2, 3]], np.float32))
+    rsp = nd.cast_storage(dense, "row_sparse")
+    assert type(rsp).__name__ == "RowSparseNDArray"
+    np.testing.assert_array_equal(nd.cast_storage(rsp, "default").asnumpy(),
+                                  dense.asnumpy())
+    csr = nd.cast_storage(dense, "csr")
+    assert type(csr).__name__ == "CSRNDArray"
+
+    p = nd.Pad(nd.array(np.ones((1, 1, 2, 2), np.float32)),
+               mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert p.shape == (1, 1, 4, 4)
