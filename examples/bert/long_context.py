@@ -43,6 +43,9 @@ def parse_args():
     p.add_argument("--sp", type=int, default=2)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--sp-mode", default="ring", choices=["ring", "ulysses"],
+                   help="sequence-parallel attention: K/V ring rotation or "
+                        "Ulysses all-to-all head<->sequence reshard")
     return p.parse_args()
 
 
@@ -50,12 +53,13 @@ def main():
     from jax.sharding import PartitionSpec as P
 
     args = parse_args()
+    sp_mode = args.sp_mode          # "ring" | "ulysses" (both truthy)
     if args.config == "long":
-        cfg = bert_mod.bert_long_config()
+        cfg = bert_mod.bert_long_config(seq_parallel=sp_mode)
     else:
         cfg = bert_mod.bert_tiny_config(
             max_length=args.seq_len, num_layers=args.layers, dropout=0.0,
-            attn_dropout=0.0, seq_parallel=True)
+            attn_dropout=0.0, seq_parallel=sp_mode)
 
     if args.seq_len % args.sp:
         raise SystemExit(f"--seq-len {args.seq_len} must be divisible by "
@@ -83,7 +87,8 @@ def main():
             for _ in range(per_stage):
                 seq.add(bert_mod.BERTEncoderLayer(
                     cfg["units"], cfg["hidden_size"], cfg["num_heads"],
-                    0.0, cfg["dtype"], attn_dropout=0.0, seq_parallel=True))
+                    0.0, cfg["dtype"], attn_dropout=0.0,
+                    seq_parallel=sp_mode))
             stages.append(seq)
 
         from mxnet_tpu.gluon import HybridBlock, nn as gnn

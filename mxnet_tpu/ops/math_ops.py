@@ -386,8 +386,7 @@ def log_sigmoid(data):
 
 @register("mish")
 def mish(data):
-    # x * tanh(softplus(x)) — reference: mish activation op
-    return data * jnp.tanh(jax.nn.softplus(data))
+    return jax.nn.mish(data)
 
 
 @register("linalg_trmm")

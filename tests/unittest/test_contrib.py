@@ -316,6 +316,11 @@ def test_symbolic_quantize_model_conv_net():
     got2 = run(qsym2, qargs2, x_in)
     assert np.abs(got2 - ref).max() < 0.04 * span
 
+    # quantized graphs serialize: JSON round-trip executes identically
+    from mxnet_tpu import symbol as sym_mod
+    back = sym_mod.load_json(qsym.tojson())
+    np.testing.assert_allclose(run(back, qargs, x_in), got, rtol=1e-6)
+
 
 def test_symbolic_quantize_reference_kwargs_and_shared_bias():
     """Reference-shaped call compatibility (ctx/excluded_sym_names/...),
