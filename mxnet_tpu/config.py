@@ -118,6 +118,11 @@ register_option(
     doc="PRNG implementation: 'rbg' (TPU hardware generator, fast), "
         "'threefry2x32' (counter-exact), or 'auto' (rbg on TPU).")
 register_option(
+    "dataloader_timeout", 300.0,
+    "Seconds the process-worker DataLoader waits with no batch arriving "
+    "before declaring the workers deadlocked (a jax/XLA call inside a "
+    "forked worker). 0 disables the watchdog.")
+register_option(
     "pallas_bwd_min_len", 512,
     "KV length at or above which flash-attention backward uses the "
     "blockwise Pallas kernels instead of XLA's fused LxL formulation "

@@ -210,9 +210,8 @@ class DataLoader:
                     continue
                 if recvd >= sent:       # nothing in flight, nothing buffered
                     break
-                import os as _os
-                stall_limit = float(_os.environ.get(
-                    "MXNET_TPU_DATALOADER_TIMEOUT", "300"))
+                from ... import config as _config
+                stall_limit = float(_config.get("dataloader_timeout"))
                 waited = 0.0
                 while True:             # bounded get: a worker that died OR
                     try:                # deadlocked must not hang us forever
@@ -226,14 +225,15 @@ class DataLoader:
                                 f"DataLoader worker (pid {dead[0].pid}) "
                                 f"died with exit code {dead[0].exitcode} "
                                 "without reporting a result") from None
-                        if waited >= stall_limit:
+                        if stall_limit > 0 and waited >= stall_limit:
                             raise RuntimeError(
                                 f"DataLoader workers produced no batch for "
                                 f"{waited:.0f}s — likely a jax/XLA call "
                                 "deadlocked inside a forked worker (keep "
                                 "transforms numpy-only, or use "
-                                "thread_pool=True). Override the limit "
-                                "with MXNET_TPU_DATALOADER_TIMEOUT."
+                                "thread_pool=True). Override with the "
+                                "dataloader_timeout config option "
+                                "(MXNET_TPU_DATALOADER_TIMEOUT)."
                             ) from None
                 recvd += 1
                 if err is not None:
