@@ -45,6 +45,15 @@ def attn_flops(B, H, L, D, causal):
 
 
 def main():
+    # probe in a killable SUBPROCESS and take the bench flock BEFORE any
+    # in-process backend init: attaching a second live TPU client while a
+    # lock holder is timing is exactly what the lock exists to prevent
+    import bench
+    if not bench.probe_tpu():
+        print(json.dumps({"error": "needs a TPU backend"}))
+        return
+    bench.acquire_bench_lock()
+
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -60,6 +60,8 @@ def main():
     # which hangs forever when the tunnel is down (bench.py's probe trick).
     import bench
     on_tpu = bench.probe_tpu()
+    if on_tpu:
+        bench.acquire_bench_lock()
 
     import jax
     import jax.numpy as jnp

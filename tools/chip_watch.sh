@@ -1,19 +1,30 @@
 #!/bin/bash
 # chip_watch.sh — tunnel-recovery watch (VERDICT r4 "Next round" #1).
 #
-# The axon TPU tunnel drops for hours at a time (down for all of rounds 3-4's
-# bench windows); bench.py only probes when the driver runs it at round end,
-# so a mid-round recovery window produced zero artifacts.  This loop probes
-# every PROBE_INTERVAL seconds in a killable subprocess (the axon PJRT plugin
-# hangs forever in backend init when the chip is unreachable — a plain
-# `import jax; jax.devices()` would wedge, hence timeout(1)).
+# The axon TPU tunnel drops for hours at a time and comes back in short
+# windows (the 2026-07-31 window lasted ~30 min); bench.py only probes when
+# the driver runs it at round end, so a mid-round recovery window used to
+# produce zero artifacts.  This loop probes every PROBE_INTERVAL seconds in
+# a killable subprocess (the axon PJRT plugin hangs forever in backend init
+# when the chip is unreachable — a plain `import jax; jax.devices()` would
+# wedge, hence timeout(1)).
 #
-# On the FIRST success of each uptime window it runs the full live-bench
-# battery (bench.py, benchmarks/bench_attention.py, benchmarks/
-# bench_step_profile.py if present) and appends results to
-# tools/chip_watch_results.jsonl; every probe outcome is appended to
-# tools/chip_watch.log so the watch itself is an artifact (VERDICT: "If the
-# tunnel never comes up, the watch log itself goes in BASELINE.md").
+# On the FIRST success of each uptime window it runs the live-bench battery
+# IN PRIORITY ORDER — rarest artifact first, so a short window still yields
+# the thing we've never captured:
+#   1. benchmarks/bench_attention.py  (per-length kernel-efficiency table)
+#   2. bench.py                       (BERT-base headline + large/resnet rows)
+#   3. benchmarks/bench_step_profile.py (per-phase step breakdown)
+# Results append to tools/chip_watch_results.jsonl; every probe outcome is
+# appended to tools/chip_watch.log so the watch itself is an artifact.
+#
+# Serialization against manually-launched benches lives in the bench
+# entry points themselves: every TPU bench (bench.py, bench_attention.py,
+# bench_step_profile.py) flocks tools/.tpu_bench.lock at startup — two
+# concurrent TPU clients taint each other's ceiling measurement AND can
+# wedge the tunnel (observed 2026-07-31).  A wrapper-level flock here
+# would only cover the watch's own battery, and would deadlock against
+# bench.py's per-row subprocesses.
 #
 # Usage: nohup tools/chip_watch.sh >/dev/null 2>&1 &   (or under tmux)
 set -u
@@ -21,8 +32,12 @@ cd "$(dirname "$0")/.."
 LOG=tools/chip_watch.log
 RESULTS=tools/chip_watch_results.jsonl
 FLAG=tools/.chip_watch_captured   # present => battery already ran this window
-PROBE_INTERVAL=${CHIP_WATCH_INTERVAL:-1500}   # ~25 min
+PROBE_INTERVAL=${CHIP_WATCH_INTERVAL:-300}    # 5 min: windows can be short
 PROBE_TIMEOUT=${CHIP_WATCH_PROBE_TIMEOUT:-120}
+PART_TIMEOUT=${CHIP_WATCH_PART_TIMEOUT:-1500}
+# bench.py's two secondary rows must BOTH fit inside PART_TIMEOUT along
+# with the headline run (~300s warm): budget each row at a third.
+export MXNET_TPU_BENCH_ROW_TIMEOUT=${MXNET_TPU_BENCH_ROW_TIMEOUT:-450}
 
 ts() { date -u +%Y-%m-%dT%H:%M:%SZ; }
 
@@ -42,10 +57,10 @@ while true; do
       echo "$(ts) running live bench battery" >> "$LOG"
       {
         echo "{\"ts\": \"$(ts)\", \"event\": \"window_open\"}"
-        timeout 1800 python bench.py 2>tools/chip_watch_bench.err
-        timeout 1800 python benchmarks/bench_attention.py 2>>tools/chip_watch_bench.err
+        timeout -k 10 "$PART_TIMEOUT" python benchmarks/bench_attention.py 2>tools/chip_watch_bench.err
+        timeout -k 10 "$PART_TIMEOUT" python bench.py 2>>tools/chip_watch_bench.err
         if [ -f benchmarks/bench_step_profile.py ]; then
-          timeout 1800 python benchmarks/bench_step_profile.py 2>>tools/chip_watch_bench.err
+          timeout -k 10 "$PART_TIMEOUT" python benchmarks/bench_step_profile.py 2>>tools/chip_watch_bench.err
         fi
         echo "{\"ts\": \"$(ts)\", \"event\": \"battery_done\"}"
       } >> "$RESULTS"
