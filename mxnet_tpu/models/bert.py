@@ -27,7 +27,7 @@ def bert_base_config(**overrides):
     cfg = dict(vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
                num_heads=12, max_length=512, type_vocab_size=2, dropout=0.1,
                attn_dropout=None, seq_parallel=False, dtype="float32",
-               remat=False)
+               remat=False, scan_layers=False)
     cfg.update(overrides)
     return cfg
 
@@ -36,9 +36,11 @@ def bert_large_config(**overrides):
     # remat by default at large depth: recompute each encoder layer in the
     # backward pass (jax.checkpoint) so activation memory scales O(1) in
     # depth instead of O(num_layers) — the FLOPs-for-HBM trade that makes
-    # BERT-large batch sizes fit (SURVEY §7.4 item 4)
+    # BERT-large batch sizes fit (SURVEY §7.4 item 4).  scan_layers
+    # compiles the layer body ONCE via lax.scan instead of unrolling 24
+    # copies: >25 min cold compile down to ~BERT-base compile time.
     cfg = bert_base_config(units=1024, hidden_size=4096, num_layers=24,
-                           num_heads=16, remat=True)
+                           num_heads=16, remat=True, scan_layers=True)
     cfg.update(overrides)
     return cfg
 
@@ -134,6 +136,71 @@ def _remat_call(layer, x, mask):
     return NDArray(jax.checkpoint(f)(*args))
 
 
+def _scan_layers_call(layers, x, mask, use_remat):
+    """Apply an identical-structure encoder stack as ONE `lax.scan` over
+    stacked per-layer parameters: the layer body is traced and compiled
+    once instead of `num_layers` times.  This is what makes BERT-large
+    (24 layers) compile in roughly the time BERT-base does — the unrolled
+    loop took >25 min cold over the axon tunnel (measured 2026-07-31).
+
+    Mechanics: each layer's parameter tensors (identical pytree structure
+    by construction) are stacked on a new leading axis *inside the trace*,
+    so under `functional_call` the stack consumes the substituted per-layer
+    tracers and gradients flow back to the individual parameters through
+    the stack — the Block/Trainer/optimizer machinery is untouched.  The
+    body runs layer 0's `forward` with its parameters swapped for the
+    scanned slices (the same substitution trick `_make_pure_fn` uses).
+
+    RNG: `next_key()` folds a PYTHON-side counter, which advances once at
+    trace time — inside scan every iteration would replay identical
+    dropout masks.  Each iteration therefore enters a fresh `key_scope`
+    folding the layer index into one base key.
+
+    With `use_remat` the body is wrapped in `jax.checkpoint`: activation
+    memory stays O(1) in depth and the backward recomputes per layer —
+    the canonical scan-over-remat pairing."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import random as _random
+
+    layer0 = layers[0]
+    gp0, aux0 = layer0._param_lists()
+    if aux0:
+        raise ValueError("scan_layers requires encoder layers without "
+                         "aux (grad_req='null') parameters")
+    params0 = [p for _, p in gp0]
+    per_layer = []
+    for layer in layers:
+        gp, aux = layer._param_lists()
+        assert not aux and len(gp) == len(gp0)
+        per_layer.append([p._data._data for _, p in gp])
+    stacked = [jnp.stack(vals) for vals in zip(*per_layer)]
+    base_key = _random.next_key()
+    mask_d = None if mask is None else mask._data
+
+    def body(carry, xs):
+        idx, leaves = xs[0], xs[1:]
+        saved = []
+        for p, d in zip(params0, leaves):
+            saved.append(p._data._data)
+            p._data._data = d
+        try:
+            with _random.key_scope(jax.random.fold_in(base_key, idx)):
+                out = layer0(NDArray(carry),
+                             None if mask_d is None else NDArray(mask_d))
+        finally:
+            for p, d in zip(params0, saved):
+                p._data._data = d
+        return out._data, None
+
+    if use_remat:
+        body = jax.checkpoint(body)
+    xs = (jnp.arange(len(layers)),) + tuple(stacked)
+    y, _ = jax.lax.scan(body, x._data, xs)
+    return NDArray(y)
+
+
 def _positions(position_embed, L, sp_manual):
     """Slice L position embeddings. Inside a shard_map stage controlling
     `sp`, this device holds tokens [off, off+L) of the global sequence —
@@ -162,10 +229,11 @@ class BERTModel(HybridBlock):
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
                  max_length=512, type_vocab_size=2, dropout=0.1,
                  attn_dropout=None, seq_parallel=False,
-                 dtype="float32", remat=False, **kwargs):
+                 dtype="float32", remat=False, scan_layers=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._remat = remat
+        self._scan_layers = scan_layers
         self._seq_parallel = seq_parallel
         self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
                                        weight_initializer="xavier")
@@ -222,11 +290,14 @@ class BERTModel(HybridBlock):
         # eager tape stores activations per-op; jax.checkpoint there would
         # just break recording)
         use_remat = self._remat and not _engine.is_recording()
-        for layer in self.layers:
-            if use_remat:
-                x = _remat_call(layer, x, mask)
-            else:
-                x = layer(x, mask)
+        if self._scan_layers and not _engine.is_recording():
+            x = _scan_layers_call(list(self.layers), x, mask, use_remat)
+        else:
+            for layer in self.layers:
+                if use_remat:
+                    x = _remat_call(layer, x, mask)
+                else:
+                    x = layer(x, mask)
         # pin the encoder output (and via transpose its cotangent) to batch
         # sharding: the MLM gather and pooler-slice backward paths otherwise
         # propagate conflicting feature shardings from fsdp-sharded head
