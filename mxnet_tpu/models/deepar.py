@@ -114,19 +114,38 @@ class DeepAR(HybridBlock):
             lambda r, t: jnp.mean(self.distr.nll(r, t[:, 1:])),
             raw, past_target)
 
+    def _next_step_raw(self, seq):
+        """Distr params for the step AFTER the last element of `seq`.
+
+        `forward` drops the final input (teacher-forcing alignment:
+        raw[:, k] is conditioned on target[<=k] and scored against
+        target[k+1]), so its raw[:, -1] predicts the last OBSERVED point —
+        sampling from that lags every forecast by one step (caught by the
+        climatology CRPS gate in test_quality_gates)."""
+        import jax.numpy as jnp
+
+        x = seq[:, :, None].astype(jnp.float32)
+        x = jnp.concatenate([x, jnp.zeros_like(x)], axis=-1)
+        h = self.lstm(NDArray(x))
+        return self.proj(h)._data[:, -1]
+
     def sample_paths(self, context, num_samples=100, features=None):
         """Ancestral sampling: returns (num_samples, B, prediction_length)."""
         import jax
         import jax.numpy as jnp
         from .. import random as _random
 
+        if features is not None:
+            raise NotImplementedError(
+                "sample_paths with covariate features: forecasting would "
+                "need future feature values threaded per sampled step; "
+                "train/forecast feature-free or extend _next_step_raw")
         B = context.shape[0]
         out = []
         for s in range(num_samples):
             seq = context._data.astype(jnp.float32)
             for t in range(self.prediction_length):
-                raw = self.forward(NDArray(seq))
-                step_raw = raw._data[:, -1]
+                step_raw = self._next_step_raw(seq)
                 val = self.distr.sample(step_raw, _random.next_key())
                 seq = jnp.concatenate([seq, val[:, None]], axis=1)
             out.append(seq[:, context.shape[1]:])

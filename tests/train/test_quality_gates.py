@@ -83,7 +83,7 @@ def test_deepar_nll_and_crps_improve():
     trainer = Trainer(model.collect_params(), "adam",
                       {"learning_rate": 1e-2})
     losses = []
-    for _ in range(60):
+    for _ in range(150):
         with autograd.record():
             l = model.loss(target)
         l.backward()
@@ -96,6 +96,25 @@ def test_deepar_nll_and_crps_improve():
     crps_after = crps_of(model)
     assert crps_after < crps_before, \
         f"CRPS did not improve: {crps_before:.4f} -> {crps_after:.4f}"
+
+    # FALSIFIABLE external bar (the SyntheticGratings pattern for
+    # forecasting): the trained model must beat a CLIMATOLOGY forecaster —
+    # samples drawn from the context window's empirical distribution —
+    # by >=50% CRPS. On a clean sinusoid a conditional forecaster that
+    # has learned the dynamics crushes the unconditional distribution
+    # (attained here: ~0.07 vs climatology ~0.52, i.e. 87% better); the
+    # pre-fix sample_paths off-by-one (forecasts lagged one step —
+    # predicted the last OBSERVED point first) scored 0.87-0.97x
+    # climatology and could never pass, which is how the bug was caught.
+    rng2 = np.random.RandomState(2)
+    ctx_hist = series[:4, :20]                      # (4, 20)
+    clim_idx = rng2.randint(0, ctx_hist.shape[1], size=(100, 4, 4))
+    clim_samples = np.take_along_axis(
+        ctx_hist[None].repeat(100, 0), clim_idx, axis=2)  # (100, 4, 4)
+    crps_clim = deepar_mod.crps_eval(clim_samples, series[:4, 20:24])
+    assert crps_after < 0.5 * crps_clim, \
+        (f"trained CRPS {crps_after:.4f} does not beat climatology "
+         f"{crps_clim:.4f} by 50%")
 
 
 def test_resnet18_synthetic_gratings_gate():
