@@ -13,8 +13,10 @@ W/R/B (gate reorder, per-layer nodes). Import constant-propagates
 Shape/Gather/Concat/Cast/arith chains (the PyTorch-exporter flatten
 idiom) at the graph's static input shapes; nearest-Resize maps to/from
 UpSampling. Multi-output (Group'd) graphs export/import. RNN covers
-unidirectional AND bidirectional LSTM/GRU. Still NOT covered: control
-flow (Loop/If), vanilla-activation RNN, GRU with linear_before_reset=0,
+unidirectional AND bidirectional LSTM/GRU, and vanilla RNN
+(rnn_tanh/rnn_relu <-> ONNX RNN with homogeneous Tanh/Relu activations).
+Still NOT covered: control flow (Loop/If), GRU with
+linear_before_reset=0, per-direction heterogeneous RNN activations,
 sequence_lens on RNN nodes, genuinely dynamic shapes (a Shape chain that
 static inference cannot resolve raises).
 Serialization is the in-tree wire codec (`_proto.py`) — the
@@ -390,7 +392,8 @@ def _export_node(node, in_names, out_names, consts, param_values=None):
 
 
 def _export_rnn(node, in_names, out_names, consts, param_values):
-    """RNN (lstm/gru, unidirectional) -> one ONNX LSTM/GRU node per layer.
+    """RNN (lstm/gru/rnn_tanh/rnn_relu, uni- or bidirectional) -> one
+    ONNX LSTM/GRU/RNN node per layer.
 
     The flat cuDNN parameter vector is split per layer and gate-reordered
     into ONNX W/R/B initializers; the original flat initializer becomes
@@ -399,15 +402,13 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
     side, where absent means zero) or explicit nonzero initializers."""
     a, nm = node.attrs, node.name
     mode = _attr(a, "mode", "lstm")
-    if mode not in ("lstm", "gru"):
-        raise NotImplementedError(
-            f"ONNX export: RNN mode '{mode}' (vanilla) has no opset-13 "
-            "node with matching semantics — use lstm/gru")
+    if mode not in ("lstm", "gru", "rnn_tanh", "rnn_relu"):
+        raise NotImplementedError(f"ONNX export: RNN mode '{mode}'")
     bidir = bool(_attr(a, "bidirectional", False))
     dirs = 2 if bidir else 1
     H = int(_attr(a, "state_size"))
     L = int(_attr(a, "num_layers", 1))
-    ngates = 4 if mode == "lstm" else 3
+    ngates = {"lstm": 4, "gru": 3}.get(mode, 1)
     if param_values is None or in_names[1] not in param_values:
         raise NotImplementedError(
             "ONNX export: RNN requires its parameter vector as an "
@@ -419,8 +420,8 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
     I = (flat.size - rest) // (dirs * ngates * H) - H - 2
     layers = _rnn_unpack_np(flat, ngates, L, I, H, dirs=dirs)
 
-    order = _LSTM_TO_ONNX if mode == "lstm" else _GRU_TO_ONNX
-    onnx_op = "LSTM" if mode == "lstm" else "GRU"
+    order = {"lstm": _LSTM_TO_ONNX, "gru": _GRU_TO_ONNX}.get(mode, [0])
+    onnx_op = {"lstm": "LSTM", "gru": "GRU"}.get(mode, "RNN")
 
     def state_value(idx):
         """(L, N, H) initial-state array or None when all zeros/absent."""
@@ -475,6 +476,11 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
             attrs["direction"] = "bidirectional"
         if mode == "gru":
             attrs["linear_before_reset"] = 1    # our GRU cell's semantics
+        if onnx_op == "RNN":
+            # vanilla RNN: explicit per-direction activation (ONNX default
+            # is Tanh; Relu must be stated)
+            attrs["activations"] = \
+                ["Relu" if mode == "rnn_relu" else "Tanh"] * dirs
         nodes.append(P.node(onnx_op, ins, [y, yh] +
                             ([yc] if mode == "lstm" else []),
                             name=f"{nm}_l{l}", attrs=attrs))
@@ -826,7 +832,7 @@ def _import_node(n, sym_of, sym_mod, inits, ctx=None):
                 "(integer NCHW spatial upscale only)")
         return sym_mod.UpSampling(ins[0], scale=int(sc[2]),
                                   sample_type="nearest", name=name)
-    if op in ("LSTM", "GRU"):
+    if op in ("LSTM", "GRU", "RNN"):
         return _import_rnn(n, ins, sym_mod, const_in, ctx, name)
     raise NotImplementedError(f"ONNX import: op '{op}' not in the "
                               "supported subset")
@@ -843,7 +849,16 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
         raise NotImplementedError(
             f"ONNX import: {op} direction '{direction}' unsupported")
     bidir = direction == "bidirectional"
-    if a.get("activations"):
+    acts = [s.decode() if isinstance(s, bytes) else s
+            for s in (a.get("activations") or [])]
+    if op == "RNN":
+        # vanilla RNN: homogeneous Tanh (the ONNX default) or Relu
+        uniq = set(acts) or {"Tanh"}
+        if len(uniq) > 1 or uniq - {"Tanh", "Relu"}:
+            raise NotImplementedError(
+                f"ONNX import: RNN activations {acts} unsupported")
+        mode = "rnn_relu" if uniq == {"Relu"} else "rnn_tanh"
+    elif acts:
         raise NotImplementedError(
             f"ONNX import: {op} with custom activations unsupported")
     if op == "GRU" and not a.get("linear_before_reset", 0):
@@ -856,8 +871,9 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
             f"ONNX import: {op} with sequence_lens unsupported — running "
             "padded sequences to full length would silently change Y/Y_h")
     H = int(a["hidden_size"])
-    mode = "lstm" if op == "LSTM" else "gru"
-    ngates = 4 if mode == "lstm" else 3
+    if op != "RNN":
+        mode = "lstm" if op == "LSTM" else "gru"
+    ngates = {"LSTM": 4, "GRU": 3}.get(op, 1)
     W, R, B = const_in(1), const_in(2), const_in(3)
     if W is None or R is None:
         raise NotImplementedError(
@@ -872,7 +888,7 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
         B = np.zeros((dirs, 2 * ngates * H), np.float32)
     else:
         B = np.asarray(B, np.float32)
-    order = _LSTM_FROM_ONNX if mode == "lstm" else _GRU_FROM_ONNX
+    order = {"lstm": _LSTM_FROM_ONNX, "gru": _GRU_FROM_ONNX}.get(mode, [0])
     entries = [{"wi": _gate_reorder(W[d], order, H),
                 "wh": _gate_reorder(R[d], order, H),
                 "bi": _gate_reorder(B[d][:ngates * H], order, H),
@@ -956,6 +972,7 @@ def import_model(onnx_file):
     _SHAPE_INPUTS = {"Reshape": [1], "Squeeze": [1], "Unsqueeze": [1],
                      "Slice": [1, 2, 3, 4], "Gather": [1],
                      "LSTM": [1, 2, 3], "GRU": [1, 2, 3],
+                     "RNN": [1, 2, 3],
                      "Resize": [1, 2, 3]}
     _CONST_TAGS = ("_scalar", "_one", "_half", "_eps", "_sqrt2", "_c",
                    "_s2pi")

@@ -165,6 +165,10 @@ def attribute(name, value):
             for v in value:
                 b += w_float(7, v)
             b += w_varint(20, ATTR_FLOATS)
+        elif all(isinstance(v, str) for v in value):
+            for v in value:
+                b += w_bytes(9, v.encode())
+            b += w_varint(20, ATTR_STRINGS)
         else:
             raise TypeError(f"attribute list {name}: {value}")
     else:
@@ -312,7 +316,7 @@ def parse_node(data):
 
 def parse_attribute(data):
     r = Reader(data)
-    name, val, ints, floats = "", None, [], []
+    name, val, ints, floats, strs = "", None, [], [], []
     while not r.eof():
         f, w, v = r.field()
         if f == 1:
@@ -330,10 +334,14 @@ def parse_attribute(data):
                 [struct.unpack("<f", struct.pack("<I", v))[0]]
         elif f == 8:           # ints: packed or repeated
             ints += unpack_varints(v) if w == 2 else [signed(v)]
+        elif f == 9:           # strings: always length-delimited, repeated
+            strs.append(v.decode())
     if ints:
         val = ints
     elif floats:
         val = floats
+    elif strs:
+        val = strs
     return name, val
 
 
