@@ -114,42 +114,46 @@ class DeepAR(HybridBlock):
             lambda r, t: jnp.mean(self.distr.nll(r, t[:, 1:])),
             raw, past_target)
 
-    def _next_step_raw(self, seq):
-        """Distr params for the step AFTER the last element of `seq`.
-
-        `forward` drops the final input (teacher-forcing alignment:
-        raw[:, k] is conditioned on target[<=k] and scored against
-        target[k+1]), so its raw[:, -1] predicts the last OBSERVED point —
-        sampling from that lags every forecast by one step (caught by the
-        climatology CRPS gate in test_quality_gates)."""
-        import jax.numpy as jnp
-
-        x = seq[:, :, None].astype(jnp.float32)
-        x = jnp.concatenate([x, jnp.zeros_like(x)], axis=-1)
-        h = self.lstm(NDArray(x))
-        return self.proj(h)._data[:, -1]
-
     def sample_paths(self, context, num_samples=100, features=None):
-        """Ancestral sampling: returns (num_samples, B, prediction_length)."""
-        import jax
+        """Ancestral sampling: returns (num_samples, B, prediction_length).
+
+        TPU-shaped: the `num_samples` axis folds into the batch (one LSTM
+        pass over the tiled context), then each forecast step advances the
+        recurrent state INCREMENTALLY — no per-sample python loop, no
+        re-running the growing prefix.  Alignment note: `forward` drops
+        the final input (teacher-forcing: raw[:, k] conditions on
+        target[<=k], scored against target[k+1]), so conditioning for the
+        first forecast step must come from the FULL context — an earlier
+        version sampled from forward()'s raw[:, -1], which predicts the
+        last OBSERVED point and lagged every path by one step (caught by
+        the climatology CRPS gate in test_quality_gates)."""
         import jax.numpy as jnp
         from .. import random as _random
+        from ..ndarray import zeros as nd_zeros
 
         if features is not None:
             raise NotImplementedError(
                 "sample_paths with covariate features: forecasting would "
                 "need future feature values threaded per sampled step; "
-                "train/forecast feature-free or extend _next_step_raw")
-        B = context.shape[0]
-        out = []
-        for s in range(num_samples):
-            seq = context._data.astype(jnp.float32)
-            for t in range(self.prediction_length):
-                step_raw = self._next_step_raw(seq)
-                val = self.distr.sample(step_raw, _random.next_key())
-                seq = jnp.concatenate([seq, val[:, None]], axis=1)
-            out.append(seq[:, context.shape[1]:])
-        return NDArray(jnp.stack(out))
+                "train/forecast feature-free or extend sample_paths")
+        B, T0 = context.shape
+        S = num_samples
+        ctx = jnp.tile(context._data.astype(jnp.float32), (S, 1))  # (S*B,T0)
+        x = ctx[:, :, None]
+        x = NDArray(jnp.concatenate([x, jnp.zeros_like(x)], axis=-1))
+        states = self.lstm.begin_state(S * B, func=nd_zeros)
+        out, states = self.lstm(x, states)          # warm state on context
+        raw_next = self.proj(NDArray(out._data[:, -1]))._data
+        vals = []
+        for t in range(self.prediction_length):
+            val = self.distr.sample(raw_next, _random.next_key())  # (S*B,)
+            vals.append(val)
+            xt = val[:, None, None].astype(jnp.float32)
+            xt = NDArray(jnp.concatenate([xt, jnp.zeros_like(xt)], axis=-1))
+            out, states = self.lstm(xt, states)
+            raw_next = self.proj(NDArray(out._data[:, -1]))._data
+        return NDArray(jnp.stack(vals, axis=-1).reshape(
+            S, B, self.prediction_length))
 
 
 def crps_eval(samples, target):
