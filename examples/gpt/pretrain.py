@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""GPT-2-style causal LM pretraining (reference: gluonnlp
+scripts/text_generation + model zoo gpt2_117m/345m), decoder-only
+counterpart of examples/bert/pretrain.py.
+
+Composes the same parallel axes as BERT: dp/fsdp sharding via
+ShardedTrainer, and --sp N shards the sequence with CAUSAL ring
+attention (or Ulysses with --sp-mode ulysses) for long context
+(SURVEY §5.7). --config 345m uses per-layer remat + scan_layers
+(compile the block body once for 24 layers).
+
+8 virtual CPU devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python examples/gpt/pretrain.py --dp 2 --sp 2 --seq-len 128 --steps 3
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import gpt as gpt_mod
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny",
+                   choices=["tiny", "117m", "345m", "long"])
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--sp-mode", default="ring", choices=["ring", "ulysses"])
+    return p.parse_args()
+
+
+def main():
+    from jax.sharding import PartitionSpec as P
+
+    args = parse_args()
+    sp = args.sp > 1
+    over = {"seq_parallel": args.sp_mode if sp else False}
+    if sp:
+        over["attn_dropout"] = 0.0
+    cfg = {
+        "tiny": gpt_mod.gpt_tiny_config,
+        "117m": gpt_mod.gpt2_117m_config,
+        "345m": gpt_mod.gpt2_345m_config,
+        "long": gpt_mod.gpt_long_config,
+    }[args.config](**over)
+    if args.seq_len > cfg["max_length"]:
+        cfg["max_length"] = args.seq_len
+
+    if args.dp > 0:
+        parallel.make_mesh(dp=args.dp, sp=args.sp,
+                           devices=parallel.local_mesh_devices(
+                               args.dp * args.sp))
+    else:
+        parallel.make_mesh(dp=args.dp, sp=args.sp)
+    mesh = parallel.current_mesh()
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if args.batch_size % n_data:
+        raise SystemExit(
+            f"batch size {args.batch_size} must be divisible by the "
+            f"sharded data-axis size {n_data} (dp x fsdp)")
+    if sp and args.seq_len % mesh.shape["sp"]:
+        raise SystemExit(
+            f"seq-len {args.seq_len} must be divisible by sp "
+            f"{mesh.shape['sp']}")
+
+    model = gpt_mod.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    model.initialize()
+
+    data_specs = label_specs = None
+    if sp:
+        batch_axes = ("dp", "fsdp")
+        data_specs = [P(batch_axes, "sp"), P(batch_axes)]
+        label_specs = [P(batch_axes, "sp"), P(batch_axes, "sp")]
+    trainer = parallel.ShardedTrainer(
+        model, gpt_mod.gpt_lm_loss, "adam", {"learning_rate": args.lr},
+        data_specs=data_specs, label_specs=label_specs)
+
+    print(f"# config={args.config} mesh={parallel.current_mesh().shape} "
+          f"b={args.batch_size} L={args.seq_len}")
+    loss = None
+    for step in range(args.steps):
+        b = gpt_mod.make_synthetic_batch(cfg, args.batch_size, args.seq_len,
+                                         seed=step)
+        data = [nd.array(b["input_ids"]), nd.array(b["valid_length"])]
+        labels = [nd.array(b["labels"]), nd.array(b["weights"])]
+        t0 = time.perf_counter()
+        loss = float(trainer.step(data, labels).asscalar())
+        print(f"step {step}: loss {loss:.4f} "
+              f"({time.perf_counter() - t0:.2f}s)")
+    assert loss is None or np.isfinite(loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,16 +10,20 @@ from . import transformer
 from . import deepar
 from . import ssd
 from . import yolo
+from . import gpt
 
 from .bert import BERTModel, BERTForPretraining, bert_base_config, bert_large_config
+from .gpt import GPTModel, GPTForCausalLM, gpt2_117m_config, gpt2_345m_config
 from .resnet import (get_resnet, resnet18_v1, resnet34_v1, resnet50_v1,
                      resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2,
                      resnet50_v2, resnet101_v2, resnet152_v2)
 from .yolo import YOLOv3Tiny
 
-__all__ = ["bert", "resnet", "transformer", "deepar", "ssd", "yolo",
+__all__ = ["bert", "resnet", "transformer", "deepar", "ssd", "yolo", "gpt",
            "BERTModel", "BERTForPretraining", "bert_base_config",
-           "bert_large_config", "get_resnet", "resnet18_v1", "resnet34_v1",
+           "bert_large_config", "GPTModel", "GPTForCausalLM",
+           "gpt2_117m_config", "gpt2_345m_config",
+           "get_resnet", "resnet18_v1", "resnet34_v1",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "YOLOv3Tiny"]

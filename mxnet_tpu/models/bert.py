@@ -65,10 +65,11 @@ def bert_tiny_config(**overrides):
 
 class BERTAttention(HybridBlock):
     """Self-attention with fused QKV and the flash kernel (or ring attention
-    over the `sp` mesh axis when seq_parallel is set)."""
+    over the `sp` mesh axis when seq_parallel is set). `causal=True` makes
+    it the decoder-side block (GPT family) — same kernel, causal mask."""
 
     def __init__(self, units, num_heads, dropout=0.0, dtype="float32",
-                 seq_parallel=False, **kwargs):
+                 seq_parallel=False, causal=False, **kwargs):
         super().__init__(**kwargs)
         if seq_parallel and dropout > 0.0:
             raise ValueError(
@@ -82,12 +83,14 @@ class BERTAttention(HybridBlock):
                              weight_initializer="xavier")
         self._dropout = dropout
         self._seq_parallel = seq_parallel
+        self._causal = causal
 
     def forward(self, x, mask=None):
         # x: (B, L, E); mask: (B, L) 1=valid
         qkv = self.qkv(x)  # (B, L, 3E)
         out = F.fused_self_attention(qkv, mask, num_heads=self._num_heads,
                                      dropout=self._dropout,
+                                     causal=self._causal,
                                      seq_parallel=self._seq_parallel)
         return self.proj(out)
 

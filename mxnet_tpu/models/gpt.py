@@ -1,0 +1,215 @@
+"""GPT-2-style decoder-only causal language model.
+
+Reference surface: the GluonNLP model zoo's text-generation family
+(`gpt2_117m`/`gpt2_345m`, upstream gluon-nlp `scripts/text_generation/`,
+model code `gluonnlp/model/transformer.py` GPT-2 variant) — the
+reference ecosystem's causal-LM counterpart to BERT.  TPU-first build:
+pre-LN blocks over the same fused-QKV flash attention as BERT but
+`causal=True`, composing with every parallel axis this framework has —
+dp/fsdp via ShardedTrainer, tp via `tp_rules`, ring/Ulysses sequence
+parallelism for long context (`gpt_long_config`, SURVEY §5.7), and
+`scan_layers` compile-once depth scaling shared with BERT.
+
+The LM head ties the token embedding (GPT-2 has no separate output
+matrix and no head bias).
+"""
+import numpy as np
+
+from ..gluon import nn, HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray import NDArray
+from ..ndarray import ndarray as F
+from .bert import BERTAttention, _positions, _scan_layers_call
+from .bert import tp_rules as _bert_tp_rules
+
+
+def gpt2_117m_config(**overrides):
+    cfg = dict(vocab_size=50257, units=768, hidden_size=3072, num_layers=12,
+               num_heads=12, max_length=1024, dropout=0.1, attn_dropout=0.0,
+               seq_parallel=False, dtype="float32", remat=False,
+               scan_layers=False)
+    cfg.update(overrides)
+    return cfg
+
+
+def gpt2_345m_config(**overrides):
+    # medium: same scan-once + remat depth treatment as bert_large
+    cfg = gpt2_117m_config(units=1024, hidden_size=4096, num_layers=24,
+                           num_heads=16, remat=True, scan_layers=True)
+    cfg.update(overrides)
+    return cfg
+
+
+def gpt_long_config(**overrides):
+    """Long-context causal pretraining: sequence sharded over the mesh's
+    `sp` axis with CAUSAL ring attention (SURVEY §5.7)."""
+    cfg = gpt2_117m_config(max_length=8192, seq_parallel=True, remat=True,
+                           scan_layers=True)
+    cfg.update(overrides)
+    return cfg
+
+
+def gpt_tiny_config(**overrides):
+    cfg = gpt2_117m_config(vocab_size=128, units=64, hidden_size=128,
+                           num_layers=2, num_heads=4, max_length=64,
+                           dropout=0.0)
+    cfg.update(overrides)
+    return cfg
+
+
+class GPTBlock(HybridBlock):
+    """Pre-LN decoder block (GPT-2 ordering: LN -> attn -> +res,
+    LN -> MLP -> +res)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 dtype="float32", attn_dropout=0.0, seq_parallel=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = BERTAttention(units, num_heads, attn_dropout, dtype,
+                                  seq_parallel=seq_parallel, causal=True)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn_in = nn.Dense(hidden_size, in_units=units, flatten=False,
+                               dtype=dtype, weight_initializer="xavier")
+        self.ffn_out = nn.Dense(units, in_units=hidden_size, flatten=False,
+                                dtype=dtype, weight_initializer="xavier")
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        a = self.attn(self.ln1(x), mask)
+        if self.dropout:
+            a = self.dropout(a)
+        x = x + a
+        h = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
+                                      act_type="gelu"))
+        if self.dropout:
+            h = self.dropout(h)
+        return x + h
+
+
+class GPTModel(HybridBlock):
+    """Token+position embeddings -> pre-LN block stack -> final LN.
+    Returns hidden states (B, L, E)."""
+
+    def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
+                 max_length=1024, dropout=0.1, attn_dropout=0.0,
+                 seq_parallel=False, dtype="float32", remat=False,
+                 scan_layers=False, **kwargs):
+        super().__init__(**kwargs)
+        self._remat = remat
+        self._scan_layers = scan_layers
+        self._seq_parallel = seq_parallel
+        self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
+                                       weight_initializer="xavier")
+        self.position_embed = Parameter(
+            "position_weight", shape=(max_length, units), dtype=dtype,
+            init="xavier")
+        self.position_embed.shard_hint = "embedding"
+        self.embed_dropout = nn.Dropout(dropout) if dropout else None
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(GPTBlock(units, hidden_size, num_heads, dropout,
+                                     dtype, attn_dropout=attn_dropout,
+                                     seq_parallel=seq_parallel))
+        self.ln_f = nn.LayerNorm(in_channels=units)
+
+    def forward(self, inputs, valid_length=None):
+        B, L = inputs.shape
+        from ..parallel import in_manual
+        sp_manual = self._seq_parallel and in_manual("sp")
+        x = self.word_embed(inputs)
+        x = x + _positions(self.position_embed, L, sp_manual).expand_dims(
+            axis=0)
+        if self.embed_dropout:
+            x = self.embed_dropout(x)
+        mask = None
+        if valid_length is not None:
+            import jax
+            import jax.numpy as jnp
+            vl = valid_length._data if isinstance(valid_length, NDArray) \
+                else valid_length
+            idx = jnp.arange(L)
+            if sp_manual:
+                idx = idx + jax.lax.axis_index("sp") * L
+            mask = NDArray(idx[None, :] < vl[:, None].astype(jnp.int32))
+        if self._seq_parallel and not sp_manual:
+            from ..ndarray import apply_op
+            from ..parallel import specs as _sp
+            x = apply_op(_sp.constrain_seq, x)
+        from .. import _engine
+        use_remat = self._remat and not _engine.is_recording()
+        if self._scan_layers and not _engine.is_recording():
+            x = _scan_layers_call(list(self.layers), x, mask, use_remat)
+        else:
+            from .bert import _remat_call
+            for layer in self.layers:
+                if use_remat:
+                    x = _remat_call(layer, x, mask)
+                else:
+                    x = layer(x, mask)
+        # pin to batch sharding before the tied-embedding head: same
+        # rationale as BERTModel — the head matmul against fsdp-sharded
+        # word_embed weights otherwise propagates conflicting feature
+        # shardings onto d(hidden), which GSPMD resolves by full remat
+        from ..ndarray import apply_op
+        from ..parallel import specs as _specs
+        x = apply_op(_specs.constrain_batch, x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(HybridBlock):
+    """Hidden states -> tied-embedding logits (B, L, V)."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        self.gpt = GPTModel(**cfg)
+
+    def forward(self, inputs, valid_length=None):
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+
+        h = self.gpt(inputs, valid_length)
+        return apply_op(lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
+                        h, self.gpt.word_embed.weight.data())
+
+
+def gpt_lm_loss(logits, labels, weights):
+    """Next-token cross entropy on NDArrays (ShardedTrainer loss_fn and
+    eager compatible). logits (B, L, V) at input positions, labels (B, L)
+    the NEXT token at each position (pre-shifted by the data pipeline so
+    sequence-parallel shards stay self-contained), weights (B, L) 0/1."""
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray import apply_op
+
+    def compute(lg, lb, w):
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(
+            logp, lb.astype(jnp.int32)[..., None], -1)[..., 0]
+        w = w.astype(jnp.float32)
+        return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    return apply_op(compute, logits, labels, weights)
+
+
+def make_synthetic_batch(cfg, batch_size, seq_len, seed=0):
+    """Tokens + pre-shifted next-token labels + weights, numpy."""
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, cfg["vocab_size"],
+                       (batch_size, seq_len + 1)).astype(np.int32)
+    return {
+        "input_ids": toks[:, :-1],
+        "labels": toks[:, 1:],
+        "weights": np.ones((batch_size, seq_len), np.float32),
+        "valid_length": np.full((batch_size,), seq_len, np.int32),
+    }
+
+
+def tp_rules(tp_axis="tp"):
+    """Megatron sharding for GPT params: bert.tp_rules verbatim (the block
+    param names match by construction) plus the position table on its
+    feature dim — the tied LM head then contracts over the sharded dim
+    with a psum."""
+    from jax.sharding import PartitionSpec as P
+    return _bert_tp_rules(tp_axis) + [(r"position_weight$", P(None, tp_axis))]

@@ -147,6 +147,11 @@ def param_spec(param, mesh=None, mode="replicate"):
         return s
     if mode == "fsdp":
         return fsdp_spec(param.shape, mesh, getattr(param, "shard_hint", None))
+    if mode != "replicate":
+        # an unrecognized mode must not silently replicate — a typo like
+        # "shard" would otherwise run (and test) the wrong configuration
+        raise ValueError(f"param_mode {mode!r}: expected 'replicate' or "
+                         "'fsdp'")
     return replicated(mesh)
 
 

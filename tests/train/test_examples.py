@@ -23,6 +23,9 @@ CASES = [
     ("bert/long_context.py",
      ["--dp", "2", "--sp", "2", "--pp", "2", "--seq-len", "64",
       "--steps", "2"], "step 2"),
+    ("gpt/pretrain.py",
+     ["--config", "tiny", "--dp", "2", "--sp", "2", "--seq-len", "64",
+      "--steps", "2"], "step 1"),
     ("nmt/train_transformer.py",
      ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
       "--units", "32"], "decode token accuracy"),

@@ -1,0 +1,118 @@
+"""GPT-2-style causal LM (reference: gluonnlp model-zoo text-generation
+family): causality, trainability, scan_layers parity, and causal-ring
+sequence-parallel loss parity — the decoder-only counterpart of the
+BERT sp/scan integration tests."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.models import gpt as gm
+
+from jax.sharding import PartitionSpec as P
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    parallel.set_mesh(None)
+
+
+def _train(mesh_axes, cfg_over=None, steps=3, B=8, L=32, opt="adam",
+           param_mode="replicate"):
+    n = int(np.prod([v for v in mesh_axes.values() if v > 0])) or None
+    parallel.make_mesh(devices=jax.devices()[:n] if n else None, **mesh_axes)
+    cfg = gm.gpt_tiny_config(**(cfg_over or {}))
+    m = gm.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    data_specs = label_specs = None
+    if cfg["seq_parallel"]:
+        batch_axes = ("dp", "fsdp")
+        data_specs = [P(batch_axes, "sp"), P(batch_axes)]
+        label_specs = [P(batch_axes, "sp"), P(batch_axes, "sp")]
+    tr = parallel.ShardedTrainer(m, gm.gpt_lm_loss, opt,
+                                 {"learning_rate": 1e-3},
+                                 param_mode=param_mode,
+                                 data_specs=data_specs,
+                                 label_specs=label_specs)
+    out = []
+    for i in range(steps):
+        b = gm.make_synthetic_batch(cfg, B, L, seed=i)
+        data = [nd.array(b["input_ids"]), nd.array(b["valid_length"])]
+        labels = [nd.array(b["labels"]), nd.array(b["weights"])]
+        out.append(float(tr.step(data, labels).asscalar()))
+    return m, tr, out
+
+
+def test_gpt_trains_and_is_causal():
+    m, tr, losses = _train({"dp": -1}, steps=5)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    tr.sync_to_block()
+    cfg = m.cfg
+    b = gm.make_synthetic_batch(cfg, 4, 32, seed=9)
+    x = b["input_ids"]
+    vl = nd.array(b["valid_length"])
+    l1 = m(nd.array(x), vl).asnumpy()
+    x2 = x.copy()
+    x2[:, 20:] = (x2[:, 20:] + 7) % cfg["vocab_size"]
+    l2 = m(nd.array(x2), vl).asnumpy()
+    np.testing.assert_allclose(l1[:, :20], l2[:, :20], atol=1e-5)
+    assert not np.allclose(l1[:, 20:], l2[:, 20:])
+
+
+def test_gpt_scan_layers_parity():
+    _, _, a = _train({"dp": -1}, {"num_layers": 3})
+    parallel.set_mesh(None)
+    _, _, b = _train({"dp": -1}, {"num_layers": 3, "scan_layers": True,
+                                  "remat": True})
+    np.testing.assert_allclose(a, b, rtol=2e-5)
+
+
+def test_gpt_fsdp_parity():
+    """replicate vs fsdp-sharded params: the tied-embedding head matmul
+    against fsdp-sharded word_embed must hit the constrain_batch pin
+    (GPTModel.forward), not a GSPMD full-remat, and losses must match."""
+    _, _, a = _train({"dp": -1})
+    parallel.set_mesh(None)
+    _, _, b = _train({"dp": -1}, param_mode="fsdp")
+    np.testing.assert_allclose(a, b, rtol=2e-5)
+
+
+def test_gpt_causal_ring_sp_parity():
+    """dp=4 dense-causal vs dp=2 x sp=2 causal-RING loss trajectories:
+    the sequence (and the per-position labels/weights) shard over sp."""
+    _, _, dense = _train({"dp": 4})
+    parallel.set_mesh(None)
+    _, _, ring = _train({"dp": 2, "sp": 2}, {"seq_parallel": True})
+    np.testing.assert_allclose(dense, ring, rtol=2e-4)
+
+
+def test_gpt_cyclic_sequence_gate():
+    """Falsifiable convergence gate (SyntheticGratings pattern): on a
+    deterministic cyclic token sequence next-token prediction is exact,
+    so a working causal LM must drive loss below 0.35 in 60 steps
+    (random-guess baseline: ln(16) ~ 2.77). Fails if the causal mask,
+    position embeddings, or the tied LM head silently regress."""
+    parallel.make_mesh(dp=-1)
+    cfg = gm.gpt_tiny_config(vocab_size=16, dropout=0.0)
+    m = gm.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    tr = parallel.ShardedTrainer(m, gm.gpt_lm_loss, "adam",
+                                 {"learning_rate": 3e-3})
+    B, L, period = 8, 32, 5
+    toks = np.stack([
+        [(i + p) % period + 1 for i in range(L + 1)]
+        for p in range(B)]).astype(np.int32)
+    data = [nd.array(toks[:, :-1]),
+            nd.array(np.full((B,), L, np.int32))]
+    labels = [nd.array(toks[:, 1:]),
+              nd.array(np.ones((B, L), np.float32))]
+    loss = None
+    for _ in range(60):
+        loss = float(tr.step(data, labels).asscalar())
+    assert loss < 0.35, f"cyclic-sequence loss stuck at {loss:.3f}"

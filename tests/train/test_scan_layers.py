@@ -48,8 +48,8 @@ def test_scan_remat_loss_parity():
 
 
 def test_scan_fsdp_parity():
-    np.testing.assert_allclose(_losses(False, param_mode="shard"),
-                               _losses(True, param_mode="shard"), rtol=2e-5)
+    np.testing.assert_allclose(_losses(False, param_mode="fsdp"),
+                               _losses(True, param_mode="fsdp"), rtol=2e-5)
 
 
 def test_scan_dropout_trains():
