@@ -1,0 +1,49 @@
+"""Shared incremental-decode scaffolding for the autoregressive models
+(TransformerNMT beam/greedy decode, GPT generate).
+
+One pattern, one place: wrap a model's `decode_step`-style function in a
+throwaway HybridBlock taking flat positional state, functionalize it
+(`gluon.functional_call`), `jax.jit` it, and return a runner that re-reads
+the model's parameters on every call — parameters are jit ARGUMENTS, not
+baked constants, so decoding stays correct after further training."""
+from ..gluon import HybridBlock
+
+
+def jit_flat_step(model, step_fn, n_state):
+    """step_fn(*leading, flat_state: list) -> (primary, new_state: list).
+
+    `model` MUST be the block whose parameters step_fn uses: registering
+    it as a child is what makes functional_call substitute its parameters
+    as jit ARGUMENTS — without it they trace as closure CONSTANTS and
+    decoding silently freezes at the weights of the first compile
+    (pinned by tests/train/test_decode.py::test_decode_sees_updated_weights).
+
+    Returns run(*leading_arrays, state_list) -> (primary, new_state) with
+    everything jitted; `leading` are the per-call scalars/arrays before the
+    flat state (token ids, step index, masks, constant caches...)."""
+    import jax
+
+    from ..gluon.block import functional_call
+
+    class _Step(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.model = model
+
+        def forward(self, *args):
+            leading, flat = args[:-n_state], list(args[-n_state:])
+            primary, new_state = step_fn(*leading, flat)
+            return tuple([primary] + list(new_state))
+
+    pure, gp, aux = functional_call(_Step(), train=False)
+    jitted = jax.jit(pure)
+    rng = jax.random.key(0)
+
+    def run(*args):
+        leading, state = args[:-1], list(args[-1])
+        gp_data = [p.data()._data for _, p in gp]
+        aux_data = [p.data()._data for _, p in aux]
+        outs, _ = jitted(gp_data, aux_data, rng, *leading, *state)
+        return outs[0], list(outs[1:])
+
+    return run

@@ -86,6 +86,51 @@ class GPTBlock(HybridBlock):
             h = self.dropout(h)
         return x + h
 
+    def step(self, x, k_cache, v_cache, t):
+        """One-token incremental step against a static-shape KV cache
+        (inference; same scheme as transformer.TransformerLayer.step).
+        x (B,1,E); caches (B,H,Lmax,D); t traced scalar — one compile
+        serves every position."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray import apply_op
+
+        attn = self.attn
+        H = attn._num_heads
+        h = self.ln1(x)
+        qkv = attn.qkv(h)                       # (B, 1, 3E)
+        B, _, E3 = qkv.shape
+        D = E3 // 3 // H
+
+        def split(qkv_d):
+            r = qkv_d.reshape(B, 1, 3, H, D)
+            return (r[:, :, 0].transpose(0, 2, 1, 3),
+                    r[:, :, 1].transpose(0, 2, 1, 3),
+                    r[:, :, 2].transpose(0, 2, 1, 3))   # (B,H,1,D) each
+
+        def upd(cache, new, tt):
+            return lax.dynamic_update_slice(
+                cache, new.astype(cache.dtype), (0, 0, tt.astype(jnp.int32), 0))
+
+        def att(qkv_d, kc, vc, tt):
+            q, k_new, v_new = split(qkv_d)
+            kc = upd(kc, k_new, tt)
+            vc = upd(vc, v_new, tt)
+            Lc = kc.shape[2]
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) / (D ** 0.5)
+            valid = jnp.arange(Lc)[None, None, None, :] <= tt.astype(jnp.int32)
+            scores = jnp.where(valid, scores, -1e30)
+            p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+            return o.transpose(0, 2, 1, 3).reshape(B, 1, H * D), kc, vc
+
+        import jax
+        o, k_cache, v_cache = apply_op(att, qkv, k_cache, v_cache, t)
+        x = x + attn.proj(o)
+        h2 = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
+                                       act_type="gelu"))
+        return x + h2, k_cache, v_cache
+
 
 class GPTModel(HybridBlock):
     """Token+position embeddings -> pre-LN block stack -> final LN.
@@ -172,6 +217,132 @@ class GPTForCausalLM(HybridBlock):
         h = self.gpt(inputs, valid_length)
         return apply_op(lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
                         h, self.gpt.word_embed.weight.data())
+
+    # -- incremental generation (static-shape KV cache) -------------------
+    def decode_step(self, tok, t, self_k, self_v):
+        """One incremental step: tok (B,) int32, t traced scalar position;
+        returns (logits (B,V), new_self_k, new_self_v). Same scheme as
+        transformer.TransformerNMT.decode_step — one compile serves every
+        position, including the prompt prefill."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray import apply_op
+
+        g = self.gpt
+        x = g.word_embed(tok.reshape(shape=(-1, 1)))
+        pos = apply_op(
+            lambda pe, tt: lax.dynamic_slice(
+                pe, (tt.astype(jnp.int32), 0), (1, pe.shape[1]))[None],
+            NDArray(g.position_embed.data()._data), t)
+        x = x + pos
+        new_k, new_v = [], []
+        for i, layer in enumerate(g.layers):
+            x, k, v = layer.step(x, self_k[i], self_v[i], t)
+            new_k.append(k)
+            new_v.append(v)
+        x = g.ln_f(x)
+        logits = apply_op(
+            lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
+            x, g.word_embed.weight.data())
+        return logits.reshape(shape=(tok.shape[0], -1)), new_k, new_v
+
+    def _init_generate(self, B, max_len):
+        """Allocate caches and jit the step (shape-keyed — the reference
+        analog is gluonnlp's SequenceSampler over a hybridized decoder)."""
+        import jax
+        import jax.numpy as jnp
+
+        g = self.gpt
+        n_l = len(g.layers)
+        H = g.layers[0].attn._num_heads
+        E = g.word_embed.weight.shape[1]
+        D = E // H
+        dt = g.word_embed.weight.data()._data.dtype
+        self_k = [jnp.zeros((B, H, max_len, D), dt) for _ in range(n_l)]
+        self_v = [jnp.zeros((B, H, max_len, D), dt) for _ in range(n_l)]
+
+        key = (B, max_len)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        if key not in self._gen_cache:
+            from ._decode import jit_flat_step
+
+            def step(tok, t, flat):
+                logits, nk, nv = self.decode_step(
+                    tok, t, flat[:n_l], flat[n_l:])
+                return logits, nk + nv
+
+            run_flat = jit_flat_step(self, step, 2 * n_l)
+
+            def run(tok, t, sk, sv):
+                logits, state = run_flat(tok, t, sk + sv)
+                return logits, state[:n_l], state[n_l:]
+
+            self._gen_cache[key] = run
+        return self._gen_cache[key], self_k, self_v
+
+    def generate(self, prompt, max_new_tokens=32, eos=None, temperature=0.0,
+                 top_k=0, seed=0):
+        """Autoregressive generation from int prompt tokens (B, Lp):
+        greedy when temperature == 0, else softmax sampling at the given
+        temperature (optionally truncated to the top_k logits) — the
+        gluonnlp text_generation sampler surface. Returns (B, <=
+        max_new_tokens) numpy tokens (rows stop growing at `eos`).
+
+        The prompt prefills through the SAME jitted one-token step as
+        generation (one compile per (B, max_len) geometry)."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32)
+        B, Lp = prompt.shape
+        need = Lp + max_new_tokens
+        limit = self.gpt.position_embed.shape[0]
+        if need > limit:
+            raise ValueError(
+                f"prompt {Lp} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_length {limit}")
+        if Lp == 0 or max_new_tokens <= 0:
+            return np.zeros((B, 0), np.int32)
+        # bucket the cache length (next power of two, capped at the
+        # position table) so one compile serves every prompt length —
+        # t is traced, only the cache SHAPE keys the jit
+        max_len = 16
+        while max_len < need:
+            max_len *= 2
+        max_len = min(max_len, limit)
+        run, self_k, self_v = self._init_generate(B, max_len)
+        rng = np.random.RandomState(seed)
+        logits = None
+        for t in range(Lp):
+            logits, self_k, self_v = run(
+                jnp.asarray(prompt[:, t]), jnp.asarray(t, jnp.int32),
+                self_k, self_v)
+        out = []
+        finished = np.zeros(B, bool)
+        for i in range(max_new_tokens):
+            lg = np.asarray(logits, np.float32)
+            if temperature and temperature > 0.0:
+                if top_k:
+                    kth = np.partition(lg, -top_k, axis=-1)[:, -top_k][:, None]
+                    lg = np.where(lg < kth, -np.inf, lg)
+                lg = lg / temperature
+                p = np.exp(lg - lg.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                nxt = np.stack([rng.choice(p.shape[1], p=p[b])
+                                for b in range(B)]).astype(np.int32)
+            else:
+                nxt = lg.argmax(-1).astype(np.int32)
+            if eos is not None:
+                nxt = np.where(finished, eos, nxt)
+                finished |= nxt == eos
+            out.append(nxt)
+            if eos is not None and finished.all():
+                break
+            if i < max_new_tokens - 1:
+                logits, self_k, self_v = run(
+                    jnp.asarray(nxt), jnp.asarray(Lp + i, jnp.int32),
+                    self_k, self_v)
+        return np.stack(out, axis=1)
 
 
 def gpt_lm_loss(logits, labels, weights):

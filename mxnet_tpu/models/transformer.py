@@ -261,37 +261,21 @@ class TransformerNMT(HybridBlock):
         if not hasattr(self, "_decode_cache"):
             self._decode_cache = {}
         if key not in self._decode_cache:
-            from ..gluon.block import functional_call
-            model = self
+            from ._decode import jit_flat_step
             n_l = n
 
-            class _Step(HybridBlock):
-                def __init__(self):
-                    super().__init__()
-                    self.model = model
+            def step(tok, t, enc_mask_a, flat):
+                logits, nk, nv = self.decode_step(
+                    tok, t, enc_mask_a, flat[:n_l], flat[n_l:2 * n_l],
+                    flat[2 * n_l:3 * n_l], flat[3 * n_l:])
+                return logits, nk + nv   # enc caches are read-only inputs
 
-                def forward(self, tok, t, enc_mask, *flat):
-                    sk = list(flat[0:n_l])
-                    sv = list(flat[n_l:2 * n_l])
-                    ek = list(flat[2 * n_l:3 * n_l])
-                    ev = list(flat[3 * n_l:4 * n_l])
-                    logits, nk, nv = model.decode_step(
-                        tok, t, enc_mask, sk, sv, ek, ev)
-                    return tuple([logits] + nk + nv)
-
-            step_block = _Step()
-            pure, gp, aux = functional_call(step_block, train=False)
-            jitted = jax.jit(pure)
-            rng = jax.random.key(0)
+            run_flat = jit_flat_step(self, step, 4 * n_l)
 
             def run(tok, t, enc_mask_d, sk, sv, ek, ev):
-                # parameters are re-read per call (jit ARGUMENTS, not baked
-                # constants): decoding stays correct after further training
-                gp_data = [p.data()._data for _, p in gp]
-                aux_data = [p.data()._data for _, p in aux]
-                outs, _ = jitted(gp_data, aux_data, rng, tok, t, enc_mask_d,
-                                 *(sk + sv + ek + ev))
-                return outs[0], list(outs[1:1 + n_l]), list(outs[1 + n_l:])
+                logits, state = run_flat(tok, t, enc_mask_d,
+                                         sk + sv + ek + ev)
+                return logits, state[:n_l], state[n_l:]
 
             self._decode_cache[key] = run
         run = self._decode_cache[key]
