@@ -9,6 +9,39 @@ baked constants, so decoding stays correct after further training."""
 from ..gluon import HybridBlock
 
 
+def cached_self_attention_step(q, k_new, v_new, k_cache, v_cache, t):
+    """The one-token causal KV-cache attention inner shared by
+    MultiHeadAttention.self_step (NMT) and GPTBlock.step: write this
+    token's K/V at position t, attend q over positions <= t.
+
+    q/k_new/v_new (B,H,1,D); caches (B,H,Lmax,D); t traced scalar.
+    Returns (out (B,1,H*D), new_k, new_v). Score/softmax/PV math runs in
+    float32 regardless of cache dtype (bf16 caches would otherwise give
+    decode logits that diverge from the training forward's f32-accumulate
+    flash kernel)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ndarray import apply_op
+
+    def f(q_, kn, vn, kc, vc, tt):
+        ti = tt.astype(jnp.int32)
+        kc = lax.dynamic_update_slice(kc, kn.astype(kc.dtype), (0, 0, ti, 0))
+        vc = lax.dynamic_update_slice(vc, vn.astype(vc.dtype), (0, 0, ti, 0))
+        B, H, _, D = q_.shape
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / (D ** 0.5)
+        valid = jnp.arange(kc.shape[2])[None, None, None, :] <= ti
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                       vc.astype(jnp.float32)).astype(q_.dtype)
+        return o.transpose(0, 2, 1, 3).reshape(B, 1, H * D), kc, vc
+
+    return apply_op(f, q, k_new, v_new, k_cache, v_cache, t)
+
+
 def jit_flat_step(model, step_fn, n_state):
     """step_fn(*leading, flat_state: list) -> (primary, new_state: list).
 

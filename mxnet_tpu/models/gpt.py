@@ -91,14 +91,12 @@ class GPTBlock(HybridBlock):
         (inference; same scheme as transformer.TransformerLayer.step).
         x (B,1,E); caches (B,H,Lmax,D); t traced scalar — one compile
         serves every position."""
-        import jax.numpy as jnp
-        from jax import lax
         from ..ndarray import apply_op
+        from ._decode import cached_self_attention_step
 
         attn = self.attn
         H = attn._num_heads
-        h = self.ln1(x)
-        qkv = attn.qkv(h)                       # (B, 1, 3E)
+        qkv = attn.qkv(self.ln1(x))             # (B, 1, 3E)
         B, _, E3 = qkv.shape
         D = E3 // 3 // H
 
@@ -108,24 +106,9 @@ class GPTBlock(HybridBlock):
                     r[:, :, 1].transpose(0, 2, 1, 3),
                     r[:, :, 2].transpose(0, 2, 1, 3))   # (B,H,1,D) each
 
-        def upd(cache, new, tt):
-            return lax.dynamic_update_slice(
-                cache, new.astype(cache.dtype), (0, 0, tt.astype(jnp.int32), 0))
-
-        def att(qkv_d, kc, vc, tt):
-            q, k_new, v_new = split(qkv_d)
-            kc = upd(kc, k_new, tt)
-            vc = upd(vc, v_new, tt)
-            Lc = kc.shape[2]
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) / (D ** 0.5)
-            valid = jnp.arange(Lc)[None, None, None, :] <= tt.astype(jnp.int32)
-            scores = jnp.where(valid, scores, -1e30)
-            p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
-            return o.transpose(0, 2, 1, 3).reshape(B, 1, H * D), kc, vc
-
-        import jax
-        o, k_cache, v_cache = apply_op(att, qkv, k_cache, v_cache, t)
+        q, k_new, v_new = apply_op(split, qkv)
+        o, k_cache, v_cache = cached_self_attention_step(
+            q, k_new, v_new, k_cache, v_cache, t)
         x = x + attn.proj(o)
         h2 = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
                                        act_type="gelu"))

@@ -89,25 +89,14 @@ class MultiHeadAttention(HybridBlock):
     def self_step(self, x, k_cache, v_cache, t):
         """Write this token's K/V at position t, attend over positions <= t.
         Returns (out (B,1,E), new_k, new_v)."""
-        import jax.numpy as jnp
-        from jax import lax
-        from ..ndarray import apply_op
+        from ._decode import cached_self_attention_step
 
-        k_new = self._heads_of(self.k_proj, x)              # (B,H,1,D)
+        q = self._heads_of(self.q_proj, x)                  # (B,H,1,D)
+        k_new = self._heads_of(self.k_proj, x)
         v_new = self._heads_of(self.v_proj, x)
-
-        def upd(cache, new, tt):
-            return lax.dynamic_update_slice(
-                cache, new.astype(cache.dtype), (0, 0, tt.astype(jnp.int32), 0))
-
-        k_cache = apply_op(upd, k_cache, k_new, t)
-        v_cache = apply_op(upd, v_cache, v_new, t)
-        Lc = k_cache.shape[2]
-        mask = apply_op(
-            lambda tt: jnp.arange(Lc)[None, :] <= tt.astype(jnp.int32),
-            t)
-        mask = mask.broadcast_to((x.shape[0], Lc))
-        return self.attend_cached(x, k_cache, v_cache, mask), k_cache, v_cache
+        o, k_cache, v_cache = cached_self_attention_step(
+            q, k_new, v_new, k_cache, v_cache, t)
+        return self.out_proj(o), k_cache, v_cache
 
 
 class TransformerLayer(HybridBlock):
