@@ -1,5 +1,6 @@
 """Text utilities: vocabulary + pretrained embeddings (reference:
 python/mxnet/contrib/text/ — vocab.py, embedding.py, utils.py)."""
-from . import embedding, tokenizer, utils, vocab   # noqa: F401
+from . import bpe, embedding, tokenizer, utils, vocab  # noqa: F401
+from .bpe import BPETokenizer, learn_bpe           # noqa: F401
 from .tokenizer import BERTTokenizer               # noqa: F401
 from .vocab import Vocabulary                      # noqa: F401

@@ -122,3 +122,52 @@ def test_composite_embedding(tmp_path):
     # "quick": missing in e1 (zeros), present in e2
     q = comp.get_vecs_by_tokens("quick").asnumpy()
     np.testing.assert_allclose(q, [0, 0, 0, 0, 1, 1])
+
+
+# -- byte-level BPE -------------------------------------------------------
+
+def test_bpe_roundtrip_any_unicode():
+    from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "the quick brown fox is quick"]
+    tok = BPETokenizer(learn_bpe(corpus, 50))
+    for s in ["the quick fox", "Ünïcôdé — naïve café ☕😀",
+              "tabs\tand\nnewlines  spaces", "", "日本語テキスト"]:
+        assert tok.decode(tok.encode(s)) == s, s
+
+
+def test_bpe_learns_compression():
+    from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+    corpus = ["low lower lowest slow slower slowest"] * 4
+    merges = learn_bpe(corpus, 40)
+    tok = BPETokenizer(merges)
+    raw_len = len("low lower lowest".encode("utf8"))
+    enc = tok.encode("low lower lowest")
+    assert len(enc) < raw_len  # merges actually merged
+    # deterministic: same corpus -> same merges
+    assert merges == learn_bpe(corpus, 40)
+
+
+def test_bpe_special_tokens_and_persistence(tmp_path):
+    from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+    tok = BPETokenizer(learn_bpe(["aa ab aa"], 10),
+                       special_tokens=("<eos>",))
+    eos = tok.special_tokens["<eos>"]
+    assert eos == len(tok) - 1
+    ids = tok.encode("aa ab") + [eos]
+    assert tok.decode(ids) == "aa ab"  # special id dropped on decode
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.encode("aa ab") == tok.encode("aa ab")
+    assert tok2.special_tokens == tok.special_tokens
+
+
+def test_bpe_underscore_and_collisions():
+    from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+    import pytest as _pytest
+    tok = BPETokenizer(learn_bpe(["a b"], 5))
+    for s in ["snake_case_name", "__init__", "a_b c _"]:
+        assert tok.decode(tok.encode(s)) == s
+    with _pytest.raises(ValueError):
+        BPETokenizer([], special_tokens=("a",))
