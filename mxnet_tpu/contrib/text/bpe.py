@@ -70,24 +70,45 @@ def learn_bpe(texts, num_merges):
     """Learn `num_merges` byte-level BPE merges from an iterable of
     strings. Returns a merge list (pairs of symbol strings, highest
     priority first) for BPETokenizer. Deterministic: frequency ties break
-    lexicographically."""
+    lexicographically.
+
+    Incremental formulation (subword-nmt style): each merge re-scans only
+    the words CONTAINING the merged pair, not the whole corpus — a 32k
+    table over ~100k word types is minutes, not hours."""
     word_freq = Counter()
     for t in texts:
         for w in _pre_tokenize(t):
             word_freq[_to_symbols(w)] += 1
+
+    pair_count = Counter()
+    pair_words = {}                        # pair -> set of words holding it
+    for w, f in word_freq.items():
+        for p in zip(w, w[1:]):
+            pair_count[p] += f
+            pair_words.setdefault(p, set()).add(w)
+
     merges = []
     for _ in range(int(num_merges)):
-        pairs = Counter()
-        for w, f in word_freq.items():
-            for a, b in zip(w, w[1:]):
-                pairs[(a, b)] += f
-        if not pairs:
+        pair_count = +pair_count           # drop <=0 entries
+        if not pair_count:
             break
-        best = min(pairs.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        best = min(pair_count.items(), key=lambda kv: (-kv[1], kv[0]))[0]
         merges.append(best)
         joined = best[0] + best[1]
-        word_freq = Counter({_merge_word(w, best, joined): f
-                             for w, f in word_freq.items()})
+        for w in list(pair_words.get(best, ())):
+            f = word_freq.pop(w, 0)
+            if not f:
+                continue
+            for p in zip(w, w[1:]):
+                pair_count[p] -= f
+                s = pair_words.get(p)
+                if s is not None:
+                    s.discard(w)
+            nw = _merge_word(w, best, joined)
+            word_freq[nw] += f
+            for p in zip(nw, nw[1:]):
+                pair_count[p] += f
+                pair_words.setdefault(p, set()).add(nw)
     return merges
 
 
@@ -101,10 +122,16 @@ class BPETokenizer:
     def __init__(self, merges, special_tokens=()):
         self.merges = [tuple(m) for m in merges]
         self.ranks = {m: i for i, m in enumerate(self.merges)}
-        syms = [_B2U[b] for b in range(256)]
-        syms += [a + b for a, b in self.merges]
-        self.token_to_idx = {s: i for i, s in enumerate(syms)}
-        self.idx_to_token = list(syms)
+        self.token_to_idx = {}
+        self.idx_to_token = []
+        # two merges CAN concatenate to the same string (('a','bc') and
+        # ('ab','c')): keep one id per distinct string so len() is the
+        # usable vocab and no embedding row is unreachable
+        for s in [_B2U[b] for b in range(256)] + \
+                 [a + b for a, b in self.merges]:
+            if s not in self.token_to_idx:
+                self.token_to_idx[s] = len(self.idx_to_token)
+                self.idx_to_token.append(s)
         self.special_tokens = {}
         for s in special_tokens:
             if s in self.token_to_idx:
@@ -147,7 +174,9 @@ class BPETokenizer:
         """ids -> text (special tokens are dropped)."""
         n_spec = len(self.special_tokens)
         base = len(self.idx_to_token) - n_spec
-        text = "".join(self.idx_to_token[i] for i in ids if i < base)
+        # 0 <= guard: a negative id (e.g. -1 padding) would python-wrap
+        # to the END of idx_to_token and leak special-token text
+        text = "".join(self.idx_to_token[i] for i in ids if 0 <= i < base)
         data = bytes(_U2B[u] for u in text)
         return data.decode("utf-8", errors="replace")
 

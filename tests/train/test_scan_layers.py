@@ -104,3 +104,51 @@ def test_scan_layer_keys_differ():
 def test_bert_large_defaults_scan():
     assert bm.bert_large_config()["scan_layers"] is True
     assert bm.bert_base_config()["scan_layers"] is False
+
+
+def _fwdbwd_temp_bytes(num_layers):
+    """Compiled temp for scan+remat forward+backward at a given depth
+    (same memory_analysis technique as the ring/fused-LAMB pins)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon import functional_call
+
+    cfg = bm.bert_tiny_config(num_layers=num_layers, units=64,
+                              hidden_size=128, num_heads=4, dropout=0.0,
+                              remat=True, scan_layers=True)
+    m = bm.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    fn, gp, aux = functional_call(m, train=True)
+    params = [p.data()._data for _, p in gp]
+    aux_d = [p.data()._data for _, p in aux]
+    b = bm.make_synthetic_batch(cfg, 4, 64, 8, seed=0)
+    args = [b[k] for k in ("input_ids", "token_types", "valid_length",
+                           "masked_positions")]
+
+    def loss(params):
+        (mlm, nsp), _ = fn(params, aux_d, jax.random.key(0), *args)
+        return jnp.sum(mlm.astype(jnp.float32)) + jnp.sum(
+            nsp.astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss))
+    return (g.lower(params).compile().memory_analysis().temp_size_in_bytes,
+            sum(int(np.prod(p.shape)) for p in params))
+
+
+def test_scan_remat_memory_flat_in_depth():
+    """The scan-over-remat pairing's point: activation temp must scale
+    FAR below linearly in depth (each layer recomputes in the backward;
+    only the per-layer boundary x rides the scan). Without remat, temp
+    would grow ~Nx with N layers."""
+    parallel.make_mesh(dp=-1)
+    t4, n4 = _fwdbwd_temp_bytes(4)
+    t16, n16 = _fwdbwd_temp_bytes(16)
+    # subtract the stacked-parameter share (grows linearly by design):
+    # 4x the depth must cost < 2x the non-param temp
+    p4, p16 = n4 * 4, n16 * 4
+    ratio = (t16 - p16) / max(t4 - p4, 1)
+    assert ratio < 2.0, (
+        f"scan+remat activation temp grew {ratio:.2f}x from 4 to 16 "
+        f"layers ({t4 - p4} -> {t16 - p16} bytes): remat not in effect")

@@ -171,3 +171,15 @@ def test_bpe_underscore_and_collisions():
         assert tok.decode(tok.encode(s)) == s
     with _pytest.raises(ValueError):
         BPETokenizer([], special_tokens=("a",))
+
+
+def test_bpe_negative_ids_and_merge_collisions():
+    from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+    tok = BPETokenizer(learn_bpe(["ab abc"], 8), special_tokens=("<eos>",))
+    # -1 padding must be dropped, not python-wrap into the special token
+    ids = tok.encode("ab") + [-1, tok.special_tokens["<eos>"]]
+    assert tok.decode(ids) == "ab"
+    # colliding merge concatenations keep len() == usable vocab
+    tok2 = BPETokenizer([("a", "bc"), ("b", "c"), ("ab", "c")])
+    assert len(tok2.idx_to_token) == len(set(tok2.idx_to_token))
+    assert len(tok2) == len(tok2.token_to_idx)
