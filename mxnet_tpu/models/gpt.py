@@ -86,6 +86,41 @@ class GPTBlock(HybridBlock):
             h = self.dropout(h)
         return x + h
 
+    def prefill(self, x, k_cache, v_cache):
+        """Full-prompt forward that ALSO writes K/V[0:Lp] into the caches:
+        on-device prefill is one batched (flash-attention) pass instead of
+        Lp sequential one-token steps. x (B, Lp, E); caches (B,H,Lmax,D).
+        Returns (y, new_k, new_v)."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray import apply_op
+
+        attn = self.attn
+        H = attn._num_heads
+        qkv = attn.qkv(self.ln1(x))             # (B, Lp, 3E)
+        B, Lp, E3 = qkv.shape
+        D = E3 // 3 // H
+
+        def split_write(qkv_d, kc, vc):
+            r = qkv_d.reshape(B, Lp, 3, H, D)
+            q = r[:, :, 0].transpose(0, 2, 1, 3)
+            k = r[:, :, 1].transpose(0, 2, 1, 3)
+            v = r[:, :, 2].transpose(0, 2, 1, 3)
+            kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, 0, 0, 0))
+            vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, 0, 0, 0))
+            return q, k, v, kc, vc
+
+        q, k, v, k_cache, v_cache = apply_op(split_write, qkv, k_cache,
+                                             v_cache)
+        o = F.flash_attention(q, k, v, None, causal=True)   # (B,H,Lp,D)
+        o = o.transpose(axes=(0, 2, 1, 3)).reshape(shape=(B, Lp, H * D))
+        x = x + attn.proj(o)
+        h = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
+                                      act_type="gelu"))
+        return x + h, k_cache, v_cache
+
     def step(self, x, k_cache, v_cache, t):
         """One-token incremental step against a static-shape KV cache
         (inference; same scheme as transformer.TransformerLayer.step).
@@ -235,14 +270,9 @@ class GPTForCausalLM(HybridBlock):
         import jax
         import jax.numpy as jnp
 
-        g = self.gpt
-        n_l = len(g.layers)
-        H = g.layers[0].attn._num_heads
-        E = g.word_embed.weight.shape[1]
-        D = E // H
-        dt = g.word_embed.weight.data()._data.dtype
-        self_k = [jnp.zeros((B, H, max_len, D), dt) for _ in range(n_l)]
-        self_v = [jnp.zeros((B, H, max_len, D), dt) for _ in range(n_l)]
+        n_l = len(self.gpt.layers)
+        caches = self._alloc_caches(B, max_len)
+        self_k, self_v = caches[:n_l], caches[n_l:]
 
         key = (B, max_len)
         if not hasattr(self, "_gen_cache"):
@@ -264,16 +294,145 @@ class GPTForCausalLM(HybridBlock):
             self._gen_cache[key] = run
         return self._gen_cache[key], self_k, self_v
 
+    def _alloc_caches(self, B, max_len):
+        """Zeroed per-layer K+V caches (the single source of cache
+        geometry for both generation paths)."""
+        import jax.numpy as jnp
+
+        g = self.gpt
+        n_l = len(g.layers)
+        H = g.layers[0].attn._num_heads
+        D = g.word_embed.weight.shape[1] // H
+        dt = g.word_embed.weight.data()._data.dtype
+        return [jnp.zeros((B, H, max_len, D), dt) for _ in range(2 * n_l)]
+
+    def _generate_on_device(self, prompt, max_new, eos, temperature, top_k,
+                            seed, max_len):
+        """Whole-generation as ONE jitted program: a batched flash
+        prefill fills the K/V caches, then a generation lax.scan samples
+        inside the trace — one host<->device round trip total instead of
+        one per token, which over a high-latency link (the axon tunnel)
+        dominates generation wall time.
+
+        The prompt right-pads to a bucket so one compile serves a range
+        of prompt lengths; temperature/eos/seed are traced scalars so
+        sweeping them reuses the compile (top_k and max_new are
+        structural: static). Pad-slot cache pollution is harmless:
+        prefill attention is causal (real positions never see pad slots)
+        and each generated step overwrites its slot before attending."""
+        import jax
+        import jax.numpy as jnp
+
+        B, Lp = prompt.shape
+        Lp_b = 16
+        while Lp_b < Lp:
+            Lp_b *= 2
+        Lp_b = min(Lp_b, max_len - 1)
+        pad = np.zeros((B, Lp_b - Lp), np.int32)
+        prompt_pad = np.concatenate([prompt, pad], axis=1)
+
+        n_l = len(self.gpt.layers)
+        do_sample = bool(temperature and temperature > 0.0)
+        key = ("dev", B, Lp_b, max_new, max_len, do_sample, int(top_k),
+               eos is not None)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        if key not in self._gen_cache:
+            from ._decode import jit_flat_step
+            model = self
+
+            def whole(prompt_d, lp_d, seed_d, temp_d, eos_d, flat):
+                # jit_flat_step hands us NDArray-wrapped tracers; this
+                # body speaks raw jax (lax.scan carries), so unwrap here
+                prompt_d, lp_d, seed_d, temp_d, eos_d = (
+                    prompt_d._data, lp_d._data, seed_d._data, temp_d._data,
+                    eos_d._data)
+                flat = [f._data for f in flat]
+
+                def wrap(d):
+                    return NDArray(d)
+
+                g = model.gpt
+                # batched prefill: embed + per-layer flash pass that also
+                # writes K/V[0:Lp_b]
+                x = g.word_embed(wrap(prompt_d))
+                x = x + NDArray(
+                    g.position_embed.data()._data[:Lp_b]).expand_dims(axis=0)
+                ks, vs = list(flat[:n_l]), list(flat[n_l:])
+                for i, layer in enumerate(g.layers):
+                    x, k, v = layer.prefill(x, wrap(ks[i]), wrap(vs[i]))
+                    ks[i], vs[i] = k._data, v._data
+                h = g.ln_f(x)._data
+                h_last = jax.lax.dynamic_index_in_dim(
+                    h, (lp_d - 1).astype(jnp.int32), axis=1, keepdims=False)
+                w = g.word_embed.weight.data()._data
+                logits = jnp.matmul(h_last, w.T.astype(h_last.dtype)) \
+                    .astype(jnp.float32)
+
+                rngk = jax.random.fold_in(
+                    jax.random.key(0), seed_d.astype(jnp.int32))
+
+                def gen_t(carry, i):
+                    logits, ks, vs, finished, rngk = carry
+                    lg = logits
+                    if do_sample:
+                        if top_k:
+                            kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                            lg = jnp.where(lg < kth, -jnp.inf, lg)
+                        rngk, sub = jax.random.split(rngk)
+                        nxt = jax.random.categorical(
+                            sub, lg / temp_d, axis=-1).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    if eos is not None:
+                        nxt = jnp.where(finished, eos_d.astype(jnp.int32),
+                                        nxt)
+                        finished = finished | (nxt == eos_d)
+                    t = lp_d + i
+                    lg2, nk, nv = model.decode_step(
+                        wrap(nxt), wrap(t),
+                        [wrap(k) for k in ks], [wrap(v) for v in vs])
+                    return (lg2._data.astype(jnp.float32),
+                            tuple(k._data for k in nk),
+                            tuple(v._data for v in nv),
+                            finished, rngk), nxt
+
+                finished0 = jnp.zeros((B,), bool)
+                (_, _, _, _, _), toks = jax.lax.scan(
+                    gen_t, (logits, tuple(ks), tuple(vs), finished0, rngk),
+                    jnp.arange(max_new))
+                return toks.T, []       # (B, max_new)
+
+            run = jit_flat_step(self, whole, 2 * n_l)
+            self._gen_cache[key] = run
+        run = self._gen_cache[key]
+        toks, _ = run(jnp.asarray(prompt_pad), jnp.asarray(Lp, jnp.int32),
+                      jnp.asarray(seed, jnp.int32),
+                      jnp.asarray(float(temperature or 1.0), jnp.float32),
+                      jnp.asarray(-1 if eos is None else eos, jnp.int32),
+                      self._alloc_caches(B, max_len))
+        out = np.asarray(toks, np.int32)
+        if eos is not None:
+            # trim trailing columns after every row finished (host-loop
+            # semantics: the step where the last row emits eos is kept)
+            allf = np.all(np.cumsum(out == eos, axis=1) >= 1, axis=0)
+            if allf.any():
+                out = out[:, :int(np.argmax(allf)) + 1]
+        return out
+
     def generate(self, prompt, max_new_tokens=32, eos=None, temperature=0.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, on_device=True):
         """Autoregressive generation from int prompt tokens (B, Lp):
         greedy when temperature == 0, else softmax sampling at the given
         temperature (optionally truncated to the top_k logits) — the
         gluonnlp text_generation sampler surface. Returns (B, <=
         max_new_tokens) numpy tokens (rows stop growing at `eos`).
 
-        The prompt prefills through the SAME jitted one-token step as
-        generation (one compile per (B, max_len) geometry)."""
+        on_device=True (default) runs prefill + the whole generation loop
+        as one jitted program (lax.scan, sampling in-trace) — a single
+        dispatch instead of one per token. on_device=False single-steps
+        through the same jitted one-token step from the host (useful for
+        debugging; identical greedy results, different sample streams)."""
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32)
@@ -293,6 +452,10 @@ class GPTForCausalLM(HybridBlock):
         while max_len < need:
             max_len *= 2
         max_len = min(max_len, limit)
+        if on_device:
+            return self._generate_on_device(
+                prompt, max_new_tokens, eos, temperature, top_k, seed,
+                max_len)
         run, self_k, self_v = self._init_generate(B, max_len)
         rng = np.random.RandomState(seed)
         logits = None

@@ -138,13 +138,15 @@ def test_gpt_generate_matches_full_forward():
     m.initialize()
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, cfg["vocab_size"], (2, 7)).astype(np.int32)
-    gen = m.generate(prompt, max_new_tokens=5)
+    gen = m.generate(prompt, max_new_tokens=5)                # on-device scan
+    gen_host = m.generate(prompt, max_new_tokens=5, on_device=False)
     seq = prompt.copy()
     for _ in range(5):
         logits = m(nd.array(seq)).asnumpy()
         nxt = logits[:, -1].argmax(-1).astype(np.int32)
         seq = np.concatenate([seq, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(gen, seq[:, 7:])
+    np.testing.assert_array_equal(gen_host, seq[:, 7:])
     # sampling surface: temperature + top_k stays in-vocab and respects eos
     s = m.generate(prompt, max_new_tokens=6, temperature=0.8, top_k=5,
                    eos=3, seed=1)
