@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Character-/subword-level text generation end to end (reference:
+gluonnlp scripts/text_generation): learn a byte-level BPE vocab from an
+in-script corpus (zero-egress), train a tiny GPT on it, then sample with
+the single-dispatch on-device generation loop.
+
+  JAX_PLATFORMS=cpu python examples/gpt/generate.py --steps 200
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir, os.pardir)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.contrib.text.bpe import BPETokenizer, learn_bpe
+from mxnet_tpu.models import gpt as gpt_mod
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog . "
+    "the lazy dog sleeps in the warm sun . "
+    "the quick fox runs through the green field . "
+    "a brown dog chases the quick fox . "
+) * 8
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--merges", type=int, default=80)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    tok = BPETokenizer(learn_bpe([CORPUS], args.merges))
+    ids = np.asarray(tok.encode(CORPUS), np.int32)
+    print(f"# corpus: {len(CORPUS)} chars -> {len(ids)} BPE tokens "
+          f"(vocab {len(tok)})")
+
+    if len(ids) < args.seq_len + 2:
+        raise SystemExit(
+            f"corpus tokenizes to {len(ids)} BPE tokens — need at least "
+            f"seq-len+2 ({args.seq_len + 2}); lower --seq-len or --merges")
+
+    parallel.make_mesh(dp=-1)
+    mesh = parallel.current_mesh()
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if args.batch_size % n_data:
+        raise SystemExit(
+            f"batch size {args.batch_size} must be divisible by the "
+            f"sharded data-axis size {n_data} (dp x fsdp)")
+    cfg = gpt_mod.gpt_tiny_config(vocab_size=len(tok),
+                                  max_length=max(64, args.seq_len * 2))
+    model = gpt_mod.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    trainer = parallel.ShardedTrainer(
+        model, gpt_mod.gpt_lm_loss, "adam", {"learning_rate": args.lr})
+
+    rng = np.random.RandomState(0)
+    L = args.seq_len
+    loss = None
+    for step in range(args.steps):
+        starts = rng.randint(0, len(ids) - L, args.batch_size)
+        chunk = np.stack([ids[s:s + L + 1] for s in starts])
+        data = [nd.array(chunk[:, :-1]),
+                nd.array(np.full((args.batch_size,), L, np.int32))]
+        labels = [nd.array(chunk[:, 1:]),
+                  nd.array(np.ones((args.batch_size, L), np.float32))]
+        loss = float(trainer.step(data, labels).asscalar())
+        if step % 50 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {loss:.4f}")
+
+    trainer.sync_to_block()
+    prompt_text = "the quick"
+    prompt = np.asarray([tok.encode(prompt_text)], np.int32)
+    out = model.generate(prompt, max_new_tokens=args.max_new,
+                         temperature=args.temperature, seed=1)
+    print(f"prompt: {prompt_text!r}")
+    print(f"generated: {tok.decode(out[0].tolist())!r}")
+    assert loss is None or np.isfinite(loss)
+
+
+if __name__ == "__main__":
+    main()

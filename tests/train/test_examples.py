@@ -26,6 +26,8 @@ CASES = [
     ("gpt/pretrain.py",
      ["--config", "tiny", "--dp", "2", "--sp", "2", "--seq-len", "64",
       "--steps", "2"], "step 1"),
+    ("gpt/generate.py",
+     ["--steps", "60", "--merges", "40", "--max-new", "8"], "generated:"),
     ("nmt/train_transformer.py",
      ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
       "--units", "32"], "decode token accuracy"),
