@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Autoregressive generation throughput: on-device whole-generation
+program vs host-driven single-token stepping (the r5 GPT `generate`
+surface). The interesting number on the axon tunnel is the gap — every
+host-loop token pays a full round trip, the on-device scan pays one.
+
+One JSON line per row:
+  {"path": "on_device"|"host_loop", "tokens_per_sec": ..., "ms_per_token":
+   ..., "batch": B, "prompt": Lp, "new": N}
+
+CPU smoke mode (tiny model) when no TPU; GPT-2 117m bf16 on the chip.
+Timing is host-fetch fenced (block_until_ready does not block on the
+tunnel).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    import bench
+    on_tpu = bench.probe_tpu()
+    if on_tpu:
+        bench.acquire_bench_lock()
+        bench.enable_compile_cache()
+
+    import jax
+    import numpy as np
+
+    if not on_tpu:
+        from jax.extend.backend import clear_backends
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models import gpt as gpt_mod
+
+    parallel.make_mesh(dp=-1)
+    if on_tpu:
+        cfg = gpt_mod.gpt2_117m_config(dtype="bfloat16")
+        B, Lp, N, reps = 8, 64, 64, 3
+    else:
+        cfg = gpt_mod.gpt_tiny_config()
+        B, Lp, N, reps = 2, 8, 16, 2
+
+    model = gpt_mod.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg["vocab_size"], (B, Lp)).astype(np.int32)
+
+    for path, on_device in (("on_device", True), ("host_loop", False)):
+        model.generate(prompt, max_new_tokens=N, on_device=on_device)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = model.generate(prompt, max_new_tokens=N,
+                                 on_device=on_device)
+        dt = (time.perf_counter() - t0) / reps
+        assert out.shape == (B, N)
+        print(json.dumps({
+            "path": path,
+            "tokens_per_sec": round(B * N / dt, 1),
+            "ms_per_token": round(dt / N * 1e3, 3),
+            "batch": B, "prompt": Lp, "new": N,
+            "backend": jax.default_backend(),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,9 @@ while true; do
         if [ -f benchmarks/bench_step_profile.py ]; then
           timeout -k 10 "$PART_TIMEOUT" python benchmarks/bench_step_profile.py 2>>tools/chip_watch_bench.err
         fi
+        if [ -f benchmarks/bench_generate.py ]; then
+          timeout -k 10 "$PART_TIMEOUT" python benchmarks/bench_generate.py 2>>tools/chip_watch_bench.err
+        fi
         echo "{\"ts\": \"$(ts)\", \"event\": \"battery_done\"}"
       } >> "$RESULTS"
       echo "$(ts) battery done (see $RESULTS)" >> "$LOG"
