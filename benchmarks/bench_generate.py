@@ -5,8 +5,13 @@ surface). The interesting number on the axon tunnel is the gap — every
 host-loop token pays a full round trip, the on-device scan pays one.
 
 One JSON line per row:
-  {"path": "on_device"|"host_loop", "tokens_per_sec": ..., "ms_per_token":
-   ..., "batch": B, "prompt": Lp, "new": N}
+  {"path": "on_device"|"host_loop", "tokens_per_sec": ..., "ms_per_dispatch":
+   ..., "dispatches": ..., "batch": B, "prompt": Lp, "new": N}
+
+tokens_per_sec is END-TO-END (prompt ingestion + N new tokens) so the two
+rows are directly comparable; dispatches makes the mechanism visible —
+the host loop pays Lp+N round trips (sequential one-token prefill +
+generation), the on-device program pays 1.
 
 CPU smoke mode (tiny model) when no TPU; GPT-2 117m bf16 on the chip.
 Timing is host-fetch fenced (block_until_ready does not block on the
@@ -62,10 +67,12 @@ def main():
                                  on_device=on_device)
         dt = (time.perf_counter() - t0) / reps
         assert out.shape == (B, N)
+        dispatches = 1 if on_device else Lp + N
         print(json.dumps({
             "path": path,
             "tokens_per_sec": round(B * N / dt, 1),
-            "ms_per_token": round(dt / N * 1e3, 3),
+            "ms_per_dispatch": round(dt / dispatches * 1e3, 3),
+            "dispatches": dispatches,
             "batch": B, "prompt": Lp, "new": N,
             "backend": jax.default_backend(),
         }), flush=True)
