@@ -420,8 +420,49 @@ class GPTForCausalLM(HybridBlock):
                 out = out[:, :int(np.argmax(allf)) + 1]
         return out
 
+    def _generate_beam(self, prompt, max_new, eos, num_beams, alpha,
+                       max_len, return_scores):
+        """Beam search over the KV cache (the gluonnlp BeamSearchSampler
+        surface): device steps + host top-k bookkeeping + on-device cache
+        reorder gathers — the same shared driver as TransformerNMT."""
+        import jax.numpy as jnp
+
+        from ._decode import beam_search_loop
+
+        B, Lp = prompt.shape
+        # prefill at batch B (beams are identical copies until the first
+        # expansion), then tile the caches: row b*beam+j is beam j of
+        # batch b — exactly the layout reorder's gather indices expect
+        run_b, pk, pv = self._init_generate(B, max_len)
+        logits0 = None
+        for t in range(Lp):
+            logits0, pk, pv = run_b(
+                jnp.asarray(prompt[:, t]), jnp.asarray(t, jnp.int32),
+                pk, pv)
+        run, _, _ = self._init_generate(B * num_beams, max_len)
+        state = {"k": [jnp.repeat(c, num_beams, axis=0) for c in pk],
+                 "v": [jnp.repeat(c, num_beams, axis=0) for c in pv]}
+        logits0 = jnp.repeat(jnp.asarray(logits0), num_beams, axis=0)
+
+        def dev_step(tok, t):
+            logits, state["k"], state["v"] = run(
+                jnp.asarray(tok), jnp.asarray(t, jnp.int32),
+                state["k"], state["v"])
+            return logits
+
+        def reorder(gather):
+            g = jnp.asarray(gather)
+            state["k"] = [jnp.take(c, g, axis=0) for c in state["k"]]
+            state["v"] = [jnp.take(c, g, axis=0) for c in state["v"]]
+
+        out, scores = beam_search_loop(
+            logits0, lambda tok, i: dev_step(tok, Lp + i), reorder,
+            B, num_beams, eos, max_new, alpha=alpha)
+        return (out, scores) if return_scores else out
+
     def generate(self, prompt, max_new_tokens=32, eos=None, temperature=0.0,
-                 top_k=0, seed=0, on_device=True):
+                 top_k=0, seed=0, on_device=True, num_beams=1, alpha=0.6,
+                 return_scores=False):
         """Autoregressive generation from int prompt tokens (B, Lp):
         greedy when temperature == 0, else softmax sampling at the given
         temperature (optionally truncated to the top_k logits) — the
@@ -432,7 +473,11 @@ class GPTForCausalLM(HybridBlock):
         as one jitted program (lax.scan, sampling in-trace) — a single
         dispatch instead of one per token. on_device=False single-steps
         through the same jitted one-token step from the host (useful for
-        debugging; identical greedy results, different sample streams)."""
+        debugging; identical greedy results, different sample streams).
+
+        num_beams > 1 switches to beam search (requires `eos`; Sockeye
+        length norm with `alpha`; `return_scores` adds per-batch scores).
+        """
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32)
@@ -452,6 +497,16 @@ class GPTForCausalLM(HybridBlock):
         while max_len < need:
             max_len *= 2
         max_len = min(max_len, limit)
+        if num_beams > 1:
+            if eos is None:
+                raise ValueError("beam search needs an `eos` id (scoring "
+                                 "terminates beams on it)")
+            if (temperature and temperature > 0.0) or top_k:
+                raise ValueError("num_beams > 1 is deterministic beam "
+                                 "search — temperature/top_k do not apply")
+            return self._generate_beam(prompt, max_new_tokens, eos,
+                                       num_beams, alpha, max_len,
+                                       return_scores)
         if on_device:
             return self._generate_on_device(
                 prompt, max_new_tokens, eos, temperature, top_k, seed,

@@ -302,62 +302,35 @@ class TransformerNMT(HybridBlock):
         length normalization lp(l) = ((5+l)/6)^alpha. Returns (B, <=max_len)
         int32 sequences (best beam per batch), or (seqs, scores)."""
         import jax.numpy as jnp
+
+        from ._decode import beam_search_loop
+
         max_len = max_len or min(self._max_length, 2 * src_tokens.shape[1] + 8)
         B = src_tokens.shape[0]
         run, enc_mask, enc_k, enc_v, self_k, self_v = self._init_decode(
             src_tokens, src_valid, beam, max_len)
+        state = {"k": self_k, "v": self_v}
 
-        seqs = np.full((B, beam, 1), bos, np.int32)
-        # only beam 0 is live at t=0 so the first expansion yields beam
-        # DISTINCT tokens, not beam copies of the argmax
-        cum = np.full((B, beam), -np.inf, np.float32)
-        cum[:, 0] = 0.0
-        finished = np.zeros((B, beam), bool)
-        lengths = np.ones((B, beam), np.int32)
-        cur = jnp.full((B * beam,), bos, jnp.int32)
-        batch_off = np.arange(B)[:, None] * beam
+        def dev_step(tok, t):
+            logits, state["k"], state["v"] = run(
+                jnp.asarray(tok), jnp.asarray(t, jnp.int32),
+                enc_mask, state["k"], state["v"], enc_k, enc_v)
+            return logits
 
-        for t in range(max_len - 1):
-            logits, self_k, self_v = run(cur, jnp.asarray(t, jnp.int32),
-                                         enc_mask, self_k, self_v, enc_k, enc_v)
-            lg = np.asarray(logits, np.float32)
-            V = lg.shape[-1]
-            logp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1,
-                               keepdims=True)) - lg.max(-1, keepdims=True)
-            logp = logp.reshape(B, beam, V)
-            # finished beams may only emit eos, at no additional cost
-            fin_row = np.full((V,), -np.inf, np.float32)
-            fin_row[eos] = 0.0
-            logp = np.where(finished[:, :, None], fin_row[None, None, :], logp)
-            total = cum[:, :, None] + logp                   # (B, beam, V)
-            flat = total.reshape(B, beam * V)
-            top = np.argpartition(-flat, beam - 1, axis=1)[:, :beam]
-            order = np.argsort(-np.take_along_axis(flat, top, 1), axis=1)
-            top = np.take_along_axis(top, order, 1)          # sorted top-k
-            parent = top // V                                # (B, beam)
-            tok = (top % V).astype(np.int32)
-            cum = np.take_along_axis(flat, top, 1)
-            finished = np.take_along_axis(finished, parent, 1)
-            lengths = np.take_along_axis(lengths, parent, 1) + (~finished)
-            seqs = np.take_along_axis(seqs, parent[:, :, None], 1)
-            seqs = np.concatenate([seqs, tok[:, :, None]], axis=2)
-            finished = finished | (tok == eos)
-            # reorder the self caches by beam parent (cross K/V and the
-            # encoder mask are beam-invariant: parents stay within a batch)
-            g = jnp.asarray((batch_off + parent).reshape(-1), jnp.int32)
-            self_k = [jnp.take(c, g, axis=0) for c in self_k]
-            self_v = [jnp.take(c, g, axis=0) for c in self_v]
-            cur = jnp.asarray(tok.reshape(-1), jnp.int32)
-            if finished.all():
-                break
+        def reorder(gather):
+            # cross K/V and the encoder mask are beam-invariant: parents
+            # stay within a batch
+            g = jnp.asarray(gather)
+            state["k"] = [jnp.take(c, g, axis=0) for c in state["k"]]
+            state["v"] = [jnp.take(c, g, axis=0) for c in state["v"]]
 
-        lp = ((5.0 + lengths) / 6.0) ** alpha
-        norm = cum / lp
-        norm = np.where(np.isfinite(norm), norm, -np.inf)
-        best = norm.argmax(axis=1)                           # (B,)
-        out = seqs[np.arange(B), best]
+        logits0 = dev_step(np.full((B * beam,), bos, np.int32), 0)
+        out, scores = beam_search_loop(
+            logits0, lambda tok, i: dev_step(tok, i + 1), reorder,
+            B, beam, eos, max_len - 1, alpha=alpha,
+            seqs0=np.full((B, beam, 1), bos, np.int32))
         if return_scores:
-            return out, norm[np.arange(B), best]
+            return out, scores
         return out
 
 

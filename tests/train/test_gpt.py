@@ -125,6 +125,15 @@ def test_gpt_cyclic_sequence_gate():
     expect = np.stack([[(10 + i + p) % period + 1 for i in range(8)]
                        for p in range(2)])
     np.testing.assert_array_equal(gen, expect)
+    # beam search on a near-deterministic model must agree with greedy
+    # (and requires eos)
+    bs, scores = m.generate(prompt, max_new_tokens=8, num_beams=4, eos=0,
+                            return_scores=True)
+    n = min(bs.shape[1], 8)
+    np.testing.assert_array_equal(bs[:, :n], expect[:, :n])
+    assert np.isfinite(scores).all()
+    with pytest.raises(ValueError):
+        m.generate(prompt, max_new_tokens=4, num_beams=4)  # no eos
 
 
 def test_gpt_generate_matches_full_forward():
