@@ -61,6 +61,10 @@ _LAZY = {
     "rtc": ".rtc",
     "visualization": ".visualization",
     "viz": ".visualization",
+    "engine": ".engine",
+    "executor": ".symbol.executor",
+    "registry": ".registry",
+    "util": ".util",
 }
 
 

@@ -1,0 +1,35 @@
+"""Engine control facade (reference: python/mxnet/engine.py — bulk
+execution sizing on the threaded dependency engine).
+
+The XLA runtime replaces the reference's dependency engine outright
+(SURVEY §7.1): ops dispatch asynchronously and fuse under jit, so bulking
+adjacent ops into one engine push — the reference's mechanism for cutting
+per-op scheduling overhead — has no analog cost to cut. The API surface
+is kept so ported scripts run unchanged; the sizes are recorded and
+returned but change nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Record the requested bulk size; returns the previous value.
+    No-op on TPU (see module docstring)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped `set_bulk_size` (reference: engine.bulk context manager)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

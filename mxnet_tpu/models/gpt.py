@@ -294,6 +294,50 @@ class GPTForCausalLM(HybridBlock):
             self._gen_cache[key] = run
         return self._gen_cache[key], self_k, self_v
 
+    def _prefill_body(self, prompt_d, lp_d, flat):
+        """Raw-jax batched prefill: embed + per-layer flash pass writing
+        K/V[0:Lp]; returns (f32 logits at the last REAL prompt position
+        (B, V), ks, vs). Shared by the on-device generation program and
+        the beam-search prefill program."""
+        import jax
+        import jax.numpy as jnp
+
+        g = self.gpt
+        Lp_b = prompt_d.shape[1]
+        n_l = len(g.layers)
+        x = g.word_embed(NDArray(prompt_d))
+        x = x + NDArray(
+            g.position_embed.data()._data[:Lp_b]).expand_dims(axis=0)
+        ks, vs = list(flat[:n_l]), list(flat[n_l:])
+        for i, layer in enumerate(g.layers):
+            x, k, v = layer.prefill(x, NDArray(ks[i]), NDArray(vs[i]))
+            ks[i], vs[i] = k._data, v._data
+        h = g.ln_f(x)._data
+        h_last = jax.lax.dynamic_index_in_dim(
+            h, (lp_d - 1).astype(jnp.int32), axis=1, keepdims=False)
+        w = g.word_embed.weight.data()._data
+        logits = jnp.matmul(h_last, w.T.astype(h_last.dtype)) \
+            .astype(jnp.float32)
+        return logits, ks, vs
+
+    def _init_prefill(self, B, Lp_b, max_len):
+        """Jitted prefill-only program: ONE dispatch fills the caches and
+        returns the first-expansion logits (beam search's prefill)."""
+        n_l = len(self.gpt.layers)
+        key = ("prefill", B, Lp_b, max_len)
+        if not hasattr(self, "_gen_cache"):
+            self._gen_cache = {}
+        if key not in self._gen_cache:
+            from ._decode import jit_flat_step
+
+            def pre(prompt_nd, lp_nd, flat):
+                logits, ks, vs = self._prefill_body(
+                    prompt_nd._data, lp_nd._data, [f._data for f in flat])
+                return logits, ks + vs
+
+            self._gen_cache[key] = jit_flat_step(self, pre, 2 * n_l)
+        return self._gen_cache[key]
+
     def _alloc_caches(self, B, max_len):
         """Zeroed per-layer K+V caches (the single source of cache
         geometry for both generation paths)."""
@@ -352,22 +396,7 @@ class GPTForCausalLM(HybridBlock):
                 def wrap(d):
                     return NDArray(d)
 
-                g = model.gpt
-                # batched prefill: embed + per-layer flash pass that also
-                # writes K/V[0:Lp_b]
-                x = g.word_embed(wrap(prompt_d))
-                x = x + NDArray(
-                    g.position_embed.data()._data[:Lp_b]).expand_dims(axis=0)
-                ks, vs = list(flat[:n_l]), list(flat[n_l:])
-                for i, layer in enumerate(g.layers):
-                    x, k, v = layer.prefill(x, wrap(ks[i]), wrap(vs[i]))
-                    ks[i], vs[i] = k._data, v._data
-                h = g.ln_f(x)._data
-                h_last = jax.lax.dynamic_index_in_dim(
-                    h, (lp_d - 1).astype(jnp.int32), axis=1, keepdims=False)
-                w = g.word_embed.weight.data()._data
-                logits = jnp.matmul(h_last, w.T.astype(h_last.dtype)) \
-                    .astype(jnp.float32)
+                logits, ks, vs = model._prefill_body(prompt_d, lp_d, flat)
 
                 rngk = jax.random.fold_in(
                     jax.random.key(0), seed_d.astype(jnp.int32))
@@ -430,15 +459,23 @@ class GPTForCausalLM(HybridBlock):
         from ._decode import beam_search_loop
 
         B, Lp = prompt.shape
-        # prefill at batch B (beams are identical copies until the first
-        # expansion), then tile the caches: row b*beam+j is beam j of
-        # batch b — exactly the layout reorder's gather indices expect
-        run_b, pk, pv = self._init_generate(B, max_len)
-        logits0 = None
-        for t in range(Lp):
-            logits0, pk, pv = run_b(
-                jnp.asarray(prompt[:, t]), jnp.asarray(t, jnp.int32),
-                pk, pv)
+        # ONE batched-prefill dispatch at batch B (beams are identical
+        # copies until the first expansion), then tile the caches: row
+        # b*beam+j is beam j of batch b — exactly the layout reorder's
+        # gather indices expect. Prompt right-pads to a bucket (pad-slot
+        # pollution is harmless — see _generate_on_device).
+        Lp_b = 16
+        while Lp_b < Lp:
+            Lp_b *= 2
+        Lp_b = min(Lp_b, max_len - 1)
+        prompt_pad = np.concatenate(
+            [prompt, np.zeros((B, Lp_b - Lp), np.int32)], axis=1)
+        pre = self._init_prefill(B, Lp_b, max_len)
+        n_l = len(self.gpt.layers)
+        logits0, caches = pre(jnp.asarray(prompt_pad),
+                              jnp.asarray(Lp, jnp.int32),
+                              self._alloc_caches(B, max_len))
+        pk, pv = caches[:n_l], caches[n_l:]
         run, _, _ = self._init_generate(B * num_beams, max_len)
         state = {"k": [jnp.repeat(c, num_beams, axis=0) for c in pk],
                  "v": [jnp.repeat(c, num_beams, axis=0) for c in pv]}

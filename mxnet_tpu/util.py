@@ -1,0 +1,42 @@
+"""Misc utilities (reference: python/mxnet/util.py subset that makes
+sense off-GPU; numpy-mode toggles are the 2.x line and out of scope for
+this 1.x-surface build — `is_np_array` reports False so shared scripts
+can branch)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "is_np_array", "use_np", "getenv", "setenv"]
+
+
+def makedirs(d):
+    """mkdir -p (the reference kept this for py2 compat; harmless)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def is_np_array():
+    """Numpy-semantics mode is the MXNet 2.x line — always False here."""
+    return False
+
+
+def use_np(func):
+    """2.x numpy-mode decorator: accepted and returned unchanged (ops
+    here already follow numpy-style broadcasting)."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+    return wrapper
+
+
+def getenv(name):
+    """Reference MXGetEnv facade."""
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    """Reference MXSetEnv facade."""
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = str(value)

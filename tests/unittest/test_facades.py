@@ -1,0 +1,71 @@
+"""Top-level module-name parity with the reference python package:
+`mx.engine` / `mx.executor` / `mx.registry` / `mx.util` exist and behave
+(engine bulking is an honest no-op on XLA — SURVEY §7.1)."""
+import mxnet_tpu as mx
+
+
+def test_engine_bulk_facade():
+    prev = mx.engine.set_bulk_size(16)
+    assert mx.engine.set_bulk_size(prev) == 16
+    with mx.engine.bulk(32):
+        pass
+
+
+def test_util():
+    assert mx.util.is_np_array() is False
+
+    @mx.util.use_np
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    mx.util.setenv("MXT_FACADE_TEST", "1")
+    assert mx.util.getenv("MXT_FACADE_TEST") == "1"
+    mx.util.setenv("MXT_FACADE_TEST", None)
+    assert mx.util.getenv("MXT_FACADE_TEST") is None
+
+
+def test_registry_factories():
+    class Base:
+        pass
+
+    class Foo(Base):
+        def __init__(self, n=1):
+            self.n = n
+
+    register = mx.registry.get_register_func(Base, "facadething")
+    create = mx.registry.get_create_func(Base, "facadething")
+    register(Foo)
+    assert isinstance(create("foo"), Foo)
+    inst = Foo()
+    assert create(inst) is inst
+    assert create('{"foo": {"n": 3}}').n == 3
+    import pytest
+    with pytest.raises(TypeError):
+        register(int)          # not a subclass
+    with pytest.raises(ValueError):
+        create('{"foo": 0.1}')  # JSON value must be a kwargs dict
+
+
+def test_registry_bridges_to_module_registries():
+    """get_create_func over an in-tree base class must find the module's
+    own _registry (the reference shares one store), and two unrelated
+    same-named base classes must NOT share a namespace."""
+    create_opt = mx.registry.get_create_func(mx.optimizer.Optimizer)
+    assert isinstance(create_opt("sgd", learning_rate=0.1),
+                      mx.optimizer.SGD)
+
+    class Loss:                                 # same NAME, two objects
+        pass
+
+    class OtherScope:
+        class Loss:
+            pass
+
+    r1 = mx.registry.get_registry(Loss)
+    r2 = mx.registry.get_registry(OtherScope.Loss)
+    assert r1 is not r2
+
+
+def test_executor_module_alias():
+    assert mx.executor.__name__.endswith("symbol.executor")
