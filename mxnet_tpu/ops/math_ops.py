@@ -146,6 +146,13 @@ register("min")(_reduce(jnp.min))
 alias("sum_axis", "sum")
 
 
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    """Reference mx.nd.cumsum: axis=None sums over the flattened array."""
+    return jnp.cumsum(a, axis=axis,
+                      dtype=np.dtype(dtype) if dtype else None)
+
+
 @register("norm")
 def norm(data, ord=2, axis=None, keepdims=False):
     axis = _norm_axis(axis)

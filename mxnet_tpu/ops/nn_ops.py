@@ -275,6 +275,46 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
+alias("embedding", "Embedding")
+
+
+@register("im2col")
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """Patch extraction (reference: src/operator/nn/im2col): NCHW input ->
+    (N, C*prod(kernel), L) columns, L = prod(output spatial), rows ordered
+    channel-major then row-major kernel position (the GEMM-convolution
+    layout)."""
+    import jax
+
+    kernel = tuple(kernel)
+    nsp = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nsp
+    dilate = tuple(dilate) if dilate else (1,) * nsp
+    pad = tuple(pad) if pad else (0,) * nsp
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    N = data.shape[0]
+    return patches.reshape(N, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Scatter-add columns back to an image — exactly the vjp of im2col
+    (overlapping patch positions sum, reference col2im semantics)."""
+    kernel = tuple(kernel)
+    output_size = tuple(output_size)
+    C = data.shape[1] // int(np.prod(kernel))
+    N = data.shape[0]
+
+    def f(img):
+        return im2col(img, kernel, stride=stride, dilate=dilate, pad=pad)
+
+    zeros = jnp.zeros((N, C) + output_size, data.dtype)
+    _, vjp = jax.vjp(f, zeros)
+    return vjp(data)[0]
+
+
 @register("Dropout")
 def dropout(data, p=0.5, mode="training", axes=(), _training=None):
     from .. import _engine

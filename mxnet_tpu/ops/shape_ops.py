@@ -123,6 +123,19 @@ def split(data, num_outputs, axis=1, squeeze_axis=False):
 alias("SliceChannel", "split")
 
 
+@register("split_v2")
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """2.x-style split (reference: mx.nd.split_v2): int = equal sections,
+    tuple = split indices (uneven parts allowed)."""
+    spec = indices_or_sections
+    if not isinstance(spec, int):
+        spec = list(spec)
+    parts = jnp.split(data, spec, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
 @register("slice")
 def slice_op(data, begin, end, step=None):
     slices = []

@@ -605,3 +605,61 @@ def test_r5_op_additions():
     p = nd.Pad(nd.array(np.ones((1, 1, 2, 2), np.float32)),
                mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
     assert p.shape == (1, 1, 4, 4)
+
+
+# -- r5 op-parity fills: split_v2 / cumsum / embedding / im2col / col2im --
+
+def test_split_v2_sections_and_indices():
+    import numpy as np
+    from mxnet_tpu import nd
+    x = nd.array(np.arange(10, dtype=np.float32))
+    a, b, c = nd.split_v2(x, (3, 7))
+    assert a.shape == (3,) and b.shape == (4,) and c.shape == (3,)
+    p = nd.split_v2(nd.array(np.arange(8).reshape(2, 4).astype(np.float32)),
+                    2, axis=1, squeeze_axis=False)
+    assert p[0].shape == (2, 2)
+
+
+def test_cumsum_flat_and_axis():
+    import numpy as np
+    from mxnet_tpu import nd
+    x = nd.array(np.asarray([[1, 2], [3, 4]], np.float32))
+    np.testing.assert_array_equal(nd.cumsum(x).asnumpy(), [1, 3, 6, 10])
+    np.testing.assert_array_equal(nd.cumsum(x, axis=1).asnumpy(),
+                                  [[1, 3], [3, 7]])
+
+
+def test_embedding_lowercase_alias():
+    import numpy as np
+    from mxnet_tpu import nd
+    w = nd.array(np.eye(4, 3).astype(np.float32))
+    e = nd.embedding(nd.array(np.asarray([1, 2], np.int32)), w)
+    np.testing.assert_array_equal(e.asnumpy(), w.asnumpy()[[1, 2]])
+
+
+def test_im2col_col2im():
+    """im2col rows are channel-major, kernel row-major (GEMM layout);
+    col2im scatter-adds overlaps (vjp of im2col)."""
+    import numpy as np
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(0)
+    img = rng.randn(1, 2, 4, 4).astype(np.float32)
+    cols = nd.im2col(nd.array(img), kernel=(2, 2), stride=(1, 1)).asnumpy()
+    assert cols.shape == (1, 8, 9)
+    naive = np.zeros((1, 8, 9), np.float32)
+    i = 0
+    for oy in range(3):
+        for ox in range(3):
+            naive[0, :, i] = img[0, :, oy:oy + 2, ox:ox + 2].reshape(-1)
+            i += 1
+    np.testing.assert_allclose(cols, naive, rtol=1e-6)
+
+    back = nd.col2im(nd.array(np.ones((1, 8, 9), np.float32)),
+                     output_size=(4, 4), kernel=(2, 2),
+                     stride=(1, 1)).asnumpy()
+    expect = np.zeros((4, 4), np.float32)
+    for oy in range(3):
+        for ox in range(3):
+            expect[oy:oy + 2, ox:ox + 2] += 1
+    np.testing.assert_allclose(back[0, 0], expect)
+    np.testing.assert_allclose(back[0, 1], expect)
