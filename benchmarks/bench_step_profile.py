@@ -176,6 +176,18 @@ def main():
     t_lamb = timeit(lambda *a: step(*a)[0], largs, reps)
     row("lamb_apply", t_lamb * 1e3)
 
+    # same pass with bf16 moment storage (config lamb_moments_dtype):
+    # the bandwidth-bound apply should drop ~30% with the state bytes
+    fl16 = FusedLamb(shapes, [jnp.float32] * len(shapes),
+                     [0.01] * len(shapes), 0.9, 0.999, 1e-6, True, 1.0,
+                     -1.0, -1.0, -1.0, moments_dtype=jnp.bfloat16)
+    step16 = jax.jit(fl16.apply_flat)
+    largs16 = (jnp.zeros(N), jnp.ones(N) * 1e-3,
+               jnp.zeros(N, jnp.bfloat16), jnp.zeros(N, jnp.bfloat16),
+               jnp.asarray(1.0), jnp.asarray(1e-3))
+    t_lamb16 = timeit(lambda *a: step16(*a)[0], largs16, reps)
+    row("lamb_apply_bf16_moments", t_lamb16 * 1e3)
+
     # ---- the real full step ----
     model = bert_mod.BERTForPretraining(cfg)
     mx.random.seed(0)

@@ -114,6 +114,13 @@ register_option(
     "Use the fused multi-tensor LAMB path (flat f32 master weights) when "
     "params are replicated.")
 register_option(
+    "lamb_moments_dtype", "float32", choices=("float32", "bfloat16"),
+    doc="Storage dtype for fused-LAMB moment buffers. 'bfloat16' cuts "
+        "optimizer HBM traffic ~30% at BERT scale (the apply pass is "
+        "bandwidth-bound); math stays f32, storage rounds through bf16. "
+        "Second-moment rounding slightly coarsens adaptive scaling — "
+        "validated on the convergence gates, off by default.")
+register_option(
     "prng", "auto", choices=("auto", "rbg", "threefry2x32"),
     doc="PRNG implementation: 'rbg' (TPU hardware generator, fast), "
         "'threefry2x32' (counter-exact), or 'auto' (rbg on TPU).")

@@ -36,9 +36,10 @@ class FusedLamb:
 
     def __init__(self, shapes, dtypes, wds, beta1, beta2, epsilon,
                  bias_correction, rescale_grad, clip_gradient,
-                 lower_bound, upper_bound):
+                 lower_bound, upper_bound, moments_dtype=jnp.float32):
         self.shapes = [tuple(s) for s in shapes]
         self.dtypes = list(dtypes)
+        self.moments_dtype = jnp.dtype(moments_dtype)
         self.b1, self.b2, self.eps = beta1, beta2, epsilon
         self.bias_correction = bias_correction
         self.rescale = rescale_grad
@@ -105,8 +106,19 @@ class FusedLamb:
         G = g.reshape(R, C) * self.rescale
         if self.clip and self.clip > 0:
             G = jnp.clip(G, -self.clip, self.clip)
-        new_m = self.b1 * m.reshape(R, C) + (1 - self.b1) * G
-        new_v = self.b2 * v.reshape(R, C) + (1 - self.b2) * jnp.square(G)
+        mdt = self.moments_dtype
+        new_m = self.b1 * m.reshape(R, C).astype(jnp.float32) \
+            + (1 - self.b1) * G
+        new_v = self.b2 * v.reshape(R, C).astype(jnp.float32) \
+            + (1 - self.b2) * jnp.square(G)
+        if mdt != jnp.float32:
+            # reduced-precision moment storage (config `lamb_moments_dtype`):
+            # ~30% less optimizer HBM traffic at BERT scale.  Round-trip
+            # through the storage dtype BEFORE the trust-ratio norms so the
+            # norm, the applied update, and the carried state all see the
+            # SAME values — trust stays consistent with what is stored.
+            new_m = new_m.astype(mdt).astype(jnp.float32)
+            new_v = new_v.astype(mdt).astype(jnp.float32)
         wd_rows = jnp.take(self._wd_seg, self._row_seg)[:, None]  # (R, 1)
 
         def make_update(mm, vv, ww):
@@ -147,6 +159,9 @@ class FusedLamb:
         # reusing pass 1's value — the barrier defeats CSE (which would
         # merge the two expressions back into one materialized temporary);
         # the recompute is pure FLOPs, traded for a full HBM round-trip
+        new_m = new_m.astype(mdt)
+        new_v = new_v.astype(mdt)
         Wb, mb, vb = jax.lax.optimization_barrier((W, new_m, new_v))
-        new_w = Wb - lr * trust_rows * make_update(mb, vb, Wb)
+        new_w = Wb - lr * trust_rows * make_update(
+            mb.astype(jnp.float32), vb.astype(jnp.float32), Wb)
         return (new_w.reshape(-1), new_m.reshape(-1), new_v.reshape(-1))

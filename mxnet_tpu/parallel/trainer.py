@@ -103,12 +103,14 @@ class ShardedTrainer:
                 [self.fopt._wd_for(i) for i in range(len(datas))],
                 o.beta1, o.beta2, o.epsilon, o.bias_correction,
                 o.rescale_grad, o.clip_gradient or -1.0,
-                o.lower_bound or -1.0, o.upper_bound or -1.0)
+                o.lower_bound or -1.0, o.upper_bound or -1.0,
+                moments_dtype=config.get("lamb_moments_dtype"))
             master = self._fl.flatten(datas)
             self.params = jax.device_put(master, rep)
+            mdt = self._fl.moments_dtype
             self.opt_state = (
-                jax.device_put(jnp.zeros_like(master), rep),
-                jax.device_put(jnp.zeros_like(master), rep))
+                jax.device_put(jnp.zeros(master.shape, mdt), rep),
+                jax.device_put(jnp.zeros(master.shape, mdt), rep))
         else:
             self.params = [jax.device_put(p.data()._data, s)
                            for (_, p), s in zip(self._grad_params, self._pshard)]
@@ -264,11 +266,12 @@ class ShardedTrainer:
         if self._fused:
             self.params = jax.device_put(
                 self._fl.flatten(state["params"]), self._rep)
+            mdt = self._fl.moments_dtype
             self.opt_state = (
                 jax.device_put(self._fl.flatten(
-                    [st[0] for st in state["opt_state"]]), self._rep),
+                    [st[0] for st in state["opt_state"]], mdt), self._rep),
                 jax.device_put(self._fl.flatten(
-                    [st[1] for st in state["opt_state"]]), self._rep))
+                    [st[1] for st in state["opt_state"]], mdt), self._rep))
         else:
             self.params = list(state["params"])
             self.opt_state = [tuple(st) for st in state["opt_state"]]

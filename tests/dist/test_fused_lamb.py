@@ -106,3 +106,21 @@ def test_apply_flat_no_fullsize_temp():
     assert ma.temp_size_in_bytes < N, (
         f"apply_flat materializes a full-size temp: "
         f"{ma.temp_size_in_bytes} bytes for N={N} elements")
+
+
+def test_bf16_moments_tracks_f32(monkeypatch):
+    """`lamb_moments_dtype=bfloat16` (config): moment storage rounds
+    through bf16 but math stays f32 — the loss trajectory must track the
+    f32-moment run closely, the carried state must actually BE bf16 (the
+    traffic win is the point), and training must still descend."""
+    l_ref, _, _ = _run(monkeypatch, fused=True, steps=30)
+    monkeypatch.setenv("MXNET_TPU_LAMB_MOMENTS_DTYPE", "bfloat16")
+    l_bf, _, tr = _run(monkeypatch, fused=True, steps=30)
+    import jax.numpy as jnp
+    assert tr.opt_state[0].dtype == jnp.bfloat16
+    assert tr.opt_state[1].dtype == jnp.bfloat16
+    # early steps nearly exact; divergence accumulates slowly
+    np.testing.assert_allclose(l_bf[:5], l_ref[:5], rtol=5e-3)
+    assert abs(l_bf[-1] - l_ref[-1]) < 0.1 * abs(l_ref[0] - l_ref[-1]), (
+        f"bf16-moment trajectory diverged: {l_bf[-1]} vs {l_ref[-1]}")
+    assert l_bf[-1] < 0.5 * l_bf[0], "bf16-moment run failed to descend"
