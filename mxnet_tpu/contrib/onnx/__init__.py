@@ -14,11 +14,15 @@ Shape/Gather/Concat/Cast/arith chains (the PyTorch-exporter flatten
 idiom) at the graph's static input shapes; nearest-Resize maps to/from
 UpSampling. Multi-output (Group'd) graphs export/import. RNN covers
 unidirectional AND bidirectional LSTM/GRU, and vanilla RNN
-(rnn_tanh/rnn_relu <-> ONNX RNN with homogeneous Tanh/Relu activations).
-Still NOT covered: control flow (Loop/If), GRU with
-linear_before_reset=0, per-direction heterogeneous RNN activations,
-sequence_lens on RNN nodes, genuinely dynamic shapes (a Shape chain that
-static inference cannot resolve raises).
+(rnn_tanh/rnn_relu <-> ONNX RNN with homogeneous Tanh/Relu activations);
+GRU imports/exports BOTH linear_before_reset forms (the op implements
+the ONNX-default 0 semantics natively), and `sequence_lens` round-trips
+— as an int32 initializer or a live int32 graph input — onto the op's
+use_sequence_length varlen mode (Y zeroed past each length, Y_h/Y_c
+frozen at it, reverse direction anchored at each sequence's own end).
+Still NOT covered: control flow (Loop/If), per-direction heterogeneous
+RNN activations, genuinely dynamic shapes (a Shape chain that static
+inference cannot resolve raises).
 Serialization is the in-tree wire codec (`_proto.py`) — the
 environment bakes no `onnx` package, but files written here follow the
 public ONNX IR (opset 13) byte for byte.
@@ -108,7 +112,8 @@ def _rnn_pack_np(layers, ngates, state_size):
     return np.concatenate(parts).astype(np.float32)
 
 
-def _export_node(node, in_names, out_names, consts, param_values=None):
+def _export_node(node, in_names, out_names, consts, param_values=None,
+                 int32_inputs=None):
     """One Symbol _Node -> list of NodeProto bytes.
 
     out_names: one ONNX value name per node output (Split emits several).
@@ -386,12 +391,14 @@ def _export_node(node, in_names, out_names, consts, param_values=None):
                          "coordinate_transformation_mode": "asymmetric",
                          "nearest_mode": "floor"})
     if op == "RNN":
-        return _export_rnn(node, in_names, out_names, consts, param_values)
+        return _export_rnn(node, in_names, out_names, consts,
+                           param_values, int32_inputs)
     raise NotImplementedError(f"ONNX export: op '{op}' not in the "
                               "supported subset")
 
 
-def _export_rnn(node, in_names, out_names, consts, param_values):
+def _export_rnn(node, in_names, out_names, consts, param_values,
+                int32_inputs=None):
     """RNN (lstm/gru/rnn_tanh/rnn_relu, uni- or bidirectional) -> one
     ONNX LSTM/GRU/RNN node per layer.
 
@@ -436,8 +443,25 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
         v = np.asarray(v)
         return None if not v.any() else v
 
+    usl = bool(_attr(a, "use_sequence_length", False))
+    lbr = bool(_attr(a, "linear_before_reset", True))
     h0 = state_value(2)
     c0 = state_value(3) if mode == "lstm" else None
+    sl_name = ""
+    if usl:
+        # symbol-node input layout: lengths sit after state_cell for LSTM,
+        # after state otherwise (mirroring the op's positional binding)
+        slot = 4 if mode == "lstm" else 3
+        cand = in_names[slot]
+        if cand in param_values:
+            lens = np.asarray(param_values[cand]).astype(np.int32)
+            consts.append((f"{nm}_seqlens", lens))
+            sl_name = f"{nm}_seqlens"
+        else:
+            # a live graph input: ONNX types sequence_lens int32
+            sl_name = cand
+            if int32_inputs is not None:
+                int32_inputs.add(cand)
 
     def const(tag, arr):
         name = f"{nm}_{tag}"
@@ -457,12 +481,14 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
                              _gate_reorder(e["bh"], order, H)])
              for e in ents]))
         ins = [x, W, R, B]
+        if sl_name and (h0 is None and c0 is None):
+            ins.append(sl_name)
         if h0 is not None or c0 is not None:
             # state arrays are (L*dirs, N, H); ONNX wants (dirs, N, H).
             # When only one of h0/c0 is nonzero the other is explicit zeros.
             N = (h0 if h0 is not None else c0).shape[1]
             zeros = np.zeros((dirs, N, H), np.float32)
-            ins.append("")                      # sequence_lens: absent
+            ins.append(sl_name)                 # sequence_lens ("" = absent)
             ins.append(const(f"h0_{l}",
                              h0[l * dirs:(l + 1) * dirs]
                              if h0 is not None else zeros))
@@ -475,7 +501,9 @@ def _export_rnn(node, in_names, out_names, consts, param_values):
         if bidir:
             attrs["direction"] = "bidirectional"
         if mode == "gru":
-            attrs["linear_before_reset"] = 1    # our GRU cell's semantics
+            # cuDNN semantics (the default) = linear_before_reset=1; the
+            # op also implements the ONNX-default 0 form
+            attrs["linear_before_reset"] = 1 if lbr else 0
         if onnx_op == "RNN":
             # vanilla RNN: explicit per-direction activation (ONNX default
             # is Tanh; Relu must be stated)
@@ -540,6 +568,7 @@ def export_model(sym, params, input_shapes, onnx_file,
     param_np = {k: np_of(v) for k, v in params.items()}
     nodes_b, init_arrays, seen_init = [], {}, set()
     consts = []                        # (name, np array) from decompositions
+    int32_inputs = set()               # graph inputs typed int32 (seq lens)
     name_of = {}                       # (_Node, out_idx) -> onnx value name
     referenced = set()                 # value names consumed by some node
     for node in topo:
@@ -563,7 +592,8 @@ def export_model(sym, params, input_shapes, onnx_file,
         outs = [f"{node.name}_output" if i == 0 else
                 f"{node.name}_output{i}" for i in range(n_out[id(node)])]
         for nb in _export_node(node, in_names, outs, consts,
-                               param_values=param_np):
+                               param_values=param_np,
+                               int32_inputs=int32_inputs):
             nodes_b.append(nb)
             referenced.update(P.node_input_names(nb))
         for i, o in enumerate(outs):
@@ -585,7 +615,9 @@ def export_model(sym, params, input_shapes, onnx_file,
                     if k in referenced or k in out_value_names]
 
     dt = P.NP2ONNX[str(np.dtype(input_dtype))]
-    inputs_vi = [P.value_info(n, dt, s) for n, s in input_shapes.items()]
+    i32 = P.NP2ONNX["int32"]
+    inputs_vi = [P.value_info(n, i32 if n in int32_inputs else dt, s)
+                 for n, s in input_shapes.items()]
     # output shapes via symbol shape inference
     try:
         _, out_shapes, _ = sym.infer_shape(**input_shapes)
@@ -861,15 +893,8 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
     elif acts:
         raise NotImplementedError(
             f"ONNX import: {op} with custom activations unsupported")
-    if op == "GRU" and not a.get("linear_before_reset", 0):
-        raise NotImplementedError(
-            "ONNX import: GRU with linear_before_reset=0 differs from this "
-            "runtime's cell (cuDNN semantics) — re-export with "
-            "linear_before_reset=1")
-    if len(n["inputs"]) > 4 and n["inputs"][4]:
-        raise NotImplementedError(
-            f"ONNX import: {op} with sequence_lens unsupported — running "
-            "padded sequences to full length would silently change Y/Y_h")
+    lbr = bool(a.get("linear_before_reset", 0)) if op == "GRU" else True
+    seq_lens_name = n["inputs"][4] if len(n["inputs"]) > 4 else ""
     H = int(a["hidden_size"])
     if op != "RNN":
         mode = "lstm" if op == "LSTM" else "gru"
@@ -924,6 +949,22 @@ def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
     h0 = state_sym(5, "state")
     kw = {"state_size": H, "num_layers": 1, "mode": mode,
           "state_outputs": True, "bidirectional": bidir}
+    if mode == "gru":
+        kw["linear_before_reset"] = lbr
+    if seq_lens_name:
+        # constant lengths fold to an int32 param; live lengths stay a
+        # graph input — either way the op's varlen mode zeroes Y past
+        # each length and freezes Y_h/Y_c, matching ONNX
+        v = const_in(4)
+        if v is not None:
+            ctx["folded_inits"].add(seq_lens_name)
+            lname = f"{name or 'rnn'}_seqlens"
+            ctx["extra_params"][lname] = np.asarray(v, np.int32)
+            sl = sym_mod.var(lname, shape=np.asarray(v).shape)
+        else:
+            sl = ins[4]
+        kw["use_sequence_length"] = True
+        kw["sequence_length"] = sl
     if mode == "lstm":
         c0 = state_sym(6, "state_cell")
         out = sym_mod.RNN(ins[0], p_sym, h0, c0, **kw)

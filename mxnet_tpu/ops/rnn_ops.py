@@ -68,14 +68,28 @@ def _lstm_cell(x, h, c, wi, wh, bi, bh):
     return h_new, c_new
 
 
-def _gru_cell(x, h, wi, wh, bi, bh):
+def _gru_cell(x, h, wi, wh, bi, bh, lbr=True):
     zi = x @ wi.T + bi
-    zh = h @ wh.T + bh
     ri, ui, ni = jnp.split(zi, 3, axis=-1)
-    rh, uh, nh = jnp.split(zh, 3, axis=-1)
-    r = jax.nn.sigmoid(ri + rh)
-    u = jax.nn.sigmoid(ui + uh)
-    n = jnp.tanh(ni + r * nh)
+    H = h.shape[-1]
+    if lbr:
+        # linear_before_reset=1 (cuDNN / this runtime's default):
+        # n = tanh(Wn x + bWn + r * (Rn h + bRn))
+        zh = h @ wh.T + bh
+        rh, uh, nh = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        u = jax.nn.sigmoid(ui + uh)
+        n = jnp.tanh(ni + r * nh)
+    else:
+        # ONNX default (linear_before_reset=0): the reset gate applies to
+        # the STATE before the recurrent matmul — n needs its own matmul
+        # on r*h, so only the r/u rows of the fused recurrent dot are
+        # computed here
+        zh = h @ wh[:2 * H].T + bh[:2 * H]
+        rh, uh = jnp.split(zh, 2, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        u = jax.nn.sigmoid(ui + uh)
+        n = jnp.tanh(ni + (r * h) @ wh[2 * H:].T + bh[2 * H:])
     return (1 - u) * n + u * h
 
 
@@ -83,46 +97,112 @@ def _vanilla_cell(x, h, wi, wh, bi, bh, act):
     return act(x @ wi.T + h @ wh.T + bi + bh)
 
 
-def _run_layer(x, layer, mode, h0, c0, reverse=False):
-    """x: (T, N, I) → (T, N, state_size)."""
+def _reverse_padded(x, lengths):
+    """Per-sequence time reversal of a padded (T, N, ...) batch: row t of
+    sequence n becomes row lengths[n]-1-t; rows at/after lengths[n] are
+    zeros. Self-inverse on the valid region, so the same gather both
+    builds the reversed input and un-reverses the scanned outputs."""
+    T = x.shape[0]
+    t = jnp.arange(T)[:, None]                                  # (T, 1)
+    idx = jnp.clip(lengths[None, :] - 1 - t, 0, T - 1)          # (T, N)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    rev = jnp.take_along_axis(x, idx, axis=0)
+    mask = (t < lengths[None, :]).reshape(
+        (T,) + (lengths.shape[0],) + (1,) * (x.ndim - 2))
+    return jnp.where(mask, rev, jnp.zeros((), x.dtype))
+
+
+def _run_layer(x, layer, mode, h0, c0, reverse=False, lengths=None,
+               lbr=True):
+    """x: (T, N, I) → (T, N, state_size).
+
+    With `lengths` (N,) the layer handles variable-length sequences the
+    way cuDNN's packed/varlen mode does: the carried state FREEZES at
+    each sequence's end (so the final h/c is the last valid step's),
+    outputs past the end are zeros, and the reverse direction of a
+    bidirectional layer starts from each sequence's own last valid step
+    — not from the padding."""
     wi, wh, bi, bh = layer["wi"], layer["wh"], layer["bi"], layer["bh"]
     if reverse:
-        x = jnp.flip(x, axis=0)
+        x = jnp.flip(x, axis=0) if lengths is None \
+            else _reverse_padded(x, lengths)
 
     if mode == "lstm":
-        def step(carry, xt):
+        def cell(carry, xt):
             h, c = carry
-            h, c = _lstm_cell(xt, h, c, wi, wh, bi, bh)
-            return (h, c), h
-        (hT, cT), ys = lax.scan(step, (h0, c0), x)
-        extra = (hT, cT)
+            h2, c2 = _lstm_cell(xt, h, c, wi, wh, bi, bh)
+            return (h2, c2), h2
+        init = (h0, c0)
     elif mode == "gru":
-        def step(h, xt):
-            h = _gru_cell(xt, h, wi, wh, bi, bh)
-            return h, h
-        hT, ys = lax.scan(step, h0, x)
-        extra = (hT, None)
+        def cell(h, xt):
+            h2 = _gru_cell(xt, h, wi, wh, bi, bh, lbr=lbr)
+            return h2, h2
+        init = h0
     else:
         act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
-        def step(h, xt):
-            h = _vanilla_cell(xt, h, wi, wh, bi, bh, act)
-            return h, h
-        hT, ys = lax.scan(step, h0, x)
-        extra = (hT, None)
+
+        def cell(h, xt):
+            h2 = _vanilla_cell(xt, h, wi, wh, bi, bh, act)
+            return h2, h2
+        init = h0
+
+    if lengths is None:
+        carryT, ys = lax.scan(cell, init, x)
+    else:
+        T = x.shape[0]
+
+        def step(carry, inp):
+            t, xt = inp
+            new_carry, y = cell(carry, xt)
+            valid = (t < lengths)[:, None]
+            if mode == "lstm":
+                (hp, cp), (hn, cn) = carry, new_carry
+                new_carry = (jnp.where(valid, hn, hp),
+                             jnp.where(valid, cn, cp))
+            else:
+                new_carry = jnp.where(valid, new_carry, carry)
+            y = jnp.where(valid, y, jnp.zeros((), y.dtype))
+            return new_carry, y
+
+        carryT, ys = lax.scan(step, init, (jnp.arange(T), x))
+    extra = carryT if mode == "lstm" else (carryT, None)
     if reverse:
-        ys = jnp.flip(ys, axis=0)
+        ys = jnp.flip(ys, axis=0) if lengths is None \
+            else _reverse_padded(ys, lengths)
     return ys, extra
 
 
 @register("RNN")
-def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
+def rnn(data, parameters, state, state_cell=None, sequence_length=None,
+        state_size=None, num_layers=1,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
-        projection_size=None, layout="TNC", _training=None):
-    """Fused multi-layer (bi)RNN. Returns output or (output, h_n[, c_n])."""
+        projection_size=None, layout="TNC", use_sequence_length=False,
+        linear_before_reset=True, _training=None):
+    """Fused multi-layer (bi)RNN. Returns output or (output, h_n[, c_n]).
+
+    `use_sequence_length` + `sequence_length` (N,) int lengths match the
+    reference RNN op's variable-length mode (upstream `src/operator/rnn.cc`
+    use_sequence_length): state freezes at each sequence's end, outputs
+    past it are zero, and the reverse direction starts at each sequence's
+    own end. `linear_before_reset` (GRU only) is an extension for ONNX
+    interop: False selects the ONNX-default gate order (reset applied to
+    the state before the recurrent matmul) instead of cuDNN semantics.
+    Symbol-graph note: when mode != 'lstm' the executor binds node inputs
+    positionally, so a lengths tensor arrives in the `state_cell` slot —
+    the guard below re-slots it."""
+    if use_sequence_length and sequence_length is None \
+            and mode != "lstm" and state_cell is not None:
+        sequence_length, state_cell = state_cell, None
     if layout == "NTC":
         data = jnp.swapaxes(data, 0, 1)
     T, N, I = data.shape
     dirs = 2 if bidirectional else 1
+    lengths = None
+    if use_sequence_length:
+        if sequence_length is None:
+            raise ValueError("RNN: use_sequence_length without "
+                             "sequence_length input")
+        lengths = jnp.asarray(sequence_length).astype(jnp.int32)
     layers = unpack_rnn_params(parameters, mode, num_layers, I, state_size, bidirectional)
 
     from .. import _engine
@@ -137,7 +217,9 @@ def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
             ent = layers[layer * dirs + d]
             h0 = state[layer * dirs + d]
             c0 = state_cell[layer * dirs + d] if mode == "lstm" else None
-            ys, (hT, cT) = _run_layer(x, ent, mode, h0, c0, reverse=(d == 1))
+            ys, (hT, cT) = _run_layer(x, ent, mode, h0, c0, reverse=(d == 1),
+                                      lengths=lengths,
+                                      lbr=linear_before_reset)
             outs.append(ys)
             h_finals.append(hT)
             if mode == "lstm":

@@ -91,3 +91,85 @@ def test_sequential_rnn_cell():
     out, states = seq(nd.ones((2, 4)), seq.begin_state(2))
     assert out.shape == (2, 6)
     assert len(states) == 3
+
+
+def _rnn_op(data, mode, state_size, seed=3, **kw):
+    """Call the fused RNN op on random packed weights (seeded)."""
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+    T, N, I = data.shape
+    bidir = kw.get("bidirectional", False)
+    rng = np.random.RandomState(seed)
+    n = rnn_param_size(mode, 1, I, state_size, bidir)
+    p = nd.array(rng.uniform(-0.2, 0.2, n).astype(np.float32))
+    dirs = 2 if bidir else 1
+    h0 = nd.zeros((dirs, N, state_size))
+    args = [nd.array(data), p, h0]
+    if mode == "lstm":
+        args.append(nd.zeros((dirs, N, state_size)))
+    return nd.RNN(*args, state_size=state_size, num_layers=1, mode=mode,
+                  state_outputs=True, **kw)
+
+
+def test_rnn_varlen_matches_per_sample():
+    """use_sequence_length: each padded sequence must produce exactly the
+    outputs/final state of running it alone unpadded — the reverse
+    direction of a bidirectional layer is the hard case (it must start at
+    each sequence's own end, not at the padding)."""
+    T, N, I, H = 6, 3, 4, 5
+    lens = np.array([4, 6, 2], np.int32)
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    for mode in ("lstm", "gru", "rnn_tanh"):
+        out = _rnn_op(x, mode, H, bidirectional=True,
+                      use_sequence_length=True, sequence_length=lens)
+        y, hn = out[0].asnumpy(), out[1].asnumpy()
+        cn = out[2].asnumpy() if mode == "lstm" else None
+        for n_i in range(N):
+            L = int(lens[n_i])
+            solo = _rnn_op(x[:L, n_i:n_i + 1], mode, H, bidirectional=True)
+            ys = solo[0].asnumpy()
+            np.testing.assert_allclose(y[:L, n_i], ys[:, 0], rtol=1e-5,
+                                       atol=1e-6, err_msg=f"{mode} n={n_i}")
+            # padding rows must be exactly zero
+            assert np.all(y[L:, n_i] == 0), f"{mode}: nonzero padding"
+            np.testing.assert_allclose(hn[:, n_i], solo[1].asnumpy()[:, 0],
+                                       rtol=1e-5, atol=1e-6, err_msg=mode)
+            if cn is not None:
+                np.testing.assert_allclose(
+                    cn[:, n_i], solo[2].asnumpy()[:, 0], rtol=1e-5,
+                    atol=1e-6)
+
+
+def test_gru_linear_before_reset_false():
+    """linear_before_reset=False must implement the ONNX-default GRU
+    update (reset applied to the state BEFORE the recurrent matmul) —
+    checked against a literal numpy transcription of the ONNX equations."""
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size, unpack_rnn_params
+    import jax
+    import jax.numpy as jnp
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(1)
+    x = rng.randn(T, N, I).astype(np.float32)
+    n = rnn_param_size("gru", 1, I, H, False)
+    p = rng.uniform(-0.4, 0.4, n).astype(np.float32)
+    out = nd.RNN(nd.array(x), nd.array(p), nd.zeros((1, N, H)),
+                 state_size=H, num_layers=1, mode="gru",
+                 linear_before_reset=False).asnumpy()
+
+    ent = jax.tree_util.tree_map(
+        np.asarray, unpack_rnn_params(jnp.asarray(p), "gru", 1, I, H))[0]
+    wi, wh, bi, bh = ent["wi"], ent["wh"], ent["bi"], ent["bh"]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((N, H), np.float32)
+    for t in range(T):
+        zi = x[t] @ wi.T + bi
+        ri, ui, ni = np.split(zi, 3, -1)
+        rh, uh, _ = np.split(h @ wh.T + bh, 3, -1)
+        r, u = sig(ri + rh), sig(ui + uh)
+        nn_ = np.tanh(ni + (r * h) @ wh[2 * H:].T + bh[2 * H:])
+        h = (1 - u) * nn_ + u * h
+        np.testing.assert_allclose(out[t], h, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"t={t}")

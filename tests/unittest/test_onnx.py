@@ -596,3 +596,89 @@ def test_yolov3_tiny_full_roundtrip(tmp_path):
     for r, g in zip(ref, got):
         assert r.shape == g.shape
         np.testing.assert_allclose(g, r, rtol=3e-5, atol=3e-6)
+
+
+@pytest.mark.parametrize("mode,bidir", [
+    ("gru", False), ("lstm", True), ("rnn_tanh", True)])
+def test_rnn_sequence_lens_roundtrip(tmp_path, mode, bidir):
+    """sequence_lens as a LIVE int32 graph input must round-trip onto the
+    op's use_sequence_length mode: the input is typed int32 in the ONNX
+    graph, outputs past each length stay zero, and the bidirectional
+    reverse pass anchors at each sequence's own end on both sides of the
+    round trip."""
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, N, I, H = 6, 3, 4, 5
+    lens = np.array([4, 6, 2], np.int32)
+    rs = np.random.RandomState(2)
+    dirs = 2 if bidir else 1
+    data = sym.var("data")
+    sl = sym.var("seq_len", shape=(N,))
+    psize = rnn_param_size(mode, 1, I, H, bidirectional=bidir)
+    p = sym.var("rnn_param", shape=(psize,))
+    h0 = sym.var("rnn_state", shape=(dirs, N, H))
+    params = {"rnn_param": nd.array(
+        rs.randn(psize).astype(np.float32) * 0.3),
+        "rnn_state": nd.array(np.zeros((dirs, N, H), np.float32))}
+    kw = dict(state_size=H, num_layers=1, mode=mode, bidirectional=bidir,
+              use_sequence_length=True, name="rnn0")
+    if mode == "lstm":
+        c0 = sym.var("rnn_state_cell", shape=(dirs, N, H))
+        params["rnn_state_cell"] = nd.array(
+            np.zeros((dirs, N, H), np.float32))
+        out = sym.RNN(data, p, h0, c0, sequence_length=sl, **kw)
+    else:
+        out = sym.RNN(data, p, h0, sequence_length=sl, **kw)
+
+    f = str(tmp_path / f"varlen_{mode}.onnx")
+    onnx_mx.export_model(out, params, {"data": (T, N, I), "seq_len": (N,)},
+                         f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = np.asarray(rs.randn(T, N, I), np.float32)
+
+    def run2(net, ps):
+        ex = net.simple_bind(ctx=mx.cpu(), data=(T, N, I), seq_len=(N,))
+        for name, arr in ex.arg_dict.items():
+            if name not in ("data", "seq_len"):
+                arr[:] = ps[name]
+        return ex.forward(is_train=False, data=nd.array(x),
+                          seq_len=nd.array(lens))[0].asnumpy()
+
+    y1 = run2(out, params)
+    y2 = run2(sym2, {**args2, **aux2})
+    np.testing.assert_allclose(y1, y2, rtol=2e-5, atol=2e-6)
+    for n_i in range(N):
+        assert np.all(y2[lens[n_i]:, n_i] == 0)
+    assert not np.all(y2[:2, 0] == 0)
+
+
+def test_gru_linear_before_reset_zero_roundtrip(tmp_path):
+    """A GRU built with the ONNX-default linear_before_reset=0 semantics
+    must export attr 0 and import back to the same outputs (r4 wall: the
+    importer used to reject these graphs outright)."""
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+
+    T, N, I, H = 5, 2, 3, 4
+    rs = np.random.RandomState(4)
+    data = sym.var("data")
+    psize = rnn_param_size("gru", 1, I, H)
+    p = sym.var("rnn_param", shape=(psize,))
+    h0 = sym.var("rnn_state", shape=(1, N, H))
+    params = {"rnn_param": nd.array(
+        rs.randn(psize).astype(np.float32) * 0.4),
+        "rnn_state": nd.array(np.zeros((1, N, H), np.float32))}
+    out = sym.RNN(data, p, h0, state_size=H, num_layers=1, mode="gru",
+                  linear_before_reset=False, name="rnn0")
+    f = str(tmp_path / "gru_lbr0.onnx")
+    onnx_mx.export_model(out, params, {"data": (T, N, I)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    x = nd.array(rs.randn(T, N, I).astype(np.float32))
+    y1 = _run(out, params, x)
+    y2 = _run(sym2, {**args2, **aux2}, x)
+    np.testing.assert_allclose(y1, y2, rtol=2e-5, atol=2e-6)
+    # and it must differ from the cuDNN-semantics cell (proves the attr
+    # actually changes the computation)
+    out_lbr1 = sym.RNN(data, p, h0, state_size=H, num_layers=1, mode="gru",
+                       name="rnn1")
+    y3 = _run(out_lbr1, params, x)
+    assert np.abs(y1 - y3).max() > 1e-4
