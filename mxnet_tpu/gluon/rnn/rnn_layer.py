@@ -24,10 +24,12 @@ class _RNNLayer(HybridBlock):
     def __init__(self, mode, hidden_size, num_layers, layout, dropout,
                  bidirectional, input_size, i2h_weight_initializer=None,
                  h2h_weight_initializer=None, i2h_bias_initializer="zeros",
-                 h2h_bias_initializer="zeros", **kwargs):
+                 h2h_bias_initializer="zeros", use_sequence_length=False,
+                 **kwargs):
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC")
         self._mode = mode
+        self._use_sequence_length = use_sequence_length
         self._hidden_size = hidden_size
         self._num_layers = num_layers
         self._layout = layout
@@ -71,7 +73,8 @@ class _RNNLayer(HybridBlock):
         func = func or _nd.zeros
         return [func(shape=info["shape"], **kwargs) for info in self.state_info(batch_size)]
 
-    def hybrid_forward(self, F, inputs, states=None, **params):
+    def hybrid_forward(self, F, inputs, states=None, sequence_length=None,
+                       **params):
         batch_axis = 0 if self._layout == "NTC" else 1
         batch = inputs.shape[batch_axis]
         ret_states = states is not None
@@ -79,6 +82,10 @@ class _RNNLayer(HybridBlock):
             states = self.begin_state(batch)
         if isinstance(states, NDArray):
             states = [states]
+        if self._use_sequence_length and sequence_length is None:
+            raise ValueError(
+                "this layer was built with use_sequence_length=True; "
+                "call it as layer(inputs, states, sequence_length)")
         flat = []
         for layer in range(self._num_layers):
             for d in range(self._dir):
@@ -93,9 +100,11 @@ class _RNNLayer(HybridBlock):
         packed = F.concat(*flat, dim=0)
         out = F.RNN(inputs, packed, states[0],
                     states[1] if self._mode == "lstm" else None,
+                    sequence_length,
                     state_size=self._hidden_size, num_layers=self._num_layers,
                     mode=self._mode, bidirectional=self._dir == 2,
-                    p=self._dropout, state_outputs=True, layout=self._layout)
+                    p=self._dropout, state_outputs=True, layout=self._layout,
+                    use_sequence_length=self._use_sequence_length)
         if self._mode == "lstm":
             output, h, c = out
             new_states = [h, c]

@@ -173,3 +173,30 @@ def test_gru_linear_before_reset_false():
         h = (1 - u) * nn_ + u * h
         np.testing.assert_allclose(out[t], h, rtol=1e-5, atol=1e-6,
                                    err_msg=f"t={t}")
+
+
+def test_gluon_layer_use_sequence_length():
+    """gluon.rnn.LSTM(use_sequence_length=True) forwards per-batch lengths
+    to the fused op (reference: rnn_layer.py use_sequence_length in 1.5+):
+    padded samples must match their solo unpadded runs."""
+    T, N, I, H = 6, 3, 4, 5
+    lens = np.array([4, 6, 2], np.int32)
+    rng = np.random.RandomState(7)
+    x = rng.randn(T, N, I).astype(np.float32)
+    layer = gluon.rnn.LSTM(H, input_size=I, bidirectional=True,
+                           use_sequence_length=True)
+    layer.initialize()
+    out, states = layer(nd.array(x), layer.begin_state(N),
+                        nd.array(lens))
+    y = out.asnumpy()
+    for n_i in range(N):
+        L = int(lens[n_i])
+        # run the same layer on the unpadded single sample
+        o2, s2 = layer(nd.array(x[:L, n_i:n_i + 1]),
+                       layer.begin_state(1), nd.array(lens[n_i:n_i + 1]))
+        np.testing.assert_allclose(y[:L, n_i], o2.asnumpy()[:, 0],
+                                   rtol=1e-5, atol=1e-6)
+        assert np.all(y[L:, n_i] == 0)
+        np.testing.assert_allclose(states[0].asnumpy()[:, n_i],
+                                   s2[0].asnumpy()[:, 0], rtol=1e-5,
+                                   atol=1e-6)
