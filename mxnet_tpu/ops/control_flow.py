@@ -95,3 +95,103 @@ def cond(pred, then_func, else_func, inputs):
         lambda xs: tuple(else_func(list(xs))),
         inputs)
     return list(out)
+
+
+# ---------------------------------------------------------------------------
+# SYMBOLIC control-flow ops: the graph-node form of the callables above
+# (reference: `_foreach`/`_while_loop`/`_cond` in src/operator/control_flow.cc
+# execute captured NNVM subgraphs; here the captured subgraph is a Symbol
+# carried as a node attr, evaluated with the symbolic executor's pure
+# `_eval_graph` inside the same lax primitives — so jit/vjp/shape-inference
+# all see ordinary traced XLA control flow).
+#
+# Input layout convention (recorded in the node's `in_names` attr, which
+# names every node input with its subgraph variable): data/loop-var/branch
+# inputs first, then the free variables the subgraphs capture from the
+# enclosing graph. Subgraphs re-trace any captured *computed* outer
+# expression per call; XLA hoists loop invariants, so this costs nothing at
+# runtime and keeps graph cutting trivial. RNG-drawing ops inside a
+# subgraph body trace ONCE (one key per scan, not per iteration) — a
+# dropout there repeats its mask across iterations; use the imperative API
+# if per-step masks matter.
+# ---------------------------------------------------------------------------
+
+from . import register as _register_cf  # noqa: E402
+
+
+def _subgraph_values(in_names, arrays):
+    return dict(zip(in_names, arrays))
+
+
+def _eval_sub(sub, values):
+    from ..symbol.executor import _eval_graph
+    from .. import _engine
+    heads, _aux = _eval_graph(sub, values, _engine.is_training())
+    return heads
+
+
+@_register_cf("_foreach")
+def _foreach_op(*arrays, _subgraph=None, in_names=(), num_data=0,
+                num_states=0, num_out_data=0, **_ignored):
+    in_names = list(in_names)
+    data = list(arrays[:num_data])
+    states = list(arrays[num_data:num_data + num_states])
+    free = _subgraph_values(in_names[num_data + num_states:],
+                            arrays[num_data + num_states:])
+
+    def body(xs, ss):
+        values = _subgraph_values(in_names[:num_data], xs)
+        values.update(_subgraph_values(
+            in_names[num_data:num_data + num_states], ss))
+        values.update(free)
+        heads = _eval_sub(_subgraph, values)
+        return heads[:num_out_data], heads[num_out_data:]
+
+    outs, finals = foreach(body, data, states)
+    res = tuple(outs) + tuple(finals)
+    return res if len(res) != 1 else res[0]
+
+
+@_register_cf("_while_loop")
+def _while_loop_op(*arrays, _subgraph_cond=None, _subgraph_func=None,
+                   in_names=(), num_loop_vars=0, num_out_data=0,
+                   max_iterations=None, **_ignored):
+    in_names = list(in_names)
+    lv = list(arrays[:num_loop_vars])
+    free = _subgraph_values(in_names[num_loop_vars:],
+                            arrays[num_loop_vars:])
+
+    def cond_fn(vs):
+        values = _subgraph_values(in_names[:num_loop_vars], vs)
+        values.update(free)
+        return _eval_sub(_subgraph_cond, values)[0]
+
+    def func(vs):
+        values = _subgraph_values(in_names[:num_loop_vars], vs)
+        values.update(free)
+        heads = _eval_sub(_subgraph_func, values)
+        return heads[:num_out_data], heads[num_out_data:]
+
+    outs, finals = while_loop(cond_fn, func, lv, max_iterations)
+    res = tuple(outs) + tuple(finals)
+    return res if len(res) != 1 else res[0]
+
+
+@_register_cf("_cond")
+def _cond_op(*arrays, _subgraph_then=None, _subgraph_else=None,
+             in_names=(), num_inputs=0, **_ignored):
+    in_names = list(in_names)
+    pred = arrays[0]
+    ins = list(arrays[1:1 + num_inputs])
+    free = _subgraph_values(in_names[num_inputs:], arrays[1 + num_inputs:])
+
+    def branch(sub):
+        def run(xs):
+            values = _subgraph_values(in_names[:num_inputs], xs)
+            values.update(free)
+            return _eval_sub(sub, values)
+        return run
+
+    res = tuple(cond(pred, branch(_subgraph_then), branch(_subgraph_else),
+                     ins))
+    return res if len(res) != 1 else res[0]

@@ -336,6 +336,34 @@ class Symbol:
     def __neg__(self):
         return _invoke("negative", [self], {})
 
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal",
+                           "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal",
+                           "_lesser_equal_scalar")
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    # __eq__ overridden for the reference's elementwise semantics; nodes
+    # stay identity-hashable (Symbol objects key dicts in the front-ends)
+    __hash__ = object.__hash__
+
     def __getattr__(self, name):
         if name.startswith("_") or name not in _ops.OPS:
             raise AttributeError(name)
@@ -438,11 +466,21 @@ class Symbol:
         index = {id(n): i for i, n in enumerate(order)}
         nodes = []
         for n in order:
+            # Symbol-valued attrs (control-flow subgraphs) serialize as a
+            # `subgraphs` list — [attr key, nested graph JSON dict] — the
+            # analog of NNVM's per-node subgraph storage
+            plain, subs = {}, []
+            for k, v in n.attrs.items():
+                if isinstance(v, Symbol):
+                    subs.append([k, json.loads(v.tojson())])
+                else:
+                    plain[k] = repr(v)
             nodes.append({
                 "op": "null" if n.is_var else n.op,
                 "name": n.name,
-                "attrs": {k: repr(v) for k, v in n.attrs.items()},
+                "attrs": plain,
                 "inputs": [[index[id(src)], idx, 0] for src, idx in n.inputs],
+                **({"subgraphs": subs} if subs else {}),
                 **({"shape": list(n._shape)} if n._shape else {}),
                 **({"scope_attrs": dict(n.scope_attrs)}
                    if n.scope_attrs else {}),
@@ -573,11 +611,16 @@ def ones(shape, dtype=None, **kwargs):
 # --------------------------------------------------------------------------
 
 def load_json(json_str):
-    d = json.loads(json_str)
+    return _load_json_dict(json.loads(json_str))
+
+
+def _load_json_dict(d):
     nodes = []
     for nd_ in d["nodes"]:
         attrs = {k: ast.literal_eval(v) for k, v in
                  nd_.get("attrs", {}).items()}
+        for k, sub in nd_.get("subgraphs", []):
+            attrs[k] = _load_json_dict(sub)
         node = _Node(None if nd_["op"] == "null" else nd_["op"],
                      nd_["name"], attrs=attrs,
                      shape=tuple(nd_["shape"]) if nd_.get("shape") else None)

@@ -1,0 +1,230 @@
+"""Symbolic control flow: sym.contrib.foreach / while_loop / cond
+(reference: `python/mxnet/symbol/contrib.py` over the subgraph ops in
+src/operator/control_flow.cc). The subgraph travels as a node attr,
+executes inside lax.scan/cond via the symbolic executor's pure evaluator,
+and serializes into the JSON `subgraphs` field."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _bind_run(out, shapes, vals, train=False, grads=None):
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="write" if grads else "null",
+                         **shapes)
+    for k, v in vals.items():
+        if k in ex.arg_dict:
+            ex.arg_dict[k][:] = v
+    res = ex.forward(is_train=train)
+    if grads:
+        ex.backward(grads)
+    return ex, [r.asnumpy() for r in res]
+
+
+def test_sym_foreach_scan_with_free_param():
+    """foreach body captures an outer weight var (a free variable): the
+    node must pick it up as an extra input and the scan must match a
+    hand-rolled numpy recurrence."""
+    T, N, H = 5, 2, 3
+    rs = np.random.RandomState(0)
+    xv = rs.randn(T, N, H).astype(np.float32)
+    wv = rs.randn(H, H).astype(np.float32) * 0.3
+    s0 = np.zeros((N, H), np.float32)
+
+    data = sym.var("data")
+    state0 = sym.var("state0")
+    w = sym.var("w")
+
+    def body(x_t, s):
+        s2 = sym.tanh(sym.dot(x_t + s, w))
+        return s2 * 2.0, s2
+
+    outs, final = sym.contrib.foreach(body, data, state0, name="fe")
+    grouped = sym.Group([outs, final])
+    _, (ys, sT) = _bind_run(
+        grouped, {"data": (T, N, H), "state0": (N, H), "w": (H, H)},
+        {"data": xv, "state0": s0, "w": wv})
+
+    s = s0
+    for t in range(T):
+        s = np.tanh((xv[t] + s) @ wv)
+        np.testing.assert_allclose(ys[t], s * 2.0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sT, s, rtol=1e-5, atol=1e-6)
+
+
+def test_sym_foreach_gradient():
+    """jax.vjp must flow through the subgraph scan to both the data and
+    the captured free param."""
+    T, N = 4, 3
+    rs = np.random.RandomState(1)
+    xv = rs.randn(T, N).astype(np.float32)
+    wv = np.float32(0.7)
+
+    data = sym.var("data")
+    state0 = sym.var("state0")
+    w = sym.var("w")
+
+    def body(x_t, s):
+        s2 = s + x_t * w
+        return s2, s2
+
+    outs, final = sym.contrib.foreach(body, data, state0, name="feg")
+    loss = sym.sum(final)
+    ex = loss.simple_bind(ctx=mx.cpu(), grad_req="write",
+                          data=(T, N), state0=(N,), w=(1,))
+    ex.arg_dict["data"][:] = xv
+    ex.arg_dict["state0"][:] = np.zeros((N,), np.float32)
+    ex.arg_dict["w"][:] = np.asarray([wv], np.float32)
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((1,)) if False else nd.array(np.float32(1.0)))
+    # final = sum over n of sum_t x[t,n]*w  -> d/dx = w, d/dw = sum(x)
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full((T, N), wv), rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(),
+                               [xv.sum()], rtol=1e-5)
+
+
+def test_sym_while_loop_cumsum_until():
+    """while_loop pads step outputs with zeros past the first failing
+    predicate, matching the imperative ndarray.contrib semantics."""
+    limit = 10.0
+
+    i0 = sym.var("i0")
+    acc0 = sym.var("acc0")
+
+    def cond_fn(i, acc):
+        return sym.sum(acc) < limit
+
+    def func(i, acc):
+        return [i], [i + 1.0, acc + i]
+
+    outs, finals = sym.contrib.while_loop(
+        cond_fn, func, [i0, acc0], max_iterations=8, name="wl")
+    grouped = sym.Group([outs[0], finals[0], finals[1]])
+    _, (steps, i_f, acc_f) = _bind_run(
+        grouped, {"i0": (1,), "acc0": (1,)},
+        {"i0": np.ones((1,), np.float32),
+         "acc0": np.zeros((1,), np.float32)})
+    # 1+2+3+4 = 10 -> 5th check fails; steps emitted for i=1..4
+    np.testing.assert_allclose(steps.ravel()[:4], [1, 2, 3, 4])
+    assert np.all(steps.ravel()[4:] == 0)
+    np.testing.assert_allclose(acc_f, [10.0])
+    np.testing.assert_allclose(i_f, [5.0])
+
+
+def test_sym_cond_branches_and_free_vars():
+    p = sym.var("p")
+    x = sym.var("x")
+    scale = sym.var("scale")
+    out = sym.contrib.cond(
+        sym.sum(p) > 0.0,
+        lambda v: v * scale,
+        lambda v: v - 1.0,
+        x, name="cd")
+    for pv, want in [(1.0, lambda v, s: v * s), (-1.0, lambda v, s: v - 1)]:
+        _, (y,) = _bind_run(out, {"p": (1,), "x": (4,), "scale": (1,)},
+                            {"p": np.full((1,), pv, np.float32),
+                             "x": np.arange(4, dtype=np.float32),
+                             "scale": np.asarray([3.0], np.float32)})
+        np.testing.assert_allclose(
+            y, want(np.arange(4, dtype=np.float32), 3.0))
+
+
+def test_sym_foreach_json_roundtrip(tmp_path):
+    """The subgraph must survive save/load: serialized into the node's
+    `subgraphs` JSON field and rebuilt into a working executor."""
+    T, N = 3, 2
+    data = sym.var("data")
+    state0 = sym.var("state0")
+    w = sym.var("w")
+
+    def body(x_t, s):
+        s2 = s * w + x_t
+        return s2, s2
+
+    outs, final = sym.contrib.foreach(body, data, state0, name="fej")
+    grouped = sym.Group([outs, final])
+    f = str(tmp_path / "cf.json")
+    grouped.save(f)
+    loaded = sym.load(f)
+    assert "fej_slice0" not in loaded.list_arguments()  # stays subgraph-local
+    rs = np.random.RandomState(2)
+    xv = rs.randn(T, N).astype(np.float32)
+    shapes = {"data": (T, N), "state0": (N,), "w": (1,)}
+    vals = {"data": xv, "state0": np.zeros((N,), np.float32),
+            "w": np.asarray([0.5], np.float32)}
+    _, y1 = _bind_run(grouped, shapes, vals)
+    _, y2 = _bind_run(loaded, shapes, vals)
+    for a, b in zip(y1, y2):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_sym_foreach_nested_no_aliasing():
+    """Nested foreach with DEFAULT names must not alias the outer loop's
+    bound variables (each subgraph gets serial-unique var names): the
+    inner body reads the OUTER slice, so aliasing would silently compute
+    with the inner slice instead."""
+    outer = np.asarray([[0., 1., 2.], [3., 4., 5.]], np.float32)
+
+    data = sym.var("data")
+    z0 = sym.var("z0")
+
+    def outer_body(x_row, s):
+        def inner_body(y, t):
+            return y + sym.sum(x_row), t
+        inner_outs, _ = sym.contrib.foreach(inner_body, x_row, s)
+        return sym.sum(inner_outs), s
+
+    outs, _ = sym.contrib.foreach(outer_body, data, z0)
+    _, (y,) = _bind_run(outs, {"data": (2, 3), "z0": (1,)},
+                        {"data": outer, "z0": np.zeros((1,), np.float32)})
+    # row [0,1,2]: sum=3; inner adds 3 to each of 3 elements -> 3+9=12
+    # row [3,4,5]: sum=12; 12+36=48
+    np.testing.assert_allclose(y.ravel(), [12.0, 48.0])
+
+
+def test_sym_foreach_scalar_state_structure():
+    """A bare (non-list) init_states must come back as a bare Symbol,
+    mirroring nd.contrib's structure-preserving packing."""
+    data = sym.var("data")
+    s0 = sym.var("s0")
+    outs, fin = sym.contrib.foreach(
+        lambda x, s: (x + s, x + s), data, s0)
+    assert not isinstance(fin, (list, tuple))
+    grouped = sym.Group([outs, fin])
+    xv = np.asarray([[1.0], [2.0]], np.float32)
+    _, (ys, f) = _bind_run(grouped, {"data": (2, 1), "s0": (1,)},
+                           {"data": xv, "s0": np.zeros((1,), np.float32)})
+    np.testing.assert_allclose(ys.ravel(), [1.0, 3.0])
+    np.testing.assert_allclose(f, [3.0])
+
+
+def test_sym_nd_contrib_same_callbacks():
+    """The SAME callback code must run on both sym.contrib and
+    nd.contrib (the call conventions are shared)."""
+    from mxnet_tpu.ndarray import contrib as ndc
+
+    def cond_fn(i, acc):
+        return sym_or_nd_sum(acc) < 6.0
+
+    def func(i, acc):
+        return [i], [i + 1.0, acc + i]
+
+    # imperative
+    import mxnet_tpu
+    sym_or_nd_sum = lambda v: v.sum()  # noqa: E731
+    outs_nd, fin_nd = ndc.while_loop(
+        cond_fn, func, [nd.ones((1,)), nd.zeros((1,))], max_iterations=6)
+    # symbolic
+    sym_or_nd_sum = sym.sum
+    i0, a0 = sym.var("i0"), sym.var("a0")
+    outs_s, fin_s = sym.contrib.while_loop(
+        cond_fn, func, [i0, a0], max_iterations=6, name="wl2")
+    g = sym.Group([outs_s[0], fin_s[0], fin_s[1]])
+    _, (st, fi, fa) = _bind_run(
+        g, {"i0": (1,), "a0": (1,)},
+        {"i0": np.ones((1,), np.float32),
+         "a0": np.zeros((1,), np.float32)})
+    np.testing.assert_allclose(st.ravel(), outs_nd[0].asnumpy().ravel())
+    np.testing.assert_allclose(fi, fin_nd[0].asnumpy())
+    np.testing.assert_allclose(fa, fin_nd[1].asnumpy())
