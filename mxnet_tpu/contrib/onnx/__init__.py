@@ -20,8 +20,17 @@ the ONNX-default 0 semantics natively), and `sequence_lens` round-trips
 — as an int32 initializer or a live int32 graph input — onto the op's
 use_sequence_length varlen mode (Y zeroed past each length, Y_h/Y_c
 frozen at it, reverse direction anchored at each sequence's own end).
-Still NOT covered: control flow (Loop/If), per-direction heterogeneous
-RNN activations, genuinely dynamic shapes (a Shape chain that static
+Control flow round-trips (BEYOND the reference, whose mx2onnx has no
+such converters): sym.contrib.cond <-> If, foreach <-> Scan, and
+while_loop <-> Loop in the final-state form — Loop/while with
+per-iteration scan outputs stays walled both ways (ONNX concatenates a
+DYNAMIC number of rows; this runtime zero-pads to max_iterations, so the
+shapes genuinely disagree). Free variables ride ONNX outer-scope
+capture; comparison ops (Greater/Less/... <-> broadcast_*/_*_scalar,
+float 0/1 semantics preserved via Cast), MatMul <-> dot, the
+ReduceSum/Mean/Max/Min/Prod family, and the common unaries round-trip
+with them. Still NOT covered: per-direction heterogeneous RNN
+activations, genuinely dynamic shapes (a Shape chain that static
 inference cannot resolve raises).
 Serialization is the in-tree wire codec (`_proto.py`) — the
 environment bakes no `onnx` package, but files written here follow the
@@ -295,12 +304,12 @@ def _export_node(node, in_names, out_names, consts, param_values=None,
                           const("ends", np.asarray(ends, np.int64)),
                           const("axes", np.asarray(axes, np.int64)),
                           const("steps", np.asarray(steps, np.int64))])
-    if op == "sqrt":
-        return n1("Sqrt")
-    if op == "erf":
-        return n1("Erf")
-    if op == "exp":
-        return n1("Exp")
+    _UNARY1 = {"sqrt": "Sqrt", "erf": "Erf", "exp": "Exp", "tanh": "Tanh",
+               "sigmoid": "Sigmoid", "relu": "Relu", "log": "Log",
+               "negative": "Neg", "abs": "Abs", "floor": "Floor",
+               "ceil": "Ceil"}
+    if op in _UNARY1:
+        return n1(_UNARY1[op])
     if op in ("_power", "broadcast_power"):
         return n1("Pow")
     if op in ("elemwise_sub", "broadcast_sub", "_sub"):
@@ -393,8 +402,238 @@ def _export_node(node, in_names, out_names, consts, param_values=None,
     if op == "RNN":
         return _export_rnn(node, in_names, out_names, consts,
                            param_values, int32_inputs)
+    if op == "cast":
+        return n1("Cast", attrs={"to": int(P.NP2ONNX[str(np.dtype(
+            _attr(a, "dtype", "float32")))])})
+    _CMP = {"broadcast_greater": "Greater", "_greater_scalar": "Greater",
+            "broadcast_lesser": "Less", "_lesser_scalar": "Less",
+            "broadcast_greater_equal": "GreaterOrEqual",
+            "_greater_equal_scalar": "GreaterOrEqual",
+            "broadcast_lesser_equal": "LessOrEqual",
+            "_lesser_equal_scalar": "LessOrEqual",
+            "broadcast_equal": "Equal", "_equal_scalar": "Equal"}
+    if op in _CMP:
+        # our comparisons produce FLOAT 0/1 (mxnet semantics); ONNX
+        # comparison ops produce bool — Cast back on the way out
+        ins_ = list(in_names)
+        if op.startswith("_"):       # scalar form: rhs becomes a const
+            ins_ = [in_names[0],
+                    const("cmp", np.float32(_attr(a, "scalar", 0.0)))]
+        raw = f"{nm}_cmpb"
+        return [P.node(_CMP[op], ins_, [raw], name=f"{nm}_cmp"),
+                P.node("Cast", [raw], [out_name], name=nm,
+                       attrs={"to": int(P.TENSOR_FLOAT)})]
+    if op == "dot":
+        if _attr(a, "transpose_a", False) or _attr(a, "transpose_b", False):
+            raise NotImplementedError(
+                "ONNX export: dot with transpose flags")
+        return n1("MatMul")
+    if op in ("sum", "mean", "max", "min", "prod"):
+        if _attr(a, "exclude", False):
+            raise NotImplementedError("ONNX export: reduce with exclude=1")
+        axis = _attr(a, "axis", None)
+        axes = None if axis is None or axis == () else \
+            [int(x) for x in (axis if isinstance(axis, (list, tuple))
+                              else [axis])]
+        kd = int(bool(_attr(a, "keepdims", False)))
+        rname = {"sum": "ReduceSum", "mean": "ReduceMean",
+                 "max": "ReduceMax", "min": "ReduceMin",
+                 "prod": "ReduceProd"}[op]
+        if rname == "ReduceSum":     # opset 13: axes is an INPUT here
+            ins_ = [in_names[0]] + ([const(
+                "axes", np.asarray(axes, np.int64))] if axes else [])
+            return n1("ReduceSum", inputs=ins_, attrs={"keepdims": kd})
+        attrs = {"keepdims": kd}
+        if axes:
+            attrs["axes"] = axes
+        return n1(rname, attrs=attrs)
+    if op in ("_cond", "_foreach", "_while_loop"):
+        return _export_control_flow(node, in_names, out_names, consts,
+                                    param_values, int32_inputs)
     raise NotImplementedError(f"ONNX export: op '{op}' not in the "
                               "supported subset")
+
+
+def _emit_graph(sub, var_names, consts, param_values, int32_inputs, prefix,
+                graph_inputs=(), head_names=None, head_order=None):
+    """Serialize a control-flow subgraph Symbol to GraphProto bytes.
+
+    var_names: subgraph-bound var name -> ONNX value name. Free variables
+    (enclosing-graph params) keep their own names and resolve via ONNX
+    outer-scope capture; decomposition constants append to the OUTER
+    `consts` for the same reason. Computed value names are
+    `prefix/`-qualified against collisions with the enclosing graph.
+    graph_inputs: [(name, dtype_enum, shape|None)] explicit body inputs
+    (Scan/Loop; If bodies have none). head_names: optional fixed names for
+    the subgraph outputs; head_order: permutation applied to the heads
+    (ONNX Scan wants states before scan-outputs, our nodes put outs
+    first)."""
+    topo = list(sub._topo_nodes())
+    n_out = {id(n): 1 for n in topo}
+    for node in topo:
+        for src, idx in node.inputs:
+            n_out[id(src)] = max(n_out.get(id(src), 1), idx + 1)
+    for hn, hidx in sub._heads:
+        n_out[id(hn)] = max(n_out.get(id(hn), 1), hidx + 1)
+    name_of = {}
+    nodes_b = []
+    for node in topo:
+        if node.is_var:
+            name_of[(id(node), 0)] = var_names.get(node.name, node.name)
+            continue
+        in_names = [name_of[(id(src), idx)] for src, idx in node.inputs]
+        outs = [f"{prefix}/{node.name}_output" if i == 0 else
+                f"{prefix}/{node.name}_output{i}"
+                for i in range(n_out[id(node)])]
+        for nb in _export_node(node, in_names, outs, consts,
+                               param_values=param_values,
+                               int32_inputs=int32_inputs):
+            nodes_b.append(nb)
+        for i, o in enumerate(outs):
+            name_of[(id(node), i)] = o
+    heads = list(sub._heads)
+    if head_order is not None:
+        heads = [heads[i] for i in head_order]
+    out_vals = []
+    for i, (hn, hidx) in enumerate(heads):
+        val = name_of[(id(hn), 0 if hn.is_var else hidx)]
+        if head_names is not None:
+            # a head that is itself an input var (pass-through) or shared
+            # between two outputs needs an Identity to own its fixed name
+            nodes_b.append(P.node("Identity", [val], [head_names[i]],
+                                  name=f"{prefix}/out{i}"))
+            val = head_names[i]
+        out_vals.append(val)
+    inputs_vi = [P.value_info(nm_, dt, shp) for nm_, dt, shp in graph_inputs]
+    outputs_vi = [P.value_info(v, P.TENSOR_FLOAT, None) for v in out_vals]
+    return P.graph(nodes_b, f"{prefix}_body", inputs_vi, outputs_vi, []), \
+        out_vals
+
+
+def _export_control_flow(node, in_names, out_names, consts, param_values,
+                         int32_inputs):
+    """_cond -> ONNX If; _foreach -> ONNX Scan; _while_loop -> ONNX Loop
+    (final-state form). The reference never exported control flow at all
+    (upstream mx2onnx has no Loop/If/Scan converters); subgraph Symbols
+    carry enough structure to map them onto the ONNX control-flow ops
+    directly."""
+    a, nm = node.attrs, node.name
+    sub_names = a["in_names"]
+    # export_model derives out_names from CONSUMER references; an unused
+    # trailing output (e.g. a discarded final state) must still occupy
+    # its ONNX output slot or the positional mapping silently shifts
+    if node.op == "_cond":
+        full = len(a["_subgraph_then"]._heads)
+    elif node.op == "_foreach":
+        full = a["num_out_data"] + a["num_states"]
+    else:
+        full = a["num_out_data"] + a["num_loop_vars"]
+    out_names = list(out_names) + [f"{nm}_unused{i}"
+                                   for i in range(len(out_names), full)]
+
+    def boolify(val, tag):
+        out = f"{nm}_{tag}"
+        return P.node("Cast", [val], [out], name=out,
+                      attrs={"to": int(P.TENSOR_BOOL)}), out
+
+    if node.op == "_cond":
+        k = a["num_inputs"]
+        # bound branch inputs alias the outer values by name; free vars
+        # resolve by outer-scope capture
+        var_map = dict(zip(sub_names[:k], in_names[1:1 + k]))
+        then_g, _ = _emit_graph(a["_subgraph_then"], var_map, consts,
+                                param_values, int32_inputs, f"{nm}/t",
+                                head_names=[f"{nm}/t_out{i}" for i in
+                                            range(len(out_names))])
+        else_g, _ = _emit_graph(a["_subgraph_else"], var_map, consts,
+                                param_values, int32_inputs, f"{nm}/e",
+                                head_names=[f"{nm}/e_out{i}" for i in
+                                            range(len(out_names))])
+        cast, pred = boolify(in_names[0], "predb")
+        return [cast, P.node("If", [pred], out_names, name=nm,
+                             attrs={"then_branch": P.GraphAttr(then_g),
+                                    "else_branch": P.GraphAttr(else_g)})]
+
+    if node.op == "_foreach":
+        ndat, nst = a["num_data"], a["num_states"]
+        nout = a["num_out_data"]
+        st_in = [f"{nm}/st{i}" for i in range(nst)]
+        sl_in = [f"{nm}/sl{i}" for i in range(ndat)]
+        var_map = dict(zip(sub_names[:ndat], sl_in))
+        var_map.update(zip(sub_names[ndat:ndat + nst], st_in))
+        # ONNX Scan body signature: states first, then scan-input slices;
+        # outputs states first, then scan outputs — our heads are
+        # [outs..., states...], so permute
+        order = list(range(nout, nout + nst)) + list(range(nout))
+        gi = [(s, P.TENSOR_FLOAT, None) for s in st_in + sl_in]
+        body, _ = _emit_graph(
+            a["_subgraph"], var_map, consts, param_values, int32_inputs,
+            f"{nm}/b", graph_inputs=gi, head_order=order,
+            head_names=[f"{nm}/b_out{i}" for i in range(nout + nst)])
+        scan_ins = in_names[ndat:ndat + nst] + in_names[:ndat]
+        scan_outs = out_names[nout:] + out_names[:nout]
+        return [P.node("Scan", scan_ins, scan_outs, name=nm,
+                       attrs={"body": P.GraphAttr(body),
+                              "num_scan_inputs": int(ndat)})]
+
+    # _while_loop -> Loop. ONNX Loop concatenates per-iteration scan
+    # outputs to a DYNAMIC length; our masked-scan zero-pads to
+    # max_iterations — the shapes disagree, so only the final-state form
+    # (num_out_data == 0) exports
+    if a["num_out_data"]:
+        raise NotImplementedError(
+            "ONNX export: while_loop with per-step outputs does not map "
+            "onto ONNX Loop (Loop concatenates a dynamic number of rows; "
+            "this runtime zero-pads to max_iterations). Export the "
+            "final-state form, or restructure as foreach")
+    nlv = a["num_loop_vars"]
+    var_map0 = dict(zip(sub_names[:nlv], in_names[:nlv]))
+    # initial predicate: the cond subgraph evaluated in the OUTER graph
+    # on the initial loop-var values
+    cond0_g, cond0_vals = _emit_graph(
+        a["_subgraph_cond"], var_map0, consts, param_values, int32_inputs,
+        f"{nm}/c0")
+    outer_nodes = _unpack_graph_nodes(cond0_g)
+    cast0, cond0 = boolify(cond0_vals[0], "cond0b")
+    outer_nodes.append(cast0)
+    # body: inputs (iter, cond_in, vars...); emit func on the input vars,
+    # then cond on the RESULTING vars; output (cond_out, new_vars...)
+    it_in, c_in = f"{nm}/iter", f"{nm}/cin"
+    lv_in = [f"{nm}/lv{i}" for i in range(nlv)]
+    var_map = dict(zip(sub_names[:nlv], lv_in))
+    body_g, body_vals = _emit_graph(
+        a["_subgraph_func"], var_map, consts, param_values, int32_inputs,
+        f"{nm}/f", head_names=[f"{nm}/f_out{i}" for i in range(nlv)])
+    body_nodes = _unpack_graph_nodes(body_g)
+    var_map_next = dict(zip(sub_names[:nlv], body_vals))
+    condn_g, condn_vals = _emit_graph(
+        a["_subgraph_cond"], var_map_next, consts, param_values,
+        int32_inputs, f"{nm}/cn")
+    body_nodes += _unpack_graph_nodes(condn_g)
+    castn, condn = boolify(condn_vals[0], "condnb")
+    body_nodes.append(castn)
+    gi = [(it_in, P.TENSOR_INT64, []), (c_in, P.TENSOR_BOOL, [])] + \
+        [(s, P.TENSOR_FLOAT, None) for s in lv_in]
+    body = P.graph(
+        body_nodes, f"{nm}_body",
+        [P.value_info(n_, d_, s_) for n_, d_, s_ in gi],
+        [P.value_info(condn, P.TENSOR_BOOL, [])] +
+        [P.value_info(v, P.TENSOR_FLOAT, None) for v in body_vals], [])
+    consts.append((f"{nm}_M", np.asarray(a["max_iterations"], np.int64)))
+    return outer_nodes + [
+        P.node("Loop", [f"{nm}_M", cond0] + in_names[:nlv], out_names,
+               name=nm, attrs={"body": P.GraphAttr(body)})]
+
+
+def _unpack_graph_nodes(graph_bytes):
+    """NodeProto bytes list of a serialized GraphProto (field 1)."""
+    r = P.Reader(graph_bytes)
+    out = []
+    while not r.eof():
+        f, _, v = r.field()
+        if f == 1:
+            out.append(v)
+    return out
 
 
 def _export_rnn(node, in_names, out_names, consts, param_values,
@@ -595,7 +834,7 @@ def export_model(sym, params, input_shapes, onnx_file,
                                param_values=param_np,
                                int32_inputs=int32_inputs):
             nodes_b.append(nb)
-            referenced.update(P.node_input_names(nb))
+            referenced.update(P.node_all_input_names(nb))
         for i, o in enumerate(outs):
             name_of[(id(node), i)] = o
 
@@ -622,7 +861,7 @@ def export_model(sym, params, input_shapes, onnx_file,
     try:
         _, out_shapes, _ = sym.infer_shape(**input_shapes)
     except Exception:
-        out_shapes = [() for _ in heads]
+        out_shapes = [None for _ in heads]   # unknown rank, NOT scalar
     outputs_vi = []
     for (hn, hidx), shape in zip(heads, out_shapes):
         out_val = name_of[(id(hn), hidx if not hn.is_var else 0)]
@@ -750,10 +989,25 @@ def _import_node(n, sym_of, sym_mod, inits, ctx=None):
         return sym_mod.erf(ins[0], name=name)
     if op == "Exp":
         return sym_mod.exp(ins[0], name=name)
-    if op == "ReduceMean":
+    if op in ("Log", "Neg", "Abs", "Floor", "Ceil"):
+        fn = {"Log": sym_mod.log, "Neg": sym_mod.negative,
+              "Abs": sym_mod.abs, "Floor": sym_mod.floor,
+              "Ceil": sym_mod.ceil}[op]
+        return fn(ins[0], name=name)
+    if op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin",
+              "ReduceProd"):
         axes = tuple(a.get("axes", ()))
-        return sym_mod.mean(ins[0], axis=axes or None,
-                            keepdims=bool(a.get("keepdims", 1)), name=name)
+        if op == "ReduceSum" and len(n["inputs"]) > 1 and n["inputs"][1]:
+            v = const_in(1)
+            if v is None:
+                raise NotImplementedError(
+                    "ONNX import: ReduceSum with computed axes")
+            axes = tuple(int(x) for x in np.asarray(v).ravel())
+        fn = {"ReduceMean": sym_mod.mean, "ReduceSum": sym_mod.sum,
+              "ReduceMax": sym_mod.max, "ReduceMin": sym_mod.min,
+              "ReduceProd": sym_mod.prod}[op]
+        return fn(ins[0], axis=axes or None,
+                  keepdims=bool(a.get("keepdims", 1)), name=name)
     if op == "Transpose":
         return sym_mod.transpose(ins[0], axes=tuple(a.get("perm", ())),
                                  name=name)
@@ -866,8 +1120,133 @@ def _import_node(n, sym_of, sym_mod, inits, ctx=None):
                                   sample_type="nearest", name=name)
     if op in ("LSTM", "GRU", "RNN"):
         return _import_rnn(n, ins, sym_mod, const_in, ctx, name)
+    if op in ("Greater", "Less", "GreaterOrEqual", "LessOrEqual",
+              "Equal"):
+        fn = {"Greater": sym_mod.broadcast_greater,
+              "Less": sym_mod.broadcast_lesser,
+              "GreaterOrEqual": sym_mod.broadcast_greater_equal,
+              "LessOrEqual": sym_mod.broadcast_lesser_equal,
+              "Equal": sym_mod.broadcast_equal}[op]
+        return fn(ins[0], ins[1], name=name)
+    if op == "MatMul":
+        return sym_mod.dot(ins[0], ins[1], name=name)
+    if op == "Cast":
+        to = P.ONNX2NP.get(int(a.get("to", P.TENSOR_FLOAT)), "float32")
+        # bool has no mxnet dtype; comparisons/predicates are float here
+        return sym_mod.cast(ins[0], dtype="float32" if to == "bool" else to,
+                            name=name)
+    if op == "Identity":
+        return sym_mod.copy(ins[0], name=name)
+    if op in ("If", "Scan", "Loop"):
+        return _import_control_flow(n, ins, sym_mod, const_in, ctx, name,
+                                    sym_of)
     raise NotImplementedError(f"ONNX import: op '{op}' not in the "
                               "supported subset")
+
+
+def _import_control_flow(n, ins, sym_mod, const_in, ctx, name, sym_of):
+    """ONNX If -> sym.contrib.cond; Scan -> foreach; Loop -> a foreach
+    over max-trip-count whose body gates on the carried predicate with a
+    nested cond (exactly ONNX's run-body-then-recheck semantics). Body
+    graphs import through ctx['run_nodes'] with a scope seeded from the
+    enclosing graph — ONNX outer-scope capture."""
+    from ...symbol import contrib as symc
+    op, a = n["op_type"], n["attrs"]
+    run_nodes = ctx["run_nodes"]
+
+    def body_heads(gd, scope):
+        if gd.get("initializers"):
+            raise NotImplementedError(
+                f"ONNX import: {op} body-local initializers unsupported "
+                "(hoist them to the main graph)")
+        run_nodes(gd["nodes"], scope)
+        return [scope[o["name"]] for o in gd["outputs"]]
+
+    if op == "If":
+        then_l = body_heads(a["then_branch"], dict(sym_of))
+        else_l = body_heads(a["else_branch"], dict(sym_of))
+
+        def pack(hs):
+            return hs[0] if len(hs) == 1 else list(hs)
+
+        return symc.cond(ins[0], lambda: pack(then_l),
+                         lambda: pack(else_l), name=name)
+
+    if op == "Scan":
+        nsi = int(a["num_scan_inputs"])
+        nst = len(n["inputs"]) - nsi
+        body = a["body"]
+        if any(int(x) for x in a.get("scan_input_axes", [])) or \
+                any(int(x) for x in a.get("scan_output_axes", [])) or \
+                any(int(x) for x in a.get("scan_input_directions", [])) or \
+                any(int(x) for x in a.get("scan_output_directions", [])):
+            raise NotImplementedError(
+                "ONNX import: Scan with non-default axes/directions")
+        b_in = [vi["name"] for vi in body["inputs"]]
+
+        def body_fn(xs, ss):
+            xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+            ss_l = ss if isinstance(ss, (list, tuple)) else [ss]
+            scope = dict(sym_of)
+            scope.update(zip(b_in[:nst], ss_l))
+            scope.update(zip(b_in[nst:], xs_l))
+            heads = body_heads(body, scope)
+            # body outputs: states first, then scan outputs
+            return heads[nst:], heads[:nst]
+
+        outs, finals = symc.foreach(body_fn, list(ins[nst:]),
+                                    list(ins[:nst]), name=name)
+        # node outputs: final states first, then stacked scan outputs
+        return list(finals) + list(outs)
+
+    # Loop: inputs (M, cond, v_initial...); body (iter, cond_in, vars...)
+    # -> (cond_out, vars_out, scan_outs...). Only the final-state form
+    # imports (scan outputs would come back zero-padded to M, not with
+    # ONNX's dynamic length).
+    body = a["body"]
+    nlv = len(n["inputs"]) - 2
+    if len(body["outputs"]) != 1 + nlv:
+        raise NotImplementedError(
+            "ONNX import: Loop with per-iteration scan outputs unsupported "
+            "(dynamic concat length; restructure as Scan)")
+    m_val = const_in(0)
+    if m_val is None:
+        raise NotImplementedError(
+            "ONNX import: Loop trip count must be a static initializer")
+    max_iter = int(np.asarray(m_val).ravel()[0])
+    if max_iter > 1_000_000:
+        raise NotImplementedError(
+            f"ONNX import: Loop trip count {max_iter} — unbounded-loop "
+            "sentinel trip counts cannot lower to a static-length scan; "
+            "re-export with a real max_iterations bound")
+    ctx["folded_inits"].add(n["inputs"][0])   # M became the static length
+    b_in = [vi["name"] for vi in body["inputs"]]
+    it_name, cin_name = b_in[0], b_in[1]
+    iters = sym_mod._arange(start=0, stop=max_iter, dtype="float32",
+                            name=f"{name or 'loop'}_iter")
+
+    def step(it, ss):
+        c, vars_ = ss[0], ss[1:]
+
+        def live():
+            scope = dict(sym_of)
+            scope[it_name] = sym_mod.cast(it, dtype="int32")
+            scope[cin_name] = c
+            scope.update(zip(b_in[2:], vars_))
+            heads = body_heads(body, scope)
+            cond_out = sym_mod.cast(heads[0], dtype="float32")
+            return [cond_out] + heads[1:]
+
+        def frozen():
+            return [c] + list(vars_)
+
+        return it, symc.cond(c > 0.5, live, frozen, name=f"{name}_gate"
+                             if name else None)
+
+    cond0 = sym_mod.cast(ins[1], dtype="float32")
+    _, finals = symc.foreach(step, iters, [cond0] + list(ins[2:]),
+                             name=name)
+    return list(finals[1:])
 
 
 def _import_rnn(n, ins, sym_mod, const_in, ctx, name):
@@ -997,8 +1376,18 @@ def import_model(onnx_file):
         m = P.parse_model(f.read())
     g = m["graph"]
     inits = g["initializers"]
+    def all_nodes(nodes):
+        """Every node including those inside If/Loop/Scan body graphs —
+        an initializer consumed only by a subgraph node is still consumed
+        (outer-scope capture)."""
+        for n in nodes:
+            yield n
+            for v in n["attrs"].values():
+                if isinstance(v, dict) and "nodes" in v:
+                    yield from all_nodes(v["nodes"])
+
     aux_names = set()
-    for n in g["nodes"]:
+    for n in all_nodes(g["nodes"]):
         if n["op_type"] == "BatchNormalization":
             aux_names.update(n["inputs"][3:5])   # running mean, running var
 
@@ -1014,7 +1403,9 @@ def import_model(onnx_file):
                      "Slice": [1, 2, 3, 4], "Gather": [1],
                      "LSTM": [1, 2, 3], "GRU": [1, 2, 3],
                      "RNN": [1, 2, 3],
-                     "Resize": [1, 2, 3]}
+                     "Resize": [1, 2, 3],
+                     "Loop": [0],          # M folds to the static length
+                     "ReduceSum": [1]}     # opset-13 axes input
     _CONST_TAGS = ("_scalar", "_one", "_half", "_eps", "_sqrt2", "_c",
                    "_s2pi")
     # this exporter records its decomposition constants in metadata; for
@@ -1028,14 +1419,16 @@ def import_model(onnx_file):
         meta_consts = set(
             m["metadata"]["mxnet_tpu_consts"].split("\n")) - {""}
     uses = {}
-    for n in g["nodes"]:
+    for n in all_nodes(g["nodes"]):
         shape_slots = _SHAPE_INPUTS.get(n["op_type"], [])
         for i, nm_ in enumerate(n["inputs"]):
             if nm_ not in inits:
                 continue
             if i in shape_slots:
                 kind = "shape"
-            elif n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow") and \
+            elif n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow",
+                                  "Greater", "Less", "GreaterOrEqual",
+                                  "LessOrEqual", "Equal") and \
                     np.asarray(inits[nm_]).size == 1:
                 kind = "scalar"
             else:
@@ -1090,7 +1483,7 @@ def import_model(onnx_file):
     def known_in(nm_):
         return inits.get(nm_) if nm_ in inits else known.get(nm_)
 
-    def fold_shape_chain(n):
+    def fold_shape_chain(n, sof):
         """Constant-propagate the shape-computation ops (Shape / Gather /
         Concat / Cast / arith / Slice / Squeeze / Unsqueeze / Constant)
         when every tensor input is statically known. Returns True when the
@@ -1110,8 +1503,8 @@ def import_model(onnx_file):
                 shp = inits[src].shape
             elif known_in(src) is not None:
                 shp = np.asarray(known_in(src)).shape
-            elif src in sym_of and sym_of[src] is not None:
-                shp = static_shape(sym_of[src])
+            elif src in sof and sof[src] is not None:
+                shp = static_shape(sof[src])
             else:
                 return False
             known[outs[0]] = np.asarray(shp, np.int64)
@@ -1166,20 +1559,32 @@ def import_model(onnx_file):
                  "Sub", "Mul", "Div", "Squeeze", "Unsqueeze", "Slice",
                  "ReduceProd")
     runtime_used = set()               # initializers real symbol nodes read
-    out_sym = None
-    for n in g["nodes"]:
-        if n["op_type"] in _FOLDABLE and fold_shape_chain(n):
+
+    def run_nodes(nodes, sof):
+        """Import a node list into scope `sof` (name -> Symbol). Shared by
+        the top-level graph and control-flow subgraph bodies (If/Scan/
+        Loop), which call back through ctx['run_nodes'] with a scope
+        seeded from the enclosing graph (ONNX outer-scope capture)."""
+        last = None
+        for n in nodes:
+            r = run_one(n, sof)
+            if r is not None:
+                last = r
+        return last
+
+    def run_one(n, sof):
+        if n["op_type"] in _FOLDABLE and fold_shape_chain(n, sof):
             # initializers a folded node consumed are shape-machinery, not
             # model parameters (unless some real node also reads them)
             ctx["folded_inits"].update(nm_ for nm_ in n["inputs"]
                                        if nm_ in inits)
-            continue
+            return None
         # a node whose tensor input is a computed shape VALUE (not just a
         # static attr slot) would need materialization — detect and reject
         # loudly rather than KeyError below
         shape_slots = _SHAPE_INPUTS.get(n["op_type"], [])
         for i, nm_ in enumerate(n["inputs"]):
-            if (nm_ and nm_ not in sym_of and nm_ in known
+            if (nm_ and nm_ not in sof and nm_ in known
                     and i not in shape_slots
                     and n["op_type"] not in ("Add", "Sub", "Mul", "Div",
                                              "Pow", "Reshape")):
@@ -1188,12 +1593,14 @@ def import_model(onnx_file):
                     f"runtime tensor by {n['op_type']}")
         # scalar-constant operands of binary ops fold to python scalars so
         # they import as `sym + 2.0`, not a bogus parameter
-        if n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow"):
+        if n["op_type"] in ("Add", "Sub", "Mul", "Div", "Pow", "Greater",
+                            "Less", "GreaterOrEqual", "LessOrEqual",
+                            "Equal"):
             vals = []
             for nm_ in n["inputs"]:
                 if nm_ in consumed:
                     vals.append(float(np.asarray(inits[nm_]).ravel()[0]))
-                elif nm_ not in sym_of and nm_ in known:
+                elif nm_ not in sof and nm_ in known:
                     # constant-propagated operand (Shape→Gather feeding
                     # position arithmetic): fold scalars, reject tensors
                     v = np.asarray(known[nm_])
@@ -1203,29 +1610,37 @@ def import_model(onnx_file):
                             f"by runtime {n['op_type']}")
                     vals.append(float(v.ravel()[0]))
                 else:
-                    vals.append(sym_of[nm_])
+                    vals.append(sof[nm_])
                     if nm_ in inits:
                         runtime_used.add(nm_)
             opf = {"Add": lambda x, y: x + y, "Sub": lambda x, y: x - y,
                    "Mul": lambda x, y: x * y, "Div": lambda x, y: x / y,
-                   "Pow": lambda x, y: x ** y}[n["op_type"]]
+                   "Pow": lambda x, y: x ** y,
+                   "Greater": lambda x, y: x > y,
+                   "Less": lambda x, y: x < y,
+                   "GreaterOrEqual": lambda x, y: x >= y,
+                   "LessOrEqual": lambda x, y: x <= y,
+                   "Equal": lambda x, y: x == y}[n["op_type"]]
             s = opf(vals[0], vals[1])
         else:
             for i, nm_ in enumerate(n["inputs"]):
                 if nm_ in inits and i not in shape_slots:
                     runtime_used.add(nm_)
-            s = _import_node(n, sym_of, sym_mod, inits, ctx)
+            s = _import_node(n, sof, sym_mod, inits, ctx)
         outs = n["outputs"]
         if len(outs) == 1:
-            sym_of[outs[0]] = s
+            sof[outs[0]] = s
         else:
             if not isinstance(s, (list, tuple)) and hasattr(s, "__getitem__"):
                 s = [s[i] for i in range(len(outs))]
             for i, o in enumerate(outs):
                 if o and i < len(s):
-                    sym_of[o] = s[i]
+                    sof[o] = s[i]
             s = s[0]
-        out_sym = s
+        return s
+
+    ctx["run_nodes"] = run_nodes
+    out_sym = run_nodes(g["nodes"], sym_of)
     if g["outputs"]:
         out_syms = [sym_of[o["name"]] for o in g["outputs"]]
         out_sym = out_syms[0] if len(out_syms) == 1 \

@@ -118,8 +118,18 @@ TENSOR_FLOAT, TENSOR_UINT8, TENSOR_INT8 = 1, 2, 3
 TENSOR_INT32, TENSOR_INT64, TENSOR_BOOL = 6, 7, 9
 TENSOR_FLOAT16, TENSOR_DOUBLE = 10, 11
 
-ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR, ATTR_GRAPH = 1, 2, 3, 4, 5
 ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+class GraphAttr:
+    """Graph-typed attribute payload (If/Loop/Scan bodies): wraps
+    serialized GraphProto bytes so `attribute()` can tell it from a
+    pre-built TensorProto (both arrive as bytes otherwise)."""
+    __slots__ = ("b",)
+
+    def __init__(self, graph_bytes):
+        self.b = graph_bytes
 
 NP2ONNX = {"float32": TENSOR_FLOAT, "float64": TENSOR_DOUBLE,
            "float16": TENSOR_FLOAT16, "uint8": TENSOR_UINT8,
@@ -156,6 +166,8 @@ def attribute(name, value):
         b += w_bytes(4, value.encode()) + w_varint(20, ATTR_STRING)
     elif isinstance(value, bytes):
         b += w_bytes(5, value) + w_varint(20, ATTR_TENSOR)  # pre-built tensor
+    elif isinstance(value, GraphAttr):
+        b += w_bytes(6, value.b) + w_varint(20, ATTR_GRAPH)
     elif isinstance(value, (list, tuple)):
         if all(isinstance(v, int) for v in value):
             for v in value:
@@ -187,6 +199,30 @@ def node_input_names(node_bytes):
     return names
 
 
+def node_all_input_names(node_bytes):
+    """Like node_input_names, but also recurses into graph-typed
+    attributes (If/Loop/Scan bodies) — a value consumed only inside a
+    subgraph is still consumed (ONNX outer-scope capture), so the
+    exporter's initializer reachability filter must see it."""
+    r = Reader(node_bytes)
+    names = []
+    while not r.eof():
+        f, _, v = r.field()
+        if f == 1:
+            names.append(v.decode())
+        elif f == 5:                       # AttributeProto
+            ra = Reader(v)
+            while not ra.eof():
+                fa, _, va = ra.field()
+                if fa == 6:                # g: nested GraphProto
+                    rg = Reader(va)
+                    while not rg.eof():
+                        fg, _, vg = rg.field()
+                        if fg == 1:        # NodeProto
+                            names += node_all_input_names(vg)
+    return names
+
+
 def node(op_type, inputs, outputs, name="", attrs=None):
     """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
     b = b""
@@ -205,11 +241,14 @@ def node(op_type, inputs, outputs, name="", attrs=None):
 def value_info(name, dtype_enum, shape):
     """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
     Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
-    Dimension{dim_value=1}."""
-    dims = b""
-    for d in (shape or ()):            # None shape = unknown rank
-        dims += w_bytes(1, w_varint(1, d))
-    tt = w_varint(1, dtype_enum) + w_bytes(2, dims)
+    Dimension{dim_value=1}. shape None = unknown rank (shape field
+    omitted — an EMPTY TensorShapeProto would declare a scalar)."""
+    tt = w_varint(1, dtype_enum)
+    if shape is not None:
+        dims = b""
+        for d in shape:
+            dims += w_bytes(1, w_varint(1, d))
+        tt += w_bytes(2, dims)
     tp = w_bytes(1, tt)
     return w_string(1, name) + w_bytes(2, tp)
 
@@ -329,6 +368,8 @@ def parse_attribute(data):
             val = v.decode()
         elif f == 5:
             val = parse_tensor(v)[1]
+        elif f == 6:           # graph-typed attr (If/Loop/Scan bodies)
+            val = parse_graph(v)
         elif f == 7:           # floats: packed (stock protobuf) or repeated
             floats += unpack_floats(v) if w == 2 else \
                 [struct.unpack("<f", struct.pack("<I", v))[0]]

@@ -228,3 +228,145 @@ def test_sym_nd_contrib_same_callbacks():
     np.testing.assert_allclose(st.ravel(), outs_nd[0].asnumpy().ravel())
     np.testing.assert_allclose(fi, fin_nd[0].asnumpy())
     np.testing.assert_allclose(fa, fin_nd[1].asnumpy())
+
+
+# -- ONNX round trips -------------------------------------------------------
+# (reference gap closed BEYOND upstream: mx2onnx never exported control
+# flow; here _cond <-> If, _foreach <-> Scan, _while_loop <-> Loop)
+
+from mxnet_tpu.contrib import onnx as onnx_mx  # noqa: E402
+
+
+def test_onnx_if_roundtrip(tmp_path):
+    p = sym.var("p")
+    x = sym.var("x")
+    scale = sym.var("scale")
+    out = sym.contrib.cond(
+        sym.sum(p) > 0.0,
+        lambda: x * scale,
+        lambda: x - 1.0, name="cd")
+    f = str(tmp_path / "if.onnx")
+    params = {"scale": nd.array(np.asarray([3.0], np.float32))}
+    onnx_mx.export_model(out, params, {"p": (1,), "x": (4,)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    assert "scale" in args2          # captured free var survives as param
+    xv = np.arange(4, dtype=np.float32)
+    for pv, want in [(1.0, xv * 3.0), (-1.0, xv - 1.0)]:
+        vals = {"p": np.full((1,), pv, np.float32), "x": xv}
+        _, (y1,) = _bind_run(out, {"p": (1,), "x": (4,), "scale": (1,)},
+                             {**vals, "scale": np.asarray([3.0],
+                                                          np.float32)})
+        ex = sym2.simple_bind(ctx=mx.cpu(), p=(1,), x=(4,))
+        for k, v in {**args2, **aux2}.items():
+            ex.arg_dict[k][:] = v
+        y2 = ex.forward(is_train=False, **{k: nd.array(v)
+                                           for k, v in vals.items()})[0]
+        np.testing.assert_allclose(y1, want, rtol=1e-6)
+        np.testing.assert_allclose(y2.asnumpy(), want, rtol=1e-6)
+
+
+def test_onnx_scan_roundtrip(tmp_path):
+    """foreach -> ONNX Scan -> foreach: scan outs + final state, with a
+    captured weight param."""
+    T, N, H = 4, 2, 3
+    rs = np.random.RandomState(3)
+    data = sym.var("data")
+    s0 = sym.var("s0")
+    w = sym.var("w")
+
+    def body(x_t, s):
+        s2 = sym.tanh(sym.dot(x_t + s, w))
+        return s2 * 2.0, s2
+
+    outs, final = sym.contrib.foreach(body, data, s0, name="fex")
+    grouped = sym.Group([outs, final])
+    wv = rs.randn(H, H).astype(np.float32) * 0.4
+    xv = rs.randn(T, N, H).astype(np.float32)
+    s0v = np.zeros((N, H), np.float32)
+    f = str(tmp_path / "scan.onnx")
+    onnx_mx.export_model(grouped, {"w": nd.array(wv)},
+                         {"data": (T, N, H), "s0": (N, H)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    shapes = {"data": (T, N, H), "s0": (N, H)}
+    vals = {"data": xv, "s0": s0v}
+    _, (y1, f1) = _bind_run(grouped, {**shapes, "w": (H, H)},
+                            {**vals, "w": wv})
+    ex = sym2.simple_bind(ctx=mx.cpu(), **shapes)
+    for k, v in {**args2, **aux2}.items():
+        ex.arg_dict[k][:] = v
+    res = ex.forward(is_train=False, **{k: nd.array(v)
+                                        for k, v in vals.items()})
+    # graph outputs keep the original head order: scan outs, then final
+    y2, f2 = res[0].asnumpy(), res[1].asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(f1, f2, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_loop_roundtrip(tmp_path):
+    """while_loop (final-state form) -> ONNX Loop -> masked foreach:
+    final loop vars must match, including the data-dependent stop."""
+    i0 = sym.var("i0")
+    acc0 = sym.var("acc0")
+
+    def cond_fn(i, acc):
+        return sym.sum(acc) < 10.0
+
+    def func(i, acc):
+        return [], [i + 1.0, acc + i]
+
+    outs, finals = sym.contrib.while_loop(
+        cond_fn, func, [i0, acc0], max_iterations=8, name="wlx")
+    grouped = sym.Group(list(finals))
+    f = str(tmp_path / "loop.onnx")
+    onnx_mx.export_model(grouped, {}, {"i0": (1,), "acc0": (1,)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    shapes = {"i0": (1,), "acc0": (1,)}
+    vals = {"i0": np.ones((1,), np.float32),
+            "acc0": np.zeros((1,), np.float32)}
+    _, (i1, a1) = _bind_run(grouped, shapes, vals)
+    ex = sym2.simple_bind(ctx=mx.cpu(), **shapes)
+    for k, v in {**args2, **aux2}.items():
+        ex.arg_dict[k][:] = v
+    res = ex.forward(is_train=False, **{k: nd.array(v)
+                                        for k, v in vals.items()})
+    np.testing.assert_allclose(res[0].asnumpy(), i1, rtol=1e-6)  # 5.0
+    np.testing.assert_allclose(res[1].asnumpy(), a1, rtol=1e-6)  # 10.0
+    np.testing.assert_allclose(a1, [10.0])
+
+
+def test_onnx_scan_unused_final_state(tmp_path):
+    """A discarded final state must still occupy its ONNX Scan output
+    slot — dropping it would shift the scan output into the final-state
+    position (review finding)."""
+    T, N = 3, 2
+    data = sym.var("data")
+    s0 = sym.var("s0")
+    outs, _unused = sym.contrib.foreach(
+        lambda x, s: (x + s, x + s), data, s0, name="feu")
+    f = str(tmp_path / "scan_unused.onnx")
+    onnx_mx.export_model(outs, {}, {"data": (T, N), "s0": (N,)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    assert not args2, set(args2)      # no phantom params
+    xv = np.asarray([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    shapes = {"data": (T, N), "s0": (N,)}
+    vals = {"data": xv, "s0": np.zeros((N,), np.float32)}
+    _, y1 = _bind_run(outs, shapes, vals)
+    ex = sym2.simple_bind(ctx=mx.cpu(), **shapes)
+    res = ex.forward(is_train=False, **{k: nd.array(v)
+                                        for k, v in vals.items()})
+    np.testing.assert_allclose(res[0].asnumpy(), y1[0], rtol=1e-6)
+
+
+def test_onnx_reducesum_axes_not_param(tmp_path):
+    """ReduceSum's opset-13 axes initializer is shape machinery, not a
+    model parameter (review finding)."""
+    x = sym.var("x")
+    out = sym.sum(x, axis=1)
+    f = str(tmp_path / "rsum.onnx")
+    onnx_mx.export_model(out, {}, {"x": (2, 3)}, f)
+    sym2, args2, aux2 = onnx_mx.import_model(f)
+    assert not args2, set(args2)
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ex = sym2.simple_bind(ctx=mx.cpu(), x=(2, 3))
+    y = ex.forward(is_train=False, x=nd.array(xv))[0].asnumpy()
+    np.testing.assert_allclose(y, xv.sum(1))
