@@ -6,7 +6,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
+           "CTCLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -189,4 +190,36 @@ class CosineEmbeddingLoss(Loss):
             F.norm(input1, axis=-1) * F.norm(input2, axis=-1) + 1e-12)
         label = label.reshape(shape=cos.shape)
         loss = F.where(label == 1, 1.0 - cos, F.relu(cos - self._margin))
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification loss (reference:
+    `gluon/loss.py` CTCLoss over warp-ctc; here the op is a log-space
+    alpha recursion scanned on-device — see ops.misc_ops.ctc_loss).
+
+    layout: 'NTC' (gluon default) or 'TNC'; label_layout 'NT'.
+    pred: unnormalized activations (softmax applied inside, matching the
+    reference). label classes are 1..C-1 with blank=0 ('first').
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        assert layout in ("NTC", "TNC")
+        assert label_layout in ("NT", "TN")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, 0, 1)
+        if self._batch_axis == 1:
+            label = F.swapaxes(label, 0, 1)
+        loss = F.ctc_loss(pred, label, pred_lengths, label_lengths,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="first")
         return _apply_weighting(F, loss, self._weight, sample_weight)

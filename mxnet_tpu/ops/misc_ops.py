@@ -450,3 +450,90 @@ alias("Flatten", "flatten")
 alias("Reshape", "reshape")
 alias("SwapAxis", "swapaxes")
 alias("choose_element_0index", "pick")
+
+
+@register("ctc_loss")
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist Temporal Classification loss (reference:
+    `src/operator/nn/ctc_loss-inl.h`, the warp-ctc integration).
+
+    TPU-native: the standard log-space alpha recursion, vectorized over
+    the batch and `lax.scan`ned over time — one fused XLA While instead of
+    warp-ctc's hand-written CUDA kernels; the backward is jax autodiff
+    through the scan (no hand-derived beta pass needed).
+
+    data: (T, N, C) unnormalized activations (softmax applied here, like
+    the reference). label: (N, L) class indices. blank_label 'first' maps
+    blank to 0 with real labels 1..C-1 (and padding value 0 when
+    use_label_lengths is False); 'last' maps blank to C-1 (padding -1).
+    Returns (N,) negative log-likelihoods."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    label = jnp.asarray(label).astype(jnp.int32)
+    L = label.shape[1]
+    blank = 0 if blank_label == "first" else C - 1
+    pad_val = 0 if blank_label == "first" else -1
+
+    if use_label_lengths and label_lengths is not None:
+        llen = jnp.asarray(label_lengths).astype(jnp.int32)
+    else:
+        llen = jnp.sum((label != pad_val).astype(jnp.int32), axis=1)
+    if use_data_lengths and data_lengths is not None:
+        dlen = jnp.asarray(data_lengths).astype(jnp.int32)
+    else:
+        dlen = jnp.full((N,), T, jnp.int32)
+
+    # extended sequence z = [blank, l1, blank, l2, ..., blank]: (N, S)
+    S = 2 * L + 1
+    z = jnp.full((N, S), blank, jnp.int32)
+    # padding positions point at blank so their emissions are harmless;
+    # they sit beyond the final index 2*llen and never enter the loss
+    safe_label = jnp.where(
+        jnp.arange(L)[None, :] < llen[:, None], label, blank)
+    z = z.at[:, 1::2].set(safe_label)
+    # alpha[t, s] may come from s-2 only when z[s] is a real label that
+    # differs from z[s-2] (the classic repeated-label constraint)
+    z_m2 = jnp.concatenate([jnp.full((N, 2), -1, jnp.int32), z[:, :-2]], 1)
+    allow2 = (z != blank) & (z != z_m2)                     # (N, S)
+
+    NEG = jnp.float32(-1e30)          # effective -inf, nan-safe in where
+    rows = jnp.arange(N)[:, None]
+
+    emit0 = logp[0][rows, z]                                # (N, S)
+    alpha0 = jnp.where(jnp.arange(S)[None, :] < 2, emit0, NEG)
+
+    def step(alpha, logp_t):
+        emit = logp_t[rows, z]
+        a1 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], 1)
+        a2 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], 1)
+        a2 = jnp.where(allow2, a2, NEG)
+        m = jnp.maximum(alpha, jnp.maximum(a1, a2))
+        new = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m)
+                          + jnp.exp(a2 - m)) + emit
+        return new, None
+
+    def masked_step(carry, inp):
+        t, logp_t = inp
+        alpha = carry
+        new, _ = step(alpha, logp_t)
+        keep = (t < dlen)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    alphaT, _ = jax.lax.scan(
+        masked_step, alpha0, (jnp.arange(1, T), logp[1:]))
+
+    end = 2 * llen                                          # (N,)
+    aS = jnp.take_along_axis(alphaT, end[:, None], axis=1)[:, 0]
+    aS1 = jnp.take_along_axis(
+        alphaT, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    aS1 = jnp.where(llen > 0, aS1, NEG)   # empty label: only the blank path
+    m = jnp.maximum(aS, aS1)
+    ll = m + jnp.log(jnp.exp(aS - m) + jnp.exp(aS1 - m))
+    return -ll
+
+
+alias("CTCLoss", "ctc_loss")
+alias("_contrib_ctc_loss", "ctc_loss")
+alias("_contrib_CTCLoss", "ctc_loss")

@@ -1,0 +1,109 @@
+"""CTC loss vs the torch oracle (reference: src/operator/nn/ctc_loss-inl.h
+via warp-ctc; torch.nn.functional.ctc_loss implements the same math and is
+baked into this image as a CPU package)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+
+
+def _torch_ctc(act, labels, dlens, llens, blank=0):
+    import torch
+    import torch.nn.functional as tF
+    lp = tF.log_softmax(torch.tensor(act), dim=-1)
+    return tF.ctc_loss(lp, torch.tensor(labels),
+                       torch.tensor(dlens), torch.tensor(llens),
+                       blank=blank, reduction="none",
+                       zero_infinity=False).numpy()
+
+
+def test_ctc_loss_matches_torch():
+    T, N, C, L = 9, 4, 6, 3
+    rs = np.random.RandomState(0)
+    act = rs.randn(T, N, C).astype(np.float32)
+    labels = rs.randint(1, C, (N, L)).astype(np.int32)
+    dlens = np.array([9, 7, 9, 5], np.int64)
+    llens = np.array([3, 2, 1, 3], np.int64)
+    want = _torch_ctc(act, labels, dlens, llens)
+    got = nd.ctc_loss(nd.array(act), nd.array(labels),
+                      nd.array(dlens.astype(np.int32)),
+                      nd.array(llens.astype(np.int32)),
+                      use_data_lengths=True,
+                      use_label_lengths=True).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_full_lengths_and_padding_derived():
+    """Without explicit lengths, label lengths derive from 0-padding
+    (blank_label='first' semantics)."""
+    T, N, C, L = 7, 3, 5, 4
+    rs = np.random.RandomState(1)
+    act = rs.randn(T, N, C).astype(np.float32)
+    labels = np.zeros((N, L), np.int32)
+    llens = np.array([2, 4, 1])
+    for i, ln in enumerate(llens):
+        labels[i, :ln] = rs.randint(1, C, ln)
+    want = _torch_ctc(act, labels, np.full(N, T, np.int64),
+                      llens.astype(np.int64))
+    got = nd.ctc_loss(nd.array(act), nd.array(labels)).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_blank_last():
+    T, N, C, L = 6, 2, 4, 2
+    rs = np.random.RandomState(2)
+    act = rs.randn(T, N, C).astype(np.float32)
+    labels = rs.randint(0, C - 1, (N, L)).astype(np.int32)
+    want = _torch_ctc(act, labels, np.full(N, T, np.int64),
+                      np.full(N, L, np.int64), blank=C - 1)
+    got = nd.ctc_loss(nd.array(act), nd.array(labels),
+                      use_label_lengths=True,
+                      label_lengths=nd.array(np.full(N, L, np.int32)),
+                      blank_label="last").asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_gradient_matches_torch():
+    import torch
+    import torch.nn.functional as tF
+    T, N, C, L = 8, 2, 5, 3
+    rs = np.random.RandomState(3)
+    act = rs.randn(T, N, C).astype(np.float32)
+    labels = rs.randint(1, C, (N, L)).astype(np.int32)
+
+    ta = torch.tensor(act, requires_grad=True)
+    lp = tF.log_softmax(ta, dim=-1)
+    tl = tF.ctc_loss(lp, torch.tensor(labels),
+                     torch.full((N,), T, dtype=torch.long),
+                     torch.full((N,), L, dtype=torch.long),
+                     blank=0, reduction="sum")
+    tl.backward()
+    want = ta.grad.numpy()
+
+    x = nd.array(act)
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.ctc_loss(x, nd.array(labels),
+                           use_label_lengths=True,
+                           label_lengths=nd.array(
+                               np.full(N, L, np.int32))).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_gluon_ctc_loss_ntc():
+    """gluon CTCLoss default NTC layout matches the op on TNC data."""
+    T, N, C, L = 6, 3, 5, 2
+    rs = np.random.RandomState(4)
+    act = rs.randn(N, T, C).astype(np.float32)      # NTC
+    labels = rs.randint(1, C, (N, L)).astype(np.float32)
+    lfn = gluon.loss.CTCLoss()
+    got = lfn(nd.array(act), nd.array(labels)).asnumpy()
+    want = nd.ctc_loss(nd.array(act.transpose(1, 0, 2)),
+                       nd.array(labels.astype(np.int32)),
+                       use_label_lengths=True,
+                       label_lengths=nd.array(
+                           np.full(N, L, np.int32))).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
