@@ -469,6 +469,11 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     blank to 0 with real labels 1..C-1 (and padding value 0 when
     use_label_lengths is False); 'last' maps blank to C-1 (padding -1).
     Returns (N,) negative log-likelihoods."""
+    # optional length tensors may arrive as NDArray KWARGS (the front-end
+    # only unwraps positional args) — duck-unwrap before touching jnp
+    data_lengths = getattr(data_lengths, "_data", data_lengths)
+    label_lengths = getattr(label_lengths, "_data", label_lengths)
+    label = getattr(label, "_data", label)
     T, N, C = data.shape
     logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
     label = jnp.asarray(label).astype(jnp.int32)

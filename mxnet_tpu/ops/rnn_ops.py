@@ -193,6 +193,9 @@ def rnn(data, parameters, state, state_cell=None, sequence_length=None,
     if use_sequence_length and sequence_length is None \
             and mode != "lstm" and state_cell is not None:
         sequence_length, state_cell = state_cell, None
+    # a lengths tensor passed as an NDArray KWARG is not unwrapped by the
+    # front-end (only positional args are) — duck-unwrap
+    sequence_length = getattr(sequence_length, "_data", sequence_length)
     if layout == "NTC":
         data = jnp.swapaxes(data, 0, 1)
     T, N, I = data.shape

@@ -37,6 +37,8 @@ CASES = [
      ["--epochs", "10", "--series", "8", "--samples", "5"], "CRPS"),
     ("module_api/train_mnist_module.py",
      ["--epochs", "2"], "final validation"),
+    ("ocr/train_crnn.py",
+     ["--steps", "12", "--batch", "8"], "held-out exact-match"),
 ]
 
 

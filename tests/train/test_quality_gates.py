@@ -255,3 +255,38 @@ def test_nmt_reversal_bleu_gate():
         bleu.update([r], [h[1:SL + 1]])   # strip the leading BOS
     score = bleu.get()[1]
     assert score >= 0.95, f"reversal BLEU {score:.3f} < 0.95 gate"
+
+
+def test_crnn_ctc_glyph_gate():
+    """Falsifiable CTC gate (the SyntheticGratings pattern for the OCR
+    stack): the deterministic rendered-glyph task is fully solvable, so
+    CRNN + CTC must reach >= 90% held-out exact-match in 400 steps. A
+    broken alpha recursion, a varlen-BiLSTM regression, or a decode bug
+    all fail it; a loss-trend assertion would not notice."""
+    from mxnet_tpu.models.crnn import (CRNN, ctc_greedy_decode,
+                                      make_glyph_batch)
+
+    mx.random.seed(0)
+    model = CRNN(num_classes=6, img_height=8)
+    model.initialize()
+    parallel.make_mesh(dp=1, devices=parallel.local_mesh_devices(1))
+    try:
+        def loss_fn(logits, label, label_len):
+            return nd.ctc_loss(logits, label, use_label_lengths=True,
+                               label_lengths=label_len).mean()
+
+        tr = parallel.ShardedTrainer(model, loss_fn, "adam",
+                                     {"learning_rate": 3e-3})
+        for step in range(400):
+            b = make_glyph_batch(32, seed=step)
+            tr.step([nd.array(b["image"])],
+                    [nd.array(b["label"]), nd.array(b["label_len"])])
+        tr.sync_to_block()
+        hb = make_glyph_batch(64, seed=10_000_000)
+        pred = ctc_greedy_decode(model(nd.array(hb["image"])).asnumpy())
+        want = [list(hb["label"][n, :hb["label_len"][n]])
+                for n in range(64)]
+        acc = np.mean([p == w for p, w in zip(pred, want)])
+        assert acc >= 0.90, f"held-out exact-match {acc:.3f} < 0.90 gate"
+    finally:
+        parallel.set_mesh(None)
