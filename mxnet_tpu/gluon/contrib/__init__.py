@@ -1,5 +1,6 @@
 """gluon.contrib (reference: `python/mxnet/gluon/contrib/`)."""
 from . import nn
 from . import rnn
+from . import estimator
 
-__all__ = ["nn", "rnn"]
+__all__ = ["nn", "rnn", "estimator"]

@@ -1,0 +1,254 @@
+"""Estimator fit-loop abstraction (reference:
+`python/mxnet/gluon/contrib/estimator/estimator.py` + event_handler.py).
+
+The reference's Estimator wraps net/loss/metrics/trainer into `fit()` with
+composable EventHandlers firing at train/epoch/batch boundaries. Same
+surface here; the step itself stays the eager autograd path (hybridize the
+net for a jitted forward) so arbitrary handler logic can observe it.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+
+    def train_begin(self, est):
+        est.max_epoch = self.max_epoch
+        est.max_batch = self.max_batch
+
+    def batch_end(self, est):
+        if self.max_batch is not None and est.num_batch >= self.max_batch:
+            est.stop_training = True
+
+    def epoch_end(self, est):
+        if self.max_epoch is not None and est.num_epoch >= self.max_epoch:
+            est.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics each epoch, update per batch."""
+
+    def __init__(self, metrics):
+        self.metrics = list(metrics)
+
+    def epoch_begin(self, est):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, est):
+        from ... import metric as _metric
+        for m in self.metrics:
+            if isinstance(m, _metric.Loss):
+                # loss metrics average the loss VALUE (reference
+                # MetricHandler special-cases these)
+                m.update(None, [est.last_loss])
+            else:
+                m.update(est.last_labels, est.last_outputs)
+
+
+class ValidationHandler(EpochEnd):
+    """Run evaluation on val_data every `epoch_period` epochs."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+
+    def epoch_end(self, est):
+        if est.num_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Epoch summaries through the estimator's logger (print-based; the
+    reference wires `logging`)."""
+
+    def __init__(self, log_fn=print):
+        self.log = log_fn
+        self._t0 = None
+
+    def train_begin(self, est):
+        self._t0 = time.time()
+        self.log(f"Training begin: epochs={est.max_epoch}")
+
+    def epoch_end(self, est):
+        vals = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                         for m in est.train_metrics)
+        self.log(f"[epoch {est.num_epoch}] {vals} "
+                 f"({time.time() - self._t0:.1f}s elapsed)")
+
+    def train_end(self, est):
+        self.log(f"Training end: {est.num_epoch} epochs, "
+                 f"{est.num_batch} batches, "
+                 f"{time.time() - self._t0:.1f}s")
+
+
+class CheckpointHandler(EpochEnd):
+    """Save params every `epoch_period` epochs (reference
+    CheckpointHandler; `save_best` keeps the best by `monitor`)."""
+
+    def __init__(self, model_dir, model_prefix="model", epoch_period=1,
+                 monitor=None, mode="min", save_best=False):
+        import os
+        self.dir = model_dir
+        os.makedirs(model_dir, exist_ok=True)
+        self.prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.monitor = monitor
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.save_best = save_best
+        self.best = None
+
+    def epoch_end(self, est):
+        import os
+        if est.num_epoch % self.epoch_period:
+            return
+        path = os.path.join(self.dir,
+                            f"{self.prefix}-epoch{est.num_epoch}.params")
+        est.net.save_parameters(path)
+        if self.save_best and self.monitor is not None:
+            val = self.sign * self.monitor.get()[1]
+            if self.best is None or val < self.best:
+                self.best = val
+                est.net.save_parameters(
+                    os.path.join(self.dir, f"{self.prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when `monitor` hasn't improved for `patience` epochs."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.bad = 0
+
+    def epoch_end(self, est):
+        val = self.sign * self.monitor.get()[1]
+        if self.best is None or val < self.best - self.min_delta:
+            self.best = val
+            self.bad = 0
+        else:
+            self.bad += 1
+            if self.bad >= self.patience:
+                est.stop_training = True
+
+
+class Estimator:
+    """fit() driver (reference Estimator). net: gluon Block; loss: gluon
+    Loss; train_metrics: list of mx.metric.EvalMetric; trainer: gluon
+    Trainer (built from `optimizer`/`optimizer_params` when omitted)."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 optimizer="adam", optimizer_params=None):
+        from ... import metric as _metric
+        from .. import Trainer
+        self.net = net
+        self.loss = loss
+        self.train_metrics = list(train_metrics or [_metric.Loss("loss")])
+        self.trainer = trainer or Trainer(
+            net.collect_params(), optimizer,
+            optimizer_params or {"learning_rate": 1e-3})
+        self.stop_training = False
+        self.num_epoch = 0
+        self.num_batch = 0
+        self.max_epoch = None
+        self.max_batch = None
+        self.last_outputs = []
+        self.last_labels = []
+
+    def evaluate(self, val_data, val_metrics):
+        for m in val_metrics:
+            m.reset()
+        for data, label in val_data:
+            out = self.net(data)
+            for m in val_metrics:
+                m.update([label], [out])
+        return val_metrics
+
+    def _handlers(self, event_handlers, epochs):
+        hs = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in hs):
+            hs.insert(0, StoppingHandler(max_epoch=epochs))
+        if not any(isinstance(h, MetricHandler) for h in hs):
+            hs.insert(1, MetricHandler(self.train_metrics))
+        return hs
+
+    def fit(self, train_data, epochs=1, event_handlers=None):
+        from .. import utils as _gutils
+        from ... import autograd
+
+        handlers = self._handlers(event_handlers, epochs)
+
+        def fire(kind):
+            for h in handlers:
+                getattr(h, kind)(self) if hasattr(h, kind) else None
+
+        self.stop_training = False
+        fire("train_begin")
+        while not self.stop_training:
+            fire("epoch_begin")
+            for data, label in train_data:
+                if self.stop_training:
+                    break
+                fire("batch_begin")
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                self.last_outputs = [out]
+                self.last_labels = [label]
+                self.last_loss = loss
+                self.num_batch += 1
+                fire("batch_end")
+            self.num_epoch += 1
+            fire("epoch_end")
+            if self.max_epoch is not None \
+                    and self.num_epoch >= self.max_epoch:
+                self.stop_training = True
+        fire("train_end")
+        return self

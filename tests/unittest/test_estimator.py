@@ -1,0 +1,83 @@
+"""gluon.contrib.estimator (reference:
+`python/mxnet/gluon/contrib/estimator/`): fit loop + event handlers."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, metric
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler,
+    StoppingHandler)
+
+
+def _toy_data(n=96, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 3).astype(np.float32)
+    y = (X @ w).argmax(1).astype(np.float32)
+    return [(nd.array(X[i:i + 32]), nd.array(y[i:i + 32]))
+            for i in range(0, n, 32)]
+
+
+def _net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(3, in_units=16))
+    net.initialize()
+    return net
+
+
+def test_estimator_fit_improves_accuracy():
+    data = _toy_data()
+    net = _net()
+    acc = metric.Accuracy()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[metric.Loss("loss"), acc],
+                    optimizer="adam",
+                    optimizer_params={"learning_rate": 0.01})
+    logs = []
+    est.fit(data, epochs=8,
+            event_handlers=[LoggingHandler(log_fn=logs.append)])
+    assert est.num_epoch == 8
+    assert est.num_batch == 8 * len(data)
+    assert acc.get()[1] > 0.8, acc.get()
+    assert any("epoch 8" in ln for ln in logs)
+
+
+def test_estimator_max_batch_stops_early():
+    data = _toy_data()
+    est = Estimator(_net(), gloss.SoftmaxCrossEntropyLoss())
+    est.fit(data, epochs=100,
+            event_handlers=[StoppingHandler(max_epoch=100, max_batch=5)])
+    assert est.num_batch == 5
+
+
+def test_estimator_early_stopping_and_checkpoint(tmp_path):
+    data = _toy_data()
+    net = _net()
+    lm = metric.Loss("loss")
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[lm],
+                    optimizer_params={"learning_rate": 0.0})  # frozen
+    est.fit(data, epochs=50, event_handlers=[
+        EarlyStoppingHandler(lm, patience=2),
+        CheckpointHandler(str(tmp_path), monitor=lm, save_best=True),
+    ])
+    # lr=0: loss never improves after the first epoch -> stops at patience
+    assert est.num_epoch <= 4
+    import os
+    assert os.path.exists(str(tmp_path / "model-epoch1.params"))
+    assert os.path.exists(str(tmp_path / "model-best.params"))
+
+
+def test_estimator_evaluate():
+    data = _toy_data(seed=3)
+    net = _net()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    optimizer_params={"learning_rate": 0.01})
+    est.fit(data, epochs=8)
+    va = metric.Accuracy()
+    est.evaluate(_toy_data(seed=3), [va])
+    # the point is evaluate() wiring, not convergence quality
+    assert va.get()[1] > 0.7
