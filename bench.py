@@ -109,8 +109,16 @@ def run_bench(on_tpu):
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import nd, parallel
+    from mxnet_tpu import nd, parallel, telemetry
     from mxnet_tpu.models import bert as bert_mod
+
+    # telemetry rides along (compile accounting happens during warmup, so
+    # enable BEFORE the first step): the JSON line gets compile_time_s and
+    # recompile_count so compile cost is separable from steady-state tok/s.
+    # Trade-off: with telemetry on, ShardedTrainer.step fences each step
+    # (block_until_ready) — a no-op on this tunnel platform, but on a
+    # backend where it blocks it trims host/device overlap slightly
+    telemetry.enable()
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
@@ -207,6 +215,10 @@ def run_bench(on_tpu):
         "value": round(per_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 4),
+        # steady state should show recompile_count == 0: every recompile in
+        # the timed loop is shape churn eating the reported throughput
+        "compile_time_s": round(telemetry.histogram("compile_seconds").sum, 3),
+        "recompile_count": int(telemetry.counter("recompile_total").value),
     }
     if mfu is not None:
         # 6*N*tokens model flops, attention quadratic term EXCLUDED

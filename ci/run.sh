@@ -25,10 +25,24 @@ from mxnet_tpu.ops import OPS
 assert len(OPS) > 200, len(OPS)
 print('import surface OK:', len(OPS), 'ops')
 "
+    # telemetry must be disabled by default and its disabled fast path must
+    # not count, allocate events, or touch the registry lock per increment
+    JAX_PLATFORMS=cpu python -c "
+from mxnet_tpu import telemetry
+assert not telemetry.enabled(), 'telemetry must default to off'
+c = telemetry.counter('ci_sanity_probe_total')
+h = telemetry.histogram('ci_sanity_probe_seconds')
+c.inc(); h.observe(1.0); telemetry.event('step', dur_s=1.0)
+assert c.value == 0 and h.count == 0, 'disabled metric still counted'
+assert telemetry.events() == [], 'disabled fast path allocated events'
+print('telemetry disabled fast path OK')
+"
 }
 
 unittest_stage() {
     echo "== unittest =="
+    # covers tests/unittest/test_telemetry.py (registry semantics,
+    # recompile-cause events, exporters) along with everything else
     python -m pytest tests/unittest -q
 }
 

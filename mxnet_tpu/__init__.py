@@ -52,6 +52,7 @@ _LAZY = {
     "runtime": ".runtime",
     "operator": ".operator",
     "profiler": ".profiler",
+    "telemetry": ".telemetry",
     "parallel": ".parallel",
     "test_utils": ".test_utils",
     "lr_scheduler": ".lr_scheduler",

@@ -139,3 +139,19 @@ register_option(
     "debug", False,
     "Debug mode: op-by-op execution (no jit) + NaN checks. Usually set via "
     "mxnet_tpu.debug() rather than this knob.")
+register_option(
+    "telemetry", False,
+    "Enable the mx.telemetry metrics registry and event stream at import. "
+    "Off by default: every instrumentation site then reduces to a single "
+    "module-bool check (the guarded fast path asserted by ci/run.sh "
+    "sanity). mx.telemetry.enable()/disable() toggle at runtime.")
+register_option(
+    "telemetry_jsonl_path", "",
+    "When set, telemetry events are appended to this JSONL file every "
+    "telemetry_flush_interval seconds and a final metrics snapshot line is "
+    "written at process exit. Empty disables auto-flush; "
+    "mx.telemetry.dump_jsonl(path) still works.")
+register_option(
+    "telemetry_flush_interval", 5.0,
+    "Seconds between auto-flushes of buffered telemetry events to "
+    "telemetry_jsonl_path. Checked on event emission (no flush thread).")

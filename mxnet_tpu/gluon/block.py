@@ -17,6 +17,7 @@ semantics survive jit.
 from __future__ import annotations
 
 import contextlib
+import time
 
 import jax
 import jax.numpy as jnp
@@ -25,11 +26,25 @@ import numpy as np
 from .. import _engine
 from .. import ndarray as nd_mod
 from .. import random as _random
+from .. import telemetry as _telemetry
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 __all__ = ["Block", "HybridBlock", "Sequential", "HybridSequential",
            "functional_call"]
+
+_M_CACHE_HITS = _telemetry.counter(
+    "hybrid_cache_hits_total", "jit-cache hits across all HybridBlocks")
+_M_CACHE_MISSES = _telemetry.counter(
+    "hybrid_cache_misses_total", "jit-cache misses (each one is a trace+compile)")
+_M_COMPILES = _telemetry.counter(
+    "compile_total", "XLA compilations (HybridBlock cache + sharded step cache)")
+_M_RECOMPILES = _telemetry.counter(
+    "recompile_total", "compilations after the first for the same block/step "
+    "(shape/dtype churn — the silent throughput killer)")
+_M_COMPILE_SECONDS = _telemetry.histogram(
+    "compile_seconds", "wall-clock trace+compile time (includes the first "
+    "execution of the jitted program, which XLA compiles lazily)")
 
 
 class Block:
@@ -212,6 +227,7 @@ class HybridBlock(Block):
         super().__init__(prefix, params)
         self._active = False
         self._cache = {}
+        self._tele_sig = None     # last compiled input signature (telemetry)
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False, **kwargs):
         self._active = active
@@ -280,7 +296,9 @@ class HybridBlock(Block):
         key = (tuple((a.shape, str(a.dtype)) for a in args), train,
                len(grad_params), len(aux_params))
         entry = self._cache.get(key)
-        if entry is None:
+        is_miss = entry is None
+        t0 = time.perf_counter() if (is_miss and _telemetry._enabled) else None
+        if is_miss:
             entry = self._build_cached(args, grad_params, aux_params, train)
             self._cache[key] = entry
         jitted, out_treedef = entry
@@ -290,7 +308,16 @@ class HybridBlock(Block):
         in_data = [a._data for a in args]
         rng = _random.next_key()
 
+        # the first call of a fresh entry triggers XLA's lazy compile, so
+        # the compile-time measurement must bracket it
         out_flat, new_aux = jitted(gp_data, aux_data, rng, *in_data)
+        if _telemetry._enabled:
+            if t0 is not None:
+                self._tele_record_compile(args, train,
+                                          time.perf_counter() - t0,
+                                          len(grad_params), len(aux_params))
+            elif not is_miss:
+                _M_CACHE_HITS.inc()
         for (_, p), v in zip(aux_params, new_aux):
             p.data()._data = v
 
@@ -308,6 +335,26 @@ class HybridBlock(Block):
             _engine.record_op(record_fn, tuple(gp_data) + tuple(in_data),
                               parents, outs)
         return jax.tree.unflatten(out_treedef, outs)
+
+    def _tele_record_compile(self, args, train, dt, n_grad, n_aux):
+        """One jit-cache miss: count it, time it, and diagnose WHY by
+        diffing the input signature against the previous compile's. n_grad
+        and n_aux are part of the cache key (freezing a layer recompiles),
+        so they belong in the signature — without them that recompile would
+        be misdiagnosed as 'signature unchanged'."""
+        _M_CACHE_MISSES.inc()
+        _M_COMPILES.inc()
+        _M_COMPILE_SECONDS.observe(dt)
+        sig = _telemetry.signature(args, train=train,
+                                   n_grad=n_grad, n_aux=n_aux)
+        causes, changed = _telemetry.diff_signature(self._tele_sig, sig)
+        kind = "compile" if self._tele_sig is None else "recompile"
+        if self._tele_sig is not None:
+            _M_RECOMPILES.inc()
+        self._tele_sig = sig
+        _telemetry.event(kind, block=type(self).__name__,
+                         compile_time_s=round(dt, 6), causes=causes,
+                         changed=changed, signature=sig)
 
     def _build_cached(self, args, grad_params, aux_params, train):
         """Trace self.forward into one jitted function (the CachedOp build)."""

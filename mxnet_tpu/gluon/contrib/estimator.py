@@ -13,7 +13,7 @@ import time
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+           "EarlyStoppingHandler", "TelemetryHandler"]
 
 
 class TrainBegin:
@@ -115,6 +115,11 @@ class LoggingHandler(TrainBegin, EpochEnd, TrainEnd):
     def epoch_end(self, est):
         vals = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
                          for m in est.train_metrics)
+        if getattr(est, "samples_per_sec", None):
+            # published by TelemetryHandler when telemetry is on
+            vals += f", {est.samples_per_sec:.1f} samples/s"
+            if getattr(est, "tokens_per_sec", None):
+                vals += f", {est.tokens_per_sec:.0f} tokens/s"
         self.log(f"[epoch {est.num_epoch}] {vals} "
                  f"({time.time() - self._t0:.1f}s elapsed)")
 
@@ -122,6 +127,75 @@ class LoggingHandler(TrainBegin, EpochEnd, TrainEnd):
         self.log(f"Training end: {est.num_epoch} epochs, "
                  f"{est.num_batch} batches, "
                  f"{time.time() - self._t0:.1f}s")
+
+
+class TelemetryHandler(TrainBegin, BatchBegin, BatchEnd, TrainEnd):
+    """Wire the fit loop into mx.telemetry: per-batch step events + the
+    step-latency histogram, and samples/s / tokens/s gauges (also published
+    on the estimator as `samples_per_sec` / `tokens_per_sec`, which
+    LoggingHandler picks up).
+
+    tokens_per_sample: multiply samples/s into tokens/s for sequence
+    workloads (e.g. the padded sequence length). `enable=True` (default)
+    turns telemetry collection on for the run; pass False to only observe
+    when something else enabled it."""
+
+    def __init__(self, tokens_per_sample=None, enable=True):
+        from ... import telemetry
+        self.telemetry = telemetry
+        self.tokens_per_sample = tokens_per_sample
+        self.enable = enable
+        self._t0 = None
+        # full fwd+bwd+update batch latency; the optimizer-apply slice of it
+        # lands in trainer_step_seconds via Trainer.step
+        self._m_step = telemetry.histogram(
+            "fit_batch_seconds", "full fit-loop batch wall time (batches "
+            "that triggered a jit compile are excluded — they land in "
+            "compile_seconds)")
+        self._m_sps = telemetry.gauge(
+            "samples_per_sec", "training throughput from the last batch")
+        self._m_tps = telemetry.gauge(
+            "tokens_per_sec", "samples/s x tokens_per_sample")
+        self._m_compiles = telemetry.counter("compile_total")
+        self._c0 = 0.0
+
+    def train_begin(self, est):
+        if self.enable:
+            self.telemetry.enable()
+
+    def batch_begin(self, est):
+        self._t0 = time.perf_counter()
+        self._c0 = self._m_compiles.value
+
+    def batch_end(self, est):
+        if self._t0 is None or not self.telemetry.enabled():
+            return
+        if self._m_compiles.value > self._c0:
+            # this batch paid a trace+compile (first batch, or shape
+            # churn): a seconds-long dur_s here would poison the step
+            # p50/p99 and the throughput gauges
+            return
+        dt = time.perf_counter() - self._t0
+        self._m_step.observe(dt)
+        self.telemetry.event("step", dur_s=round(dt, 6), step=est.num_batch)
+        n = est.last_outputs[0].shape[0] if est.last_outputs else 0
+        if dt > 0 and n:
+            est.samples_per_sec = n / dt
+            self._m_sps.set(est.samples_per_sec)
+            if self.tokens_per_sample:
+                est.tokens_per_sec = est.samples_per_sec * self.tokens_per_sample
+                self._m_tps.set(est.tokens_per_sec)
+
+    def train_end(self, est):
+        path = self.telemetry.config.get("telemetry_jsonl_path")
+        if path:
+            try:
+                self.telemetry.flush(path)
+            except OSError as e:
+                # same policy as autoflush: a bad sink must not fail fit()
+                # or starve the remaining train_end handlers
+                import warnings
+                warnings.warn(f"telemetry flush to {path!r} failed: {e}")
 
 
 class CheckpointHandler(EpochEnd):

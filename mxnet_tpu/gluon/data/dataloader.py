@@ -18,13 +18,23 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ... import telemetry as _telemetry
 from ...ndarray import ndarray as _nd
 from ...ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+_M_WAIT = _telemetry.histogram(
+    "dataloader_wait_seconds", "time the training loop spent blocked "
+    "waiting for the next batch — compare against trainer_step_seconds to "
+    "tell input-bound from compute-bound steps")
+_M_DEPTH = _telemetry.gauge(
+    "dataloader_prefetch_depth", "batches buffered ahead of the consumer "
+    "(0 while the consumer is starved = input-bound)")
 
 __all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn",
            "in_worker"]
@@ -134,6 +144,23 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        if not _telemetry._enabled:
+            yield from self._iter_impl()
+            return
+        # batch-wait accounting: the gap between the consumer asking for a
+        # batch and one being ready is exactly the input stall the train
+        # step experiences
+        it = self._iter_impl()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _M_WAIT.observe(time.perf_counter() - t0)
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
@@ -146,10 +173,27 @@ class DataLoader:
             futures = queue.Queue()
             batches = iter(self._batch_sampler)
             stop = object()
+            # depth = completed - consumed, from done callbacks — the queue
+            # itself holds every future of the epoch up front, so qsize()
+            # would report batches-remaining, not prefetch depth. Separate
+            # monotonic counters (not one +/- cell) because the consumer's
+            # result() can return BEFORE the done callback runs; the raw
+            # difference dips to -1 transiently and self-corrects instead
+            # of accumulating a phantom +1 per raced batch.
+            counts_lock = threading.Lock()
+            counts = [0, 0]     # [completed, consumed], tracked futures only
+
+            def _mark_ready(_):
+                with counts_lock:
+                    counts[0] += 1
 
             def submitter():
                 for indices in batches:
-                    futures.put(pool.submit(self._load_batch, indices))
+                    fut = pool.submit(self._load_batch, indices)
+                    if _telemetry._enabled:
+                        fut._tele_tracked = True
+                        fut.add_done_callback(_mark_ready)
+                    futures.put(fut)
                 futures.put(stop)
 
             t = threading.Thread(target=submitter, daemon=True)
@@ -158,7 +202,14 @@ class DataLoader:
                 fut = futures.get()
                 if fut is stop:
                     break
-                yield fut.result()
+                batch = fut.result()
+                if _telemetry._enabled and getattr(fut, "_tele_tracked",
+                                                   False):
+                    with counts_lock:
+                        counts[1] += 1
+                        depth = max(0, counts[0] - counts[1])
+                    _M_DEPTH.set(depth)
+                yield batch
             t.join()
 
     def _iter_processes(self):
@@ -205,9 +256,13 @@ class DataLoader:
             next_yield = 0
             while True:
                 if next_yield in buf:
+                    if _telemetry._enabled:
+                        _M_DEPTH.set(len(buf))
                     yield _to_device_tree(buf.pop(next_yield))
                     next_yield += 1
                     continue
+                if _telemetry._enabled:
+                    _M_DEPTH.set(0)     # consumer is starved: input-bound
                 if recvd >= sent:       # nothing in flight, nothing buffered
                     break
                 from ... import config as _config

@@ -10,11 +10,20 @@ accepted for API compatibility and validated against the mesh story
 """
 from __future__ import annotations
 
+import time
+
 from .. import optimizer as opt_mod
+from .. import telemetry as _telemetry
 from ..ndarray import NDArray
 from .parameter import ParameterDict
 
 __all__ = ["Trainer"]
+
+_M_STEP_SECONDS = _telemetry.histogram(
+    "trainer_step_seconds", "Trainer.step / ShardedTrainer.step host wall "
+    "time (optimizer apply; the sharded path fences on the step's outputs, "
+    "so this is device step time except on tunnel platforms where "
+    "block_until_ready is a no-op and it degrades to dispatch time)")
 
 
 class Trainer:
@@ -59,6 +68,16 @@ class Trainer:
         """Scale gradients by 1/batch_size and apply updates. When AMP is
         attached (contrib.amp.init_trainer), also unscale by the dynamic
         loss scale and skip non-finite steps."""
+        if _telemetry._enabled:
+            t0 = time.perf_counter()
+            try:
+                self._step_impl(batch_size, ignore_stale_grad)
+            finally:
+                _M_STEP_SECONDS.observe(time.perf_counter() - t0)
+            return
+        self._step_impl(batch_size, ignore_stale_grad)
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         scaler = getattr(self, "_amp_loss_scaler", None)
         if scaler is not None and scaler.loss_scale != 1.0:
             # bf16's default scale of 1.0 skips the whole dance — no

@@ -26,10 +26,39 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["KVStore", "create"]
+
+_M_KV_CALLS = _telemetry.counter(
+    "kvstore_calls_total", "KVStore data-plane calls, labelled op=push|pull")
+_M_KV_BYTES = _telemetry.counter(
+    "kvstore_bytes_total", "payload bytes through the KVStore data plane, "
+    "labelled op=push|pull")
+
+
+def _payload_bytes(values):
+    """Raw payload bytes of a (possibly nested) value list. Dense NDArrays
+    carry their buffer under ._data; sparse ones have _data=None and store
+    value/index buffers under ._values / ._indices."""
+    n = 0
+    for v in values:
+        for x in (v if isinstance(v, (list, tuple)) else [v]):
+            if x is None:
+                continue
+            for buf in ("_data", "_values", "_indices"):
+                d = getattr(x, buf, None)
+                if d is not None and hasattr(d, "nbytes"):
+                    n += int(d.nbytes)
+    return n
+
+
+def _tele_bytes(op, values):
+    """Count one data-plane call and its payload bytes."""
+    _M_KV_CALLS.labels(op=op).inc()
+    _M_KV_BYTES.labels(op=op).inc(_payload_bytes(values))
 
 
 def _nd_scalar(v):
@@ -62,44 +91,68 @@ class KVStore:
     def push(self, key, value, priority=0):
         from ..ndarray.sparse import BaseSparseNDArray, add as sparse_add
         keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            # validate BEFORE any aggregation: compression keeps
-            # error-feedback residuals, which a failed push must not touch
-            if k not in self._store:
-                raise KeyError(f"key {k} not initialized")
-            if any(isinstance(x, BaseSparseNDArray) for x in vs):
-                # sparse aggregate stays sparse so the optimizer can take
-                # its lazy row-update path (reference: sparse push keeps
-                # kRowSparseStorage through the server merge); compression
-                # applies to dense pushes only (reference behavior)
-                agg = vs[0]
-                for extra in vs[1:]:
-                    agg = sparse_add(agg, extra)
-            elif self._compression is not None:
-                # per-slot quantize with error feedback (int8 wire
-                # payloads, the reference's worker->server format),
-                # aggregate in int32 so any slot count sums exactly,
-                # dequantize in the gradients' own dtype
-                qs = [self._compression.compress(k, i, x._data)
-                      for i, x in enumerate(vs)]
-                qsum = qs[0].astype(jnp.int32)
-                for q in qs[1:]:
-                    qsum = qsum + q
-                agg = NDArray(self._compression.decompress(qsum)
-                              .astype(vs[0]._data.dtype))
-            else:
-                agg = NDArray(sum((x._data for x in vs[1:]), vs[0]._data))
-            if self._updater is not None:
-                self._updater(k, agg, self._store[k])
-            elif self._optimizer is not None:
-                state = self._opt_states.setdefault(
-                    k, self._optimizer.create_state(k, self._store[k]))
-                self._optimizer.update(k, self._store[k], agg, state)
-            else:
-                dense = agg.todense()._data \
-                    if isinstance(agg, BaseSparseNDArray) else agg._data
-                self._pending[k] = self._pending.get(k, 0) + dense
+        # byte counting is per committed key — a rejected key contributes
+        # nothing, but keys already applied before a later key fails DID
+        # move their bytes and stay counted; the call counts iff any key
+        # committed (hence the try/finally)
+        pushed_any = False
+        try:
+            for k, v in zip(keys, values):
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                kb = 0      # telemetry: this key's wire payload
+                # validate BEFORE any aggregation: compression keeps
+                # error-feedback residuals, which a failed push must not
+                # touch
+                if k not in self._store:
+                    raise KeyError(f"key {k} not initialized")
+                if any(isinstance(x, BaseSparseNDArray) for x in vs):
+                    # sparse aggregate stays sparse so the optimizer can
+                    # take its lazy row-update path (reference: sparse push
+                    # keeps kRowSparseStorage through the server merge);
+                    # compression applies to dense pushes only (reference
+                    # behavior)
+                    agg = vs[0]
+                    for extra in vs[1:]:
+                        agg = sparse_add(agg, extra)
+                    if _telemetry._enabled:
+                        kb = _payload_bytes(vs)
+                elif self._compression is not None:
+                    # per-slot quantize with error feedback (int8 wire
+                    # payloads, the reference's worker->server format),
+                    # aggregate in int32 so any slot count sums exactly,
+                    # dequantize in the gradients' own dtype
+                    qs = [self._compression.compress(k, i, x._data)
+                          for i, x in enumerate(vs)]
+                    if _telemetry._enabled:
+                        # the quantized wire payload, not the f32 inputs —
+                        # byte counts must reflect what compression saves
+                        kb = sum(int(q.nbytes) for q in qs)
+                    qsum = qs[0].astype(jnp.int32)
+                    for q in qs[1:]:
+                        qsum = qsum + q
+                    agg = NDArray(self._compression.decompress(qsum)
+                                  .astype(vs[0]._data.dtype))
+                else:
+                    agg = NDArray(sum((x._data for x in vs[1:]),
+                                      vs[0]._data))
+                    if _telemetry._enabled:
+                        kb = _payload_bytes(vs)
+                if self._updater is not None:
+                    self._updater(k, agg, self._store[k])
+                elif self._optimizer is not None:
+                    state = self._opt_states.setdefault(
+                        k, self._optimizer.create_state(k, self._store[k]))
+                    self._optimizer.update(k, self._store[k], agg, state)
+                else:
+                    dense = agg.todense()._data \
+                        if isinstance(agg, BaseSparseNDArray) else agg._data
+                    self._pending[k] = self._pending.get(k, 0) + dense
+                if _telemetry._enabled:
+                    _M_KV_BYTES.labels(op="push").inc(kb)
+                    pushed_any = True
+        finally:
+            if pushed_any:
+                _M_KV_CALLS.labels(op="push").inc()
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
@@ -120,6 +173,8 @@ class KVStore:
                     else:
                         dst._data = val
                 results.append(o)
+        if _telemetry._enabled:
+            _tele_bytes("pull", results)
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):

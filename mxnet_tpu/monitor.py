@@ -8,6 +8,7 @@ The classic Module path gets the same via `Module.install_monitor`."""
 from __future__ import annotations
 
 import re
+import weakref
 
 from .ndarray import NDArray
 
@@ -41,24 +42,37 @@ class Monitor:
         self.activated = False
         self._activations = []
         self._params = None
+        # block -> set of names it is hooked under; weak so a dead block's
+        # entry (and its reused id) can never shadow a new block
+        self._installed = weakref.WeakKeyDictionary()
 
     # -- wiring ----------------------------------------------------------
     def install(self, block, prefix=""):
         """Recursively hook a gluon Block; records each child's output when
         the monitor is activated. Also registers the block's parameters for
-        param/grad statistics."""
+        param/grad statistics. Idempotent per (block, name) — a repeated
+        install would duplicate every forward hook and double-count
+        activations — while a shared block instance reachable under two
+        prefixes still reports under both names, and the recursion always
+        walks the children, so children added after a first install get
+        hooked by a re-install."""
         name = prefix or type(block).__name__.lower()
+        hooked_names = self._installed.setdefault(block, set())
+        if name not in hooked_names:
+            hooked_names.add(name)
 
-        def hook(blk, inputs, output, _name=name):
-            if not self.activated:
-                return
-            outs = output if isinstance(output, (list, tuple)) else [output]
-            for i, o in enumerate(outs):
-                if isinstance(o, NDArray):
-                    tag = _name if len(outs) == 1 else f"{_name}_output{i}"
-                    self._activations.append((tag, o))
+            def hook(blk, inputs, output, _name=name):
+                if not self.activated:
+                    return
+                outs = output if isinstance(output, (list, tuple)) \
+                    else [output]
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray):
+                        tag = _name if len(outs) == 1 \
+                            else f"{_name}_output{i}"
+                        self._activations.append((tag, o))
 
-        block.register_forward_hook(hook)
+            block.register_forward_hook(hook)
         for cname, child in getattr(block, "_children", {}).items():
             self.install(child, f"{name}.{cname}")
         if prefix == "":

@@ -13,11 +13,14 @@ Gluon blocks plug in unchanged via `gluon.functional_call`.
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
 from .. import random as _random
 from .. import _engine
+from .. import telemetry as _telemetry
 from ..gluon.block import functional_call
 from ..ndarray import NDArray
 from . import specs as _specs
@@ -25,6 +28,19 @@ from .functional_opt import FunctionalOptimizer
 from .mesh import current_mesh
 
 __all__ = ["ShardedTrainer", "call_loss"]
+
+# shared, framework-wide series (get-or-create: same objects as the
+# HybridBlock jit cache and the gluon Trainer register)
+_M_COMPILES = _telemetry.counter("compile_total")
+_M_RECOMPILES = _telemetry.counter("recompile_total")
+_M_COMPILE_SECONDS = _telemetry.histogram("compile_seconds")
+_M_STEP_SECONDS = _telemetry.histogram("trainer_step_seconds")
+_M_COLL_CALLS = _telemetry.counter(
+    "collective_calls_total", "XLA collectives issued per jitted train step "
+    "(host-side accounting: one gradient psum per step on the data axes)")
+_M_COLL_BYTES = _telemetry.counter(
+    "collective_bytes_total", "payload bytes moved by the counted "
+    "collectives (gradient bytes per reducing step)")
 
 
 def call_loss(loss_fn, rng, outs, labels):
@@ -65,6 +81,8 @@ class ShardedTrainer:
         self.num_update = 0
         self._step_cache = {}
         self._ready = False
+        self._tele_sig = None
+        self._tele_reduce_bytes = 0
         from ..gluon.parameter import DeferredInitializationError
         try:
             self._setup()
@@ -120,6 +138,19 @@ class ShardedTrainer:
                 for st, s in zip(self.fopt.init(self.params), self._pshard)]
         self.aux = [jax.device_put(p.data()._data, s)
                     for (_, p), s in zip(self._aux_params, self._aux_shard)]
+        # gradient-reduction payload per step, for the collective counters:
+        # XLA psums grads over the data axes iff they span >1 device
+        reduce_degree = self.mesh.shape.get("dp", 1) * \
+            self.mesh.shape.get("fsdp", 1)
+        if reduce_degree > 1:
+            if self._fused:
+                self._tele_reduce_bytes = int(
+                    self.params.size * self.params.dtype.itemsize)
+            else:
+                self._tele_reduce_bytes = int(sum(
+                    p.size * p.dtype.itemsize for p in self.params))
+        else:
+            self._tele_reduce_bytes = 0
         self._ready = True
 
     # ------------------------------------------------------------------
@@ -196,7 +227,10 @@ class ShardedTrainer:
                  for b in list(data) + list(labels)]
         shapes = tuple(b.shape for b in batch)
         key = (len(data), len(labels), shapes)
-        if key not in self._step_cache:
+        is_miss = key not in self._step_cache
+        t_build = time.perf_counter() if (is_miss and _telemetry._enabled) \
+            else None
+        if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
         self.num_update += 1
         t = jnp.asarray(self.num_update, jnp.float32)
@@ -207,13 +241,53 @@ class ShardedTrainer:
         # StepTraceAnnotation: jax.profiler device traces group work by
         # train step (the reference profiler's per-iteration ranges —
         # SURVEY §5.1); free when no trace is active
+        t_step = time.perf_counter() if _telemetry._enabled else None
         with jax.profiler.StepTraceAnnotation("train_step",
                                               step_num=self.num_update):
             loss, self.params, self.aux, self.opt_state = \
                 self._step_cache[key](
                     self.params, self.aux, self.opt_state, t, lr,
                     _random.next_key(), *batch)
+        if _telemetry._enabled:
+            # fence on the loss (one output of the step executable fences
+            # the whole executable) so the histogram records device step
+            # time, not just async dispatch; on tunnel platforms where
+            # block_until_ready is a no-op this degrades to dispatch time
+            jax.block_until_ready(loss)
+            self._tele_record_step(batch, t_build, t_step)
         return NDArray(loss)
+
+    def _tele_record_step(self, batch, t_build, t_step):
+        """Telemetry for one sharded step: compile accounting on a
+        step-cache miss (with a signature diff explaining the re-jit),
+        step latency, and gradient-reduction collective bytes. The jitted
+        call compiles lazily on its first invocation, so t_build brackets
+        build + first call."""
+        now = time.perf_counter()
+        if t_step is not None and t_build is None:
+            # compile steps are excluded: the lazy first invocation would
+            # put a seconds-long compile into the step histogram and poison
+            # p99 / the input-stall denominator (it lands in compile_seconds)
+            _M_STEP_SECONDS.observe(now - t_step)
+            _telemetry.event("step", dur_s=round(now - t_step, 6),
+                             step=self.num_update)
+        if t_build is not None:
+            dt = now - t_build
+            _M_COMPILES.inc()
+            _M_COMPILE_SECONDS.observe(dt)
+            sig = _telemetry.signature(batch)
+            causes, changed = _telemetry.diff_signature(self._tele_sig, sig)
+            kind = "compile" if self._tele_sig is None else "recompile"
+            if self._tele_sig is not None:
+                _M_RECOMPILES.inc()
+            self._tele_sig = sig
+            _telemetry.event(
+                kind, block=f"ShardedTrainer({type(self.block).__name__})",
+                compile_time_s=round(dt, 6), causes=causes, changed=changed,
+                signature=sig)
+        if self._tele_reduce_bytes:
+            _M_COLL_CALLS.labels(op="psum_grad").inc()
+            _M_COLL_BYTES.labels(op="psum_grad").inc(self._tele_reduce_bytes)
 
     # ------------------------------------------------------------------
     def sync_to_block(self):

@@ -37,6 +37,52 @@ def test_monitor_gluon_interval_and_stats():
     assert seen[1] == 0 and seen[3] == 0
 
 
+def test_monitor_install_idempotent():
+    # regression: a second install() on the same block used to re-register
+    # every forward hook, double-counting each activation row
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1, monitor_gradient=False)
+    mon.install(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    baseline = len(mon.toc())
+    assert baseline > 0
+    n_hooks = sum(len(b._forward_hooks)
+                  for b in [net] + list(net._children.values()))
+
+    mon.install(net)            # must be a no-op
+    assert sum(len(b._forward_hooks)
+               for b in [net] + list(net._children.values())) == n_hooks
+    mon.tic()
+    net(nd.ones((2, 3)))
+    assert len(mon.toc()) == baseline
+
+    # a child added AFTER the first install is still picked up by a
+    # re-install (the idempotence guard is per block, not per tree)
+    net.add(nn.Dense(3))
+    mon.install(net)
+    new_child = list(net._children.values())[-1]
+    assert len(new_child._forward_hooks) == 1
+    assert sum(len(b._forward_hooks)
+               for b in [net] + list(net._children.values())) == n_hooks + 1
+
+
+def test_monitor_shared_block_reports_both_names():
+    # one Dense instance added twice: the guard is per (block, name), so
+    # the shared block reports an activation row under each prefix
+    shared = nn.Dense(4, in_units=3)
+    net = nn.HybridSequential()
+    net.add(shared, shared)
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1, monitor_gradient=False)
+    mon.install(net)
+    assert len(shared._forward_hooks) == 2
+    mon.install(net)                        # still idempotent
+    assert len(shared._forward_hooks) == 2
+
+
 def test_monitor_pattern_filters():
     net = nn.HybridSequential()
     net.add(nn.Dense(4), nn.Dense(2))

@@ -1,0 +1,309 @@
+"""mx.telemetry: metric semantics, the disabled fast path, recompile-cause
+diagnosis on the HybridBlock jit cache, exporter formats, and the JSONL →
+tools/telemetry_report.py round trip."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# -- metric semantics -------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    c = telemetry.counter("t_requests_total", "doc")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    assert telemetry.counter("t_requests_total") is c  # get-or-create
+
+    g = telemetry.gauge("t_depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5.0
+
+    h = telemetry.histogram("t_latency_seconds")
+    for v in (0.001, 0.002, 0.003, 0.5):
+        h.observe(v)
+    assert h.count == 4
+    assert abs(h.sum - 0.506) < 1e-9
+    assert h.percentile(50) == pytest.approx(0.003)  # nearest-rank
+    assert h.percentile(99) == pytest.approx(0.5)
+
+    with pytest.raises(TypeError):
+        telemetry.gauge("t_requests_total")  # type clash on one name
+
+
+def test_labels_fan_out():
+    c = telemetry.counter("t_calls_total")
+    c.labels(op="push").inc(2)
+    c.labels(op="pull").inc()
+    assert c.labels(op="push").value == 2
+    assert c.labels(op="pull").value == 1
+    assert c.labels(op="push") is c.labels(op="push")
+    snap = telemetry.snapshot()["t_calls_total"]
+    assert snap["labels"]['{op="push"}']["value"] == 2
+
+
+def test_disabled_fast_path_allocates_nothing():
+    telemetry.disable()
+    c = telemetry.counter("t_noop_total")
+    h = telemetry.histogram("t_noop_seconds")
+    c.inc()
+    h.observe(1.0)
+    telemetry.event("step", dur_s=1.0)
+    assert c.value == 0
+    assert h.count == 0
+    assert telemetry.events() == []
+
+
+def test_reset_zeroes_but_keeps_registry():
+    c = telemetry.counter("t_reset_total")
+    c.labels(op="x").inc(4)
+    c.inc(4)
+    telemetry.event("step", dur_s=0.1)
+    telemetry.reset()
+    assert c.value == 0
+    assert c.labels(op="x").value == 0
+    assert telemetry.events() == []
+    assert telemetry.get("t_reset_total") is c
+
+
+# -- recompile diagnosis ----------------------------------------------------
+
+def test_diff_signature_names_changed_axis():
+    a = telemetry.signature([nd.ones((4, 8))], train=False)
+    b = telemetry.signature([nd.ones((6, 8))], train=False)
+    causes, changed = telemetry.diff_signature(a, b)
+    assert causes == ["input[0] shape axis 0: 4 -> 6"]
+    assert changed == [{"input": 0, "axis": 0, "from": 4, "to": 6}]
+    causes, _ = telemetry.diff_signature(None, a)
+    assert causes == ["first compile"]
+
+
+def test_hybrid_block_compile_once_then_recompile_on_shape_change():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+
+    x = nd.array(np.ones((2, 3), np.float32))
+    net(x)
+    net(x)
+    net(x)
+    # exactly one compile for repeated same-shape calls
+    assert telemetry.counter("compile_total").value == 1
+    assert telemetry.counter("recompile_total").value == 0
+    assert telemetry.counter("hybrid_cache_hits_total").value == 2
+    assert len(telemetry.events("compile")) == 1
+
+    # a deliberate batch-size change must produce a recompile event whose
+    # payload names the changed axis
+    net(nd.array(np.ones((5, 3), np.float32)))
+    assert telemetry.counter("recompile_total").value == 1
+    (ev,) = telemetry.events("recompile")
+    assert ev["block"] == "Dense"
+    assert ev["causes"] == ["input[0] shape axis 0: 2 -> 5"]
+    assert {"input": 0, "axis": 0, "from": 2, "to": 5} in ev["changed"]
+    assert ev["compile_time_s"] > 0
+
+
+def test_trainer_step_records_latency():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, loss as gloss
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    lfn = gloss.L2Loss()
+    with autograd.record():
+        loss = lfn(net(nd.ones((4, 3))), nd.ones((4, 2))).mean()
+    loss.backward()
+    tr.step(4)
+    assert telemetry.histogram("trainer_step_seconds").count == 1
+
+
+def test_dataloader_wait_and_kvstore_bytes():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(nd.array(np.arange(24, dtype=np.float32).reshape(8, 3)),
+                      nd.array(np.arange(8, dtype=np.float32)))
+    before = telemetry.histogram("dataloader_wait_seconds").count
+    for _ in DataLoader(ds, batch_size=4):
+        pass
+    assert telemetry.histogram("dataloader_wait_seconds").count == before + 2
+
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((4, 4)))
+    kv.push("w", nd.ones((4, 4)))
+    kv.pull("w")
+    assert telemetry.counter("kvstore_calls_total").labels(op="push").value == 1
+    assert telemetry.counter("kvstore_calls_total").labels(op="pull").value == 1
+    assert telemetry.counter("kvstore_bytes_total").labels(op="push").value \
+        == 4 * 4 * 4  # 16 f32 elements
+
+
+def test_kvstore_failed_push_not_counted():
+    kv = mx.kv.create("local")
+    with pytest.raises(KeyError):
+        kv.push("never_initialized", nd.ones((2, 2)))
+    assert telemetry.counter("kvstore_bytes_total").labels(op="push").value == 0
+
+    # partial multi-key push: the committed key's bytes ARE counted (they
+    # moved), the rejected key's are not
+    kv.init("a", nd.zeros((2, 2)))
+    with pytest.raises(KeyError):
+        kv.push(["a", "b_missing"], [nd.ones((2, 2)), nd.ones((2, 2))])
+    assert telemetry.counter("kvstore_bytes_total").labels(op="push").value \
+        == 2 * 2 * 4
+    assert telemetry.counter("kvstore_calls_total").labels(op="push").value == 1
+
+
+def test_kvstore_compressed_push_counts_wire_bytes():
+    # with gradient compression on, the byte counter must reflect the
+    # quantized wire payload, not the raw f32 inputs
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((64,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.push("w", nd.ones((64,)))
+    wire = telemetry.counter("kvstore_bytes_total").labels(op="push").value
+    assert 0 < wire < 64 * 4, wire   # strictly smaller than the f32 payload
+
+
+def test_autoflush_failure_does_not_raise_into_hot_path(recwarn):
+    from mxnet_tpu import config
+    old_path = config.get("telemetry_jsonl_path")
+    old_int = config.get("telemetry_flush_interval")
+    config.set("telemetry_jsonl_path", "/nonexistent-dir/run.jsonl")
+    config.set("telemetry_flush_interval", 0.0)
+    try:
+        telemetry.event("step", dur_s=0.01)   # triggers autoflush; must not raise
+        telemetry.event("step", dur_s=0.02)
+        # events survive the failed flush for a later dump_jsonl
+        assert len(telemetry.events("step")) == 2
+        with pytest.raises(OSError):
+            telemetry.flush("/nonexistent-dir/run.jsonl")  # explicit flush raises
+        assert len(telemetry.events("step")) == 2          # ...but keeps events
+    finally:
+        config.set("telemetry_jsonl_path", old_path)
+        config.set("telemetry_flush_interval", old_int)
+
+
+# -- exporters --------------------------------------------------------------
+
+def test_prometheus_text_format():
+    telemetry.counter("t_prom_total", "a counter").labels(op="push").inc(3)
+    telemetry.gauge("t_prom_depth").set(2)
+    h = telemetry.histogram("t_prom_seconds")
+    h.observe(0.0005)
+    h.observe(40.0)
+    text = telemetry.dump_prometheus()
+    assert "# HELP t_prom_total a counter" in text
+    assert "# TYPE t_prom_total counter" in text
+    assert 't_prom_total{op="push"} 3.0' in text
+    assert "# TYPE t_prom_depth gauge" in text
+    assert "t_prom_depth 2.0" in text
+    assert "# TYPE t_prom_seconds histogram" in text
+    assert 't_prom_seconds_bucket{le="0.001"} 1' in text
+    assert 't_prom_seconds_bucket{le="+Inf"} 2' in text
+    assert "t_prom_seconds_count 2" in text
+    # labeled-only metric: no phantom zero-valued unlabeled parent sample
+    assert "t_prom_total 0" not in text
+
+
+def test_prometheus_file_and_profiler_bridge(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    telemetry.counter("t_file_total").inc()
+    telemetry.dump_prometheus(path)
+    with open(path) as f:
+        assert "t_file_total 1.0" in f.read()
+
+    # counter updates mirror into mx.profiler as chrome-trace 'C' events
+    mx.profiler.start()
+    try:
+        telemetry.counter("t_bridge_total").inc()
+    finally:
+        mx.profiler.stop()
+    prof_path = str(tmp_path / "trace.json")
+    mx.profiler.dump(filename=prof_path)
+    with open(prof_path) as f:
+        trace = json.load(f)
+    bridged = [e for e in trace["traceEvents"]
+               if e.get("name") == "t_bridge_total" and e.get("ph") == "C"]
+    assert bridged and bridged[0]["args"]["t_bridge_total"] == 1.0
+
+
+def test_jsonl_roundtrip_through_report_cli(tmp_path):
+    # synthesize a small run: one hybridized block with a shape change,
+    # some steps, some comms — then dump and feed the CLI
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 3)))
+    net(nd.ones((6, 3)))
+    for dur in (0.010, 0.011, 0.012, 0.080):
+        telemetry.event("step", dur_s=dur)
+    telemetry.histogram("dataloader_wait_seconds").observe(0.004)
+    telemetry.counter("collective_bytes_total").labels(op="psum_grad") \
+        .inc(1 << 20)
+
+    path = str(tmp_path / "run.jsonl")
+    telemetry.dump_jsonl(path)
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert lines[-1]["kind"] == "snapshot"
+    assert any(l["kind"] == "recompile" for l in lines)
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "telemetry_report.py"),
+         path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "recompile Dense: input[0] shape axis 0: 2 -> 6" in out
+    assert "p50 12.00 ms" in out
+    assert "p99 80.00 ms" in out
+    assert "1.0 MiB" in out
+    assert "stall fraction" in out
+
+
+def test_estimator_telemetry_handler_throughput():
+    from mxnet_tpu import metric
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator, LoggingHandler,
+                                                   TelemetryHandler)
+    rs = np.random.RandomState(0)
+    data = [(nd.array(rs.rand(8, 3).astype(np.float32)),
+             nd.array(rs.randint(0, 2, 8).astype(np.float32)))
+            for _ in range(3)]
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[metric.Loss("loss")],
+                    optimizer_params={"learning_rate": 0.01})
+    logs = []
+    est.fit(data, epochs=1,
+            event_handlers=[TelemetryHandler(tokens_per_sample=4),
+                            LoggingHandler(log_fn=logs.append)])
+    assert est.samples_per_sec > 0
+    assert est.tokens_per_sec == pytest.approx(est.samples_per_sec * 4)
+    assert telemetry.gauge("samples_per_sec").value > 0
+    assert len(telemetry.events("step")) == 3
+    assert telemetry.histogram("fit_batch_seconds").count == 3
+    assert any("samples/s" in l for l in logs if "epoch" in l)

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Summarize one telemetry JSONL run (mx.telemetry.dump_jsonl output, or a
+telemetry_jsonl_path auto-flush file).
+
+    python tools/telemetry_report.py run.jsonl
+
+Prints: recompile count with per-event causes, step-time p50/p99,
+collective/kvstore bytes moved, and the input-stall fraction (time blocked
+on the input pipeline as a share of run time) — the triage order for a slow
+TPU training run: recompiling? input-bound? comms-bound? only then look at
+the kernels (mx.profiler / jax.profiler).
+
+Reads only the stdlib so it runs anywhere the JSONL lands (no jax import).
+"""
+import json
+import sys
+
+
+def load(path):
+    events, snapshot = [], {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("kind") == "snapshot":
+                snapshot = ev.get("metrics", {})  # last snapshot wins
+            else:
+                events.append(ev)
+    return events, snapshot
+
+
+def percentile(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))]
+
+
+def _metric_sum(snapshot, name):
+    """Histogram sum / counter value for `name`, summed over labels."""
+    m = snapshot.get(name)
+    if not m:
+        return 0.0
+    if "labels" in m:
+        return sum(c.get("sum", c.get("value", 0.0)) or 0.0
+                   for c in m["labels"].values())
+    return m.get("sum", m.get("value", 0.0)) or 0.0
+
+
+def _label_values(snapshot, name):
+    m = snapshot.get(name, {})
+    out = {k: c.get("value", 0.0)
+           for k, c in m.get("labels", {}).items()}
+    if not out and m.get("value"):
+        out[""] = m["value"]
+    return out
+
+
+def fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def report(path):
+    events, snapshot = load(path)
+    lines = [f"telemetry report: {path}", "=" * 60]
+
+    # -- compiles / recompiles -------------------------------------------
+    compiles = [e for e in events if e["kind"] == "compile"]
+    recompiles = [e for e in events if e["kind"] == "recompile"]
+    compile_s = _metric_sum(snapshot, "compile_seconds")
+    lines.append(f"compiles:   {len(compiles)} first-time, "
+                 f"{len(recompiles)} recompiles, "
+                 f"{compile_s:.2f}s total compile time")
+    for e in recompiles:
+        causes = "; ".join(e.get("causes", [])) or "unknown"
+        lines.append(f"  recompile {e.get('block', '?')}: {causes} "
+                     f"({e.get('compile_time_s', 0):.2f}s)")
+
+    # -- step time --------------------------------------------------------
+    steps = [e["dur_s"] for e in events
+             if e["kind"] == "step" and "dur_s" in e]
+    if steps:
+        p50, p99 = percentile(steps, 50), percentile(steps, 99)
+        lines.append(f"steps:      {len(steps)}  "
+                     f"p50 {p50 * 1e3:.2f} ms  p99 {p99 * 1e3:.2f} ms")
+    else:
+        h = snapshot.get("trainer_step_seconds", {})
+        if h.get("count"):
+            lines.append(
+                f"steps:      {h['count']}  "
+                f"p50 {(h.get('p50') or 0) * 1e3:.2f} ms  "
+                f"p99 {(h.get('p99') or 0) * 1e3:.2f} ms (from snapshot)")
+        else:
+            lines.append("steps:      none recorded")
+
+    # -- comms ------------------------------------------------------------
+    coll = _label_values(snapshot, "collective_bytes_total")
+    kv = _label_values(snapshot, "kvstore_bytes_total")
+    total_comms = sum(coll.values()) + sum(kv.values())
+    lines.append(f"comms:      {fmt_bytes(total_comms)} total")
+    for tag, vals in (("collective", coll), ("kvstore", kv)):
+        for k, v in sorted(vals.items()):
+            lines.append(f"  {tag}{k}: {fmt_bytes(v)}")
+
+    # -- input pipeline ---------------------------------------------------
+    wait_s = _metric_sum(snapshot, "dataloader_wait_seconds")
+    step_s = sum(steps) if steps else _metric_sum(snapshot,
+                                                  "trainer_step_seconds")
+    denom = wait_s + step_s
+    if denom > 0:
+        frac = wait_s / denom
+        verdict = "input-bound" if frac > 0.5 else "compute-bound"
+        lines.append(f"input:      {wait_s:.2f}s waiting on batches, "
+                     f"stall fraction {frac:.1%} ({verdict})")
+    else:
+        lines.append("input:      no wait/step time recorded")
+    return "\n".join(lines)
+
+
+def main(argv):
+    if len(argv) == 2 and argv[1] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    print(report(argv[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
