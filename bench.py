@@ -109,7 +109,7 @@ def run_bench(on_tpu):
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import nd, parallel, telemetry
+    from mxnet_tpu import diagnostics, nd, parallel, telemetry
     from mxnet_tpu.models import bert as bert_mod
 
     # telemetry rides along (compile accounting happens during warmup, so
@@ -219,6 +219,14 @@ def run_bench(on_tpu):
         # the timed loop is shape churn eating the reported throughput
         "compile_time_s": round(telemetry.histogram("compile_seconds").sum, 3),
         "recompile_count": int(telemetry.counter("recompile_total").value),
+        # tail latency + memory trajectory: a p99 far above p50 means the
+        # run stutters (recompiles, input stalls, host interference) even
+        # when mean throughput looks healthy; RSS creep across rounds is
+        # the host-side leak detector
+        "step_p99_ms": round(
+            (telemetry.histogram("trainer_step_seconds").percentile(99)
+             or 0.0) * 1e3, 3),
+        "peak_host_rss_mb": round(diagnostics.host_peak_rss_mb(), 1),
     }
     if mfu is not None:
         # 6*N*tokens model flops, attention quadratic term EXCLUDED

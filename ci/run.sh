@@ -37,6 +37,21 @@ assert c.value == 0 and h.count == 0, 'disabled metric still counted'
 assert telemetry.events() == [], 'disabled fast path allocated events'
 print('telemetry disabled fast path OK')
 "
+    # diagnostics must be disabled by default: no ring-buffer allocation,
+    # no recorded entries, and no watchdog thread on the disabled fast path
+    JAX_PLATFORMS=cpu python -c "
+import threading
+from mxnet_tpu import diagnostics
+assert not diagnostics.enabled(), 'diagnostics must default to off'
+diagnostics.record_step(1, loss=0.5, lr=1e-3)
+diagnostics.record_event('compile', block='X')
+assert diagnostics._ring is None, 'disabled fast path allocated the ring'
+assert diagnostics.records() == [], 'disabled fast path recorded entries'
+assert diagnostics._watchdog is None, 'watchdog armed while disabled'
+assert not any(t.name == 'mx-diagnostics-watchdog'
+               for t in threading.enumerate()), 'watchdog thread exists'
+print('diagnostics disabled fast path OK')
+"
 }
 
 unittest_stage() {

@@ -53,6 +53,7 @@ _LAZY = {
     "operator": ".operator",
     "profiler": ".profiler",
     "telemetry": ".telemetry",
+    "diagnostics": ".diagnostics",
     "parallel": ".parallel",
     "test_utils": ".test_utils",
     "lr_scheduler": ".lr_scheduler",

@@ -155,3 +155,37 @@ register_option(
     "telemetry_flush_interval", 5.0,
     "Seconds between auto-flushes of buffered telemetry events to "
     "telemetry_jsonl_path. Checked on event emission (no flush thread).")
+register_option(
+    "diagnostics", False,
+    "Arm mx.diagnostics at import: flight recorder, crash post-mortem "
+    "writer (sys.excepthook + atexit + faulthandler), and — when "
+    "watchdog_deadline_s > 0 — the hang watchdog. Off by default: every "
+    "recording site then reduces to a single module-bool check and no "
+    "ring buffer or watchdog thread exists (asserted by ci/run.sh "
+    "sanity). mx.diagnostics.install() arms at runtime.")
+register_option(
+    "diagnostics_dir", "diagnostics",
+    "Base directory for per-rank diagnostic artifacts: "
+    "<dir>/<rank>/postmortem.json, worker.log (written by tools/"
+    "launch.py), faulthandler.log, watchdog_stacks.txt. Merged across "
+    "ranks by tools/postmortem_report.py.")
+register_option(
+    "diagnostics_ring_size", 256,
+    "Flight-recorder capacity: the last N step/compile records kept in "
+    "the in-memory ring buffer and written into postmortem.json.")
+register_option(
+    "watchdog_deadline_s", 0.0,
+    "Seconds without a completed step before the mx.diagnostics watchdog "
+    "fires (names the last-entered scope, dumps all-thread stacks and a "
+    "post-mortem, then re-arms on the next step). 0 disables the "
+    "watchdog thread entirely.")
+register_option(
+    "nan_sentinel", False,
+    "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "
+    "the loss (ShardedTrainer/estimator DiagnosticsHandler) or global "
+    "grad-norm (gluon Trainer) each step; a non-finite value writes a "
+    "post-mortem and raises mx.diagnostics.NonFiniteError instead of "
+    "silently corrupting the run. Works with diagnostics off (the dump "
+    "then has an empty ring); stands down in the gluon Trainer while a "
+    "scaling AMP loss scaler is attached, whose overflow-skip handles "
+    "Inf grads as routine. Costs one device sync per step.")

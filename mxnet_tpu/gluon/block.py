@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import _engine
+from .. import diagnostics as _diagnostics
 from .. import ndarray as nd_mod
 from .. import random as _random
 from .. import telemetry as _telemetry
@@ -297,7 +298,9 @@ class HybridBlock(Block):
                len(grad_params), len(aux_params))
         entry = self._cache.get(key)
         is_miss = entry is None
-        t0 = time.perf_counter() if (is_miss and _telemetry._enabled) else None
+        t0 = time.perf_counter() if (
+            is_miss and (_telemetry._enabled or _diagnostics._enabled)) \
+            else None
         if is_miss:
             entry = self._build_cached(args, grad_params, aux_params, train)
             self._cache[key] = entry
@@ -311,13 +314,21 @@ class HybridBlock(Block):
         # the first call of a fresh entry triggers XLA's lazy compile, so
         # the compile-time measurement must bracket it
         out_flat, new_aux = jitted(gp_data, aux_data, rng, *in_data)
-        if _telemetry._enabled:
-            if t0 is not None:
-                self._tele_record_compile(args, train,
-                                          time.perf_counter() - t0,
+        if t0 is not None:
+            dt = time.perf_counter() - t0
+            if _telemetry._enabled:
+                self._tele_record_compile(args, train, dt,
                                           len(grad_params), len(aux_params))
-            elif not is_miss:
-                _M_CACHE_HITS.inc()
+            if _diagnostics._enabled:
+                # compile events land in the flight-recorder ring too: a
+                # post-mortem showing recompiles right before the crash is
+                # the shape-churn smoking gun
+                _diagnostics.record_event(
+                    "compile", block=type(self).__name__,
+                    compile_time_s=round(dt, 6),
+                    shapes=[list(a.shape) for a in args])
+        elif _telemetry._enabled and not is_miss:
+            _M_CACHE_HITS.inc()
         for (_, p), v in zip(aux_params, new_aux):
             p.data()._data = v
 

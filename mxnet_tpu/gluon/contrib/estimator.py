@@ -13,7 +13,7 @@ import time
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler", "TelemetryHandler"]
+           "EarlyStoppingHandler", "TelemetryHandler", "DiagnosticsHandler"]
 
 
 class TrainBegin:
@@ -196,6 +196,76 @@ class TelemetryHandler(TrainBegin, BatchBegin, BatchEnd, TrainEnd):
                 # or starve the remaining train_end handlers
                 import warnings
                 warnings.warn(f"telemetry flush to {path!r} failed: {e}")
+
+
+class DiagnosticsHandler(TrainBegin, BatchEnd, TrainEnd):
+    """Wire the fit loop into mx.diagnostics: arm the post-mortem writer
+    for the run, record one flight-recorder entry per batch (step id,
+    mean loss, lr), feed the hang watchdog, and — when the nan_sentinel
+    knob (or `nan_sentinel=True` here) is on — finiteness-check the loss,
+    dumping a post-mortem and raising NonFiniteError on NaN/Inf.
+
+    `watchdog_deadline_s=None` defers to the config knob (0 = no
+    watchdog). `install=True` (default) chains the crash hooks so an
+    unhandled exception anywhere in fit() leaves a postmortem.json; pass
+    False to only record while something else owns the hooks."""
+
+    def __init__(self, diagnostics_dir=None, watchdog_deadline_s=None,
+                 nan_sentinel=None, install=True):
+        from ... import config, diagnostics
+        self.diagnostics = diagnostics
+        self.config = config
+        self.diagnostics_dir = diagnostics_dir
+        self.watchdog_deadline_s = watchdog_deadline_s
+        self.nan_sentinel = nan_sentinel
+        self.install = install
+        self._armed_watchdog = False
+
+    def train_begin(self, est):
+        if self.install:
+            self.diagnostics.install(diagnostics_dir=self.diagnostics_dir)
+        else:
+            self.diagnostics.enable()
+        deadline = self.watchdog_deadline_s
+        if deadline is None:
+            deadline = self.config.get("watchdog_deadline_s")
+        # a process-lifetime watchdog (e.g. armed by install() at import)
+        # is respected: this handler only arms — and later disarms — its
+        # own, so fit() can't silently strip the user's watchdog
+        if deadline and deadline > 0 and self.diagnostics._watchdog is None:
+            self.diagnostics.arm_watchdog(deadline)
+            self._armed_watchdog = True
+
+    def batch_end(self, est):
+        check = self.nan_sentinel if self.nan_sentinel is not None \
+            else self.config.get("nan_sentinel")
+        if not (self.diagnostics.enabled() or check):
+            return
+        loss_val = None
+        if getattr(est, "last_loss", None) is not None:
+            # the eager fit loop already materialized the loss for the
+            # metric handlers, so this host read costs nothing extra
+            try:
+                loss_val = self.diagnostics._scalar(est.last_loss)
+            except Exception:
+                loss_val = None
+        if loss_val is None:
+            return  # Trainer.step already recorded this step
+        # Trainer.step already appended this step's record (grad-norm,
+        # lr); fold the loss into it rather than halving ring coverage
+        # with a near-duplicate entry. Recorded BEFORE the sentinel check
+        # so a NaN loss is the ring's last entry in the post-mortem.
+        if not self.diagnostics.annotate_step(est.num_batch, loss=loss_val):
+            self.diagnostics.record_step(
+                est.num_batch, loss=loss_val,
+                lr=est.trainer.learning_rate, trainer="Estimator")
+        if check:
+            self.diagnostics.sentinel_check(loss_val, "loss", est.num_batch)
+
+    def train_end(self, est):
+        if self._armed_watchdog:
+            self.diagnostics.disarm_watchdog()
+            self._armed_watchdog = False
 
 
 class CheckpointHandler(EpochEnd):

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import time
 
+from .. import config as _config
+from .. import diagnostics as _diagnostics
 from .. import optimizer as opt_mod
 from .. import telemetry as _telemetry
 from ..ndarray import NDArray
@@ -47,6 +49,7 @@ class Trainer:
         self._states = [None] * len(self._params)
         self._states_created = False
         self._kvstore_type = kvstore
+        self._num_update = 0
 
     @property
     def optimizer(self):
@@ -78,8 +81,33 @@ class Trainer:
         self._step_impl(batch_size, ignore_stale_grad)
 
     def _step_impl(self, batch_size, ignore_stale_grad):
+        self._num_update += 1
         scaler = getattr(self, "_amp_loss_scaler", None)
-        if scaler is not None and scaler.loss_scale != 1.0:
+        amp_scaled = scaler is not None and scaler.loss_scale != 1.0
+        # per-step config read (dict + uncontended lock, sub-µs vs a
+        # ms-scale step) so mx.config.set takes effect mid-run; the
+        # per-record fast path inside diagnostics stays a single bool
+        sentinel = _config.get("nan_sentinel")
+        if _diagnostics._enabled or sentinel:
+            # flight-recorder entry BEFORE the update so the sentinel can
+            # stop a non-finite gradient from reaching the parameters.
+            # With a scaling AMP trainer attached the sentinel stands
+            # down: Inf grads there are a routine scale-too-high overflow
+            # that the scaler below handles by skipping the step, not a
+            # run-killing event
+            gnorm = None
+            if sentinel and not amp_scaled:
+                gnorm = _diagnostics.grad_global_norm(self._params)
+            _diagnostics.record_step(
+                self._num_update, lr=self.learning_rate, grad_norm=gnorm,
+                trainer="Trainer")
+            if gnorm is not None:
+                # checked AFTER recording so the fatal step is the ring's
+                # last entry (the post-mortem must show the NaN, not end
+                # one step before it), but BEFORE the update applies
+                _diagnostics.sentinel_check(gnorm, "grad_norm",
+                                            self._num_update)
+        if amp_scaled:
             # bf16's default scale of 1.0 skips the whole dance — no
             # overflow sync on the hot path (the point of bf16-first AMP)
             if getattr(scaler, "_pending_unscaled", False):

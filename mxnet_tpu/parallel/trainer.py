@@ -20,6 +20,8 @@ import jax.numpy as jnp
 
 from .. import random as _random
 from .. import _engine
+from .. import config as _config
+from .. import diagnostics as _diagnostics
 from .. import telemetry as _telemetry
 from ..gluon.block import functional_call
 from ..ndarray import NDArray
@@ -228,34 +230,79 @@ class ShardedTrainer:
         shapes = tuple(b.shape for b in batch)
         key = (len(data), len(labels), shapes)
         is_miss = key not in self._step_cache
-        t_build = time.perf_counter() if (is_miss and _telemetry._enabled) \
-            else None
+        # per-step config read (sub-µs vs a ms-scale step) so
+        # mx.config.set("nan_sentinel", ...) takes effect mid-run
+        sentinel = _config.get("nan_sentinel")
+        observing = _telemetry._enabled or _diagnostics._enabled or sentinel
+        t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
         self.num_update += 1
+        lr_host = self.fopt.lr_at(self.num_update)
         t = jnp.asarray(self.num_update, jnp.float32)
-        lr = jnp.asarray(self.fopt.lr_at(self.num_update), jnp.float32)
+        lr = jnp.asarray(lr_host, jnp.float32)
         batch = [jax.device_put(b, s) for b, s in
                  zip(batch, self._batch_shardings(len(data), len(labels),
                                                   shapes))]
         # StepTraceAnnotation: jax.profiler device traces group work by
         # train step (the reference profiler's per-iteration ranges —
         # SURVEY §5.1); free when no trace is active
-        t_step = time.perf_counter() if _telemetry._enabled else None
-        with jax.profiler.StepTraceAnnotation("train_step",
-                                              step_num=self.num_update):
-            loss, self.params, self.aux, self.opt_state = \
-                self._step_cache[key](
-                    self.params, self.aux, self.opt_state, t, lr,
-                    _random.next_key(), *batch)
-        if _telemetry._enabled:
-            # fence on the loss (one output of the step executable fences
-            # the whole executable) so the histogram records device step
-            # time, not just async dispatch; on tunnel platforms where
-            # block_until_ready is a no-op this degrades to dispatch time
-            jax.block_until_ready(loss)
-            self._tele_record_step(batch, t_build, t_step)
+        t_step = time.perf_counter() if observing else None
+        in_scope = _diagnostics._enabled
+        if in_scope:
+            # the watchdog names this scope when the step never completes:
+            # with >1 reducing device a hang here is almost always the
+            # gradient psum waiting on a straggler/dead rank
+            _diagnostics._scope_begin(
+                "sharded_step(psum)" if self._tele_reduce_bytes
+                else "sharded_step(dispatch)", self.num_update)
+        try:
+            with jax.profiler.StepTraceAnnotation("train_step",
+                                                  step_num=self.num_update):
+                loss, self.params, self.aux, self.opt_state = \
+                    self._step_cache[key](
+                        self.params, self.aux, self.opt_state, t, lr,
+                        _random.next_key(), *batch)
+            if observing:
+                if _telemetry._enabled or sentinel:
+                    # fence on the loss (one output of the step executable
+                    # fences the whole executable) so the histogram records
+                    # device step time, not just async dispatch; on tunnel
+                    # platforms where block_until_ready is a no-op this
+                    # degrades to dispatch time. Diagnostics-only mode
+                    # skips the fence — a ring append must not cost the
+                    # host/device overlap — so its records mean "step
+                    # dispatched" there
+                    jax.block_until_ready(loss)
+                if _telemetry._enabled:
+                    self._tele_record_step(batch, t_build, t_step)
+                if _diagnostics._enabled or sentinel:
+                    self._diag_record_step(loss, lr_host, shapes, t_build,
+                                           sentinel)
+        finally:
+            if in_scope:
+                _diagnostics._scope_end()
         return NDArray(loss)
+
+    def _diag_record_step(self, loss, lr, shapes, t_build, sentinel):
+        """Flight-recorder entry for one sharded step; with the
+        nan_sentinel knob on (works with diagnostics off too — the dump
+        then just has an empty ring), the loss is host-fetched and
+        checked here; NonFiniteError propagates after the post-mortem."""
+        if t_build is not None:
+            _diagnostics.record_event(
+                "compile",
+                block=f"ShardedTrainer({type(self.block).__name__})",
+                compile_time_s=round(time.perf_counter() - t_build, 6),
+                step=self.num_update)
+        loss_val = _diagnostics._scalar(loss) if sentinel else None
+        _diagnostics.record_step(
+            self.num_update, loss=loss_val, lr=float(lr), shapes=shapes,
+            trainer="ShardedTrainer", compiled=t_build is not None)
+        if sentinel:
+            # checked AFTER recording so the fatal step — non-finite loss
+            # included — is the ring's last entry in the post-mortem
+            _diagnostics.sentinel_check(loss_val, "loss", self.num_update)
 
     def _tele_record_step(self, batch, t_build, t_step):
         """Telemetry for one sharded step: compile accounting on a

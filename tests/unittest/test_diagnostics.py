@@ -1,0 +1,518 @@
+"""mx.diagnostics: flight-recorder ring semantics, the disabled fast path,
+the hang watchdog (fake clock), the NaN/Inf sentinel (injected NaN), the
+crash post-mortem writer (forced ZeroDivisionError in a toy train loop),
+and the multi-rank launch → postmortem_report merge workflow."""
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, diagnostics, nd
+from mxnet_tpu.gluon import Trainer, nn
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir, os.pardir))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+PM_REPORT = os.path.join(ROOT, "tools", "postmortem_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_diagnostics():
+    diagnostics.reset()
+    yield
+    diagnostics.uninstall()
+    diagnostics.reset()
+    mx.config.reset("nan_sentinel")
+    mx.config.reset("watchdog_deadline_s")
+    mx.config.reset("diagnostics_ring_size")
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_disabled_fast_path_records_nothing():
+    assert not diagnostics.enabled()
+    diagnostics.record_step(1, loss=0.1)
+    diagnostics.record_event("compile", block="X")
+    assert diagnostics.records() == []
+    assert diagnostics._ring is None  # zero allocation while off
+    assert not any(t.name == "mx-diagnostics-watchdog"
+                   for t in threading.enumerate())
+
+
+def test_ring_is_bounded_and_ordered():
+    diagnostics.enable(ring_size=4)
+    for step in range(1, 11):
+        diagnostics.record_step(step, loss=float(step))
+    recs = diagnostics.records("step")
+    assert [r["step"] for r in recs] == [7, 8, 9, 10]  # last N survive
+    diagnostics.record_event("compile", block="Net", compile_time_s=0.5)
+    assert diagnostics.records("compile")[0]["block"] == "Net"
+    diagnostics.reset()
+    assert diagnostics.records() == []
+
+
+def test_step_record_fields():
+    diagnostics.enable()
+    with diagnostics.scope("psum", step=3):
+        diagnostics.record_step(3, loss=0.25, lr=1e-3, grad_norm=2.0,
+                                shapes=[(8, 16)])
+    (rec,) = diagnostics.records("step")
+    assert rec["loss"] == 0.25 and rec["lr"] == 1e-3
+    assert rec["grad_norm"] == 2.0
+    assert rec["shapes"] == [[8, 16]]
+    assert rec["scope"] == "psum"
+    assert "compile_total" in rec["telemetry"]
+
+
+def test_trainer_step_records_into_ring():
+    diagnostics.enable()
+    net = nn.Dense(3)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    trainer.step(2)
+    recs = diagnostics.records("step")
+    assert recs and recs[-1]["step"] == 1
+    assert recs[-1]["trainer"] == "Trainer"
+    assert recs[-1]["lr"] == pytest.approx(0.1)
+
+
+def test_hybridblock_compile_lands_in_ring():
+    diagnostics.enable()
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.hybridize()
+    net(nd.array(np.ones((1, 3), np.float32)))
+    net(nd.array(np.ones((4, 3), np.float32)))  # shape churn: second compile
+    compiles = diagnostics.records("compile")
+    assert len(compiles) == 2
+    assert all(c["compile_time_s"] >= 0 for c in compiles)
+    assert compiles[1]["shapes"] == [[4, 3]]
+
+
+# -- watchdog ---------------------------------------------------------------
+
+def test_watchdog_fires_deterministically_on_fake_clock():
+    diagnostics.enable()
+    now = [0.0]
+    fired = []
+    w = diagnostics.Watchdog(deadline_s=10.0, clock=lambda: now[0],
+                             on_fire=fired.append)
+    w.notify(step=1203)
+    now[0] = 9.0
+    assert not w._check() and not fired
+    diagnostics._scope_begin("sharded_step(psum)", 1203)
+    now[0] = 11.0
+    assert w._check()
+    assert w.fired == 1
+    assert "stuck in sharded_step(psum)" in fired[0]
+    assert "@ step 1203" in fired[0]
+    # one fire per stall: quiet until the next step re-arms it
+    now[0] = 50.0
+    assert not w._check() and w.fired == 1
+    w.notify(step=1204)
+    now[0] = 70.0
+    assert w._check() and w.fired == 2
+    diagnostics._scope_end()
+
+
+def test_watchdog_thread_fires_and_disarms(tmp_path):
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    fired = threading.Event()
+    w = diagnostics.arm_watchdog(deadline_s=0.05, interval=0.01,
+                                 on_fire=lambda msg: fired.set())
+    assert w is not None
+    assert any(t.name == "mx-diagnostics-watchdog"
+               for t in threading.enumerate())
+    assert fired.wait(timeout=5.0)
+    diagnostics.disarm_watchdog()
+    time.sleep(0.05)
+    assert not any(t.name == "mx-diagnostics-watchdog"
+                   for t in threading.enumerate())
+
+
+def test_watchdog_zero_deadline_means_no_thread():
+    diagnostics.enable()
+    assert diagnostics.arm_watchdog(deadline_s=0) is None
+    assert diagnostics._watchdog is None
+
+
+def test_watchdog_default_fire_writes_postmortem_and_stacks(tmp_path):
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    now = [0.0]
+    w = diagnostics.Watchdog(deadline_s=1.0, clock=lambda: now[0])
+    w.notify(step=7)
+    now[0] = 5.0
+    assert w._check()
+    pm = json.load(open(tmp_path / "0" / "postmortem.json"))
+    assert pm["reason"] == "watchdog"
+    assert "step 7" in pm["note"]
+    assert (tmp_path / "0" / "watchdog_stacks.txt").exists()
+
+
+# -- NaN sentinel -----------------------------------------------------------
+
+def test_sentinel_check_passes_finite_and_dumps_on_nan(tmp_path):
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    assert diagnostics.sentinel_check(0.5, "loss", 1) == 0.5
+    diagnostics.record_step(1, loss=0.5)
+    with pytest.raises(diagnostics.NonFiniteError, match="loss at step 2"):
+        diagnostics.sentinel_check(float("nan"), "loss", 2)
+    pm = json.load(open(tmp_path / "0" / "postmortem.json"))
+    assert pm["reason"] == "nan"
+    assert pm["ring"][-1]["step"] == 1  # prior finite steps preserved
+
+
+def test_trainer_nan_sentinel_blocks_update(tmp_path):
+    import jax.numpy as jnp
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    mx.config.set("nan_sentinel", True)
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.array(np.ones((2, 4), np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    before = {k: np.asarray(p.data()._data).copy()
+              for k, p in net.collect_params().items()}
+    for p in net.collect_params().values():
+        g = p.grad()
+        g._data = jnp.full_like(g._data, jnp.nan)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    with pytest.raises(diagnostics.NonFiniteError, match="grad_norm"):
+        trainer.step(2)
+    # the sentinel fired BEFORE the optimizer apply: params stay finite
+    for k, p in net.collect_params().items():
+        np.testing.assert_array_equal(np.asarray(p.data()._data), before[k])
+    # ...but AFTER recording: the fatal step IS the ring's last entry
+    last = diagnostics.records("step")[-1]
+    assert last["step"] == 1 and math.isnan(last["grad_norm"])
+
+
+def test_grad_global_norm():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = nd.array(np.ones((1, 2), np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    gn = diagnostics.grad_global_norm(net.collect_params().values())
+    assert gn is not None and math.isfinite(gn) and gn > 0
+
+
+# -- memory watermarks ------------------------------------------------------
+
+def test_memory_watermarks_host_fallback():
+    marks = diagnostics.memory_watermarks()
+    host = [m for m in marks if m.get("device") == "host"]
+    assert host and host[0]["peak_rss_mb"] > 0
+
+
+def test_memory_gauges_published_when_telemetry_on():
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    try:
+        diagnostics.memory_watermarks()
+        assert telemetry.get("host_peak_rss_mb").value > 0
+    finally:
+        telemetry.disable()
+
+
+# -- post-mortem writer -----------------------------------------------------
+
+def test_dump_contents_and_overwrite(tmp_path):
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=3)
+    diagnostics.record_step(41, loss=1.0)
+    path = diagnostics.dump(reason="manual", note="probe")
+    pm = json.load(open(path))
+    assert pm["rank"] == 3 and pm["reason"] == "manual"
+    assert pm["ring"][-1]["step"] == 41
+    assert "telemetry" in pm and "config" in pm and "memory" in pm
+    assert pm["config"]["diagnostics_ring_size"]["value"] == 256
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        path2 = diagnostics.dump(reason="exception", exc_info=sys.exc_info())
+    assert path2 == path  # last dump wins, same per-rank file
+    pm = json.load(open(path))
+    assert pm["exception"]["type"] == "ValueError"
+    assert any("boom" in line for line in pm["exception"]["traceback"])
+
+
+def test_forced_crash_in_toy_train_loop_leaves_postmortem(tmp_path):
+    """A ZeroDivisionError mid-train must leave a parseable postmortem.json
+    recording the exception and the steps that completed before it."""
+    code = f"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import sys
+sys.path.insert(0, {ROOT!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, diagnostics, nd
+from mxnet_tpu.gluon import Trainer, nn
+
+diagnostics.install(diagnostics_dir={str(tmp_path)!r})
+net = nn.Dense(3, in_units=4)
+net.initialize()
+trainer = Trainer(net.collect_params(), "sgd", {{"learning_rate": 0.1}})
+x = nd.array(np.ones((2, 4), np.float32))
+for step in range(1, 4):
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)
+    if step == 3:
+        1 / 0
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180)
+    assert r.returncode != 0
+    pm = json.load(open(tmp_path / "0" / "postmortem.json"))
+    assert pm["reason"] == "exception"
+    assert pm["exception"]["type"] == "ZeroDivisionError"
+    steps = [e for e in pm["ring"] if e.get("kind") == "step"]
+    assert [e["step"] for e in steps] == [1, 2, 3]
+
+
+# -- multi-rank launch + merge (the acceptance workflow) --------------------
+
+def _write_worker(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {ROOT!r})
+from mxnet_tpu import diagnostics
+assert diagnostics.enabled()  # armed by MXNET_TPU_DIAGNOSTICS from launch.py
+rank = int(os.environ["JAX_PROCESS_ID"])
+for step in range(1, 8):
+    diagnostics.record_step(step, loss=1.0 / step + 0.01 * rank, lr=1e-3)
+    print(f"step {{step}} ok", flush=True)
+    if rank == 1 and step == 6:
+        raise RuntimeError("boom at step 6")
+""")
+    return str(script)
+
+
+def test_two_rank_launch_leaves_postmortems_and_report_names_rank1(tmp_path):
+    diag_dir = str(tmp_path / "diag")
+    worker = _write_worker(tmp_path)
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--diagnostics-dir", diag_dir, sys.executable, worker],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 1  # rank 1's exit code propagated
+
+    # [rank N] prefixes on the merged stream; raw lines tee'd per rank
+    assert "[rank 0] step 1 ok" in r.stdout
+    assert "[rank 1] step 1 ok" in r.stdout
+    log1 = open(os.path.join(diag_dir, "1", "worker.log")).read()
+    assert "step 6 ok" in log1 and "[rank" not in log1
+    assert "RuntimeError: boom at step 6" in log1
+
+    pm0 = json.load(open(os.path.join(diag_dir, "0", "postmortem.json")))
+    pm1 = json.load(open(os.path.join(diag_dir, "1", "postmortem.json")))
+    assert pm0["reason"] == "exit" and pm0["rank"] == 0
+    assert pm1["reason"] == "exception" and pm1["rank"] == 1
+    assert pm1["exception"]["type"] == "RuntimeError"
+
+    rep = subprocess.run([sys.executable, PM_REPORT, diag_dir],
+                         capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0
+    out = rep.stdout
+    assert "rank 0: clean" in out
+    assert "rank 1: CRASHED" in out and "boom at step 6" in out
+    assert "verdict:    rank 1 failed" in out
+    # last 5 step records of the failing rank (steps 2..6)
+    for step in (2, 3, 4, 5, 6):
+        assert f"step {step}" in out
+    # rank 1 died at 6 while rank 0 reached 7 → rank 1 is the straggler
+    assert "straggler:  rank 1 stopped at step 6" in out
+
+
+def test_launch_propagates_real_exit_code():
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         sys.executable, "-c",
+         "import os,sys; sys.exit(3 if os.environ['JAX_PROCESS_ID']=='1' "
+         "else 0)"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3
+
+
+def test_postmortem_report_divergence(tmp_path):
+    """A rank whose loss departs from the per-step median is named."""
+    for rank in range(3):
+        d = tmp_path / str(rank)
+        d.mkdir()
+        ring = [{"ts": float(s), "kind": "step", "step": s,
+                 "loss": 1.0 / s if rank != 2 or s < 4 else 99.0}
+                for s in range(1, 6)]
+        (d / "postmortem.json").write_text(json.dumps(
+            {"schema": 1, "rank": rank, "reason": "exit", "ring": ring}))
+    rep = subprocess.run([sys.executable, PM_REPORT, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0
+    assert "divergence: rank 2 at step 4" in rep.stdout
+    assert "all ranks exited clean" in rep.stdout
+
+
+# -- estimator integration --------------------------------------------------
+
+def test_estimator_diagnostics_handler(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (DiagnosticsHandler,
+                                                   Estimator)
+    from mxnet_tpu.gluon import loss as gloss
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    data = [(nd.array(np.ones((2, 4), np.float32)),
+             nd.array(np.zeros((2, 2), np.float32)))] * 3
+    est = Estimator(net, gloss.L2Loss(), optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.01})
+    handler = DiagnosticsHandler(diagnostics_dir=str(tmp_path),
+                                 watchdog_deadline_s=60.0)
+    est.fit(data, epochs=1, event_handlers=[handler])
+    recs = diagnostics.records("step")
+    # ONE record per batch: the handler folds the loss into the Trainer's
+    # record instead of appending a near-duplicate
+    assert [r["step"] for r in recs] == [1, 2, 3]
+    assert all(r["trainer"] == "Trainer" for r in recs)
+    assert all(isinstance(r.get("loss"), float) for r in recs)
+    assert diagnostics._watchdog is None  # disarmed at train_end
+
+
+def test_sentinel_works_without_diagnostics_enabled(tmp_path):
+    """nan_sentinel alone (diagnostics off) must still catch the NaN —
+    the knob is not a silent no-op."""
+    import jax.numpy as jnp
+    mx.config.set("nan_sentinel", True)
+    mx.config.set("diagnostics_dir", str(tmp_path))
+    try:
+        assert not diagnostics.enabled()
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        x = nd.array(np.ones((2, 4), np.float32))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        for p in net.collect_params().values():
+            g = p.grad()
+            g._data = jnp.full_like(g._data, jnp.nan)
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+        with pytest.raises(diagnostics.NonFiniteError):
+            trainer.step(2)
+        pm = json.load(open(tmp_path / "0" / "postmortem.json"))
+        assert pm["reason"] == "nan"
+    finally:
+        mx.config.reset("diagnostics_dir")
+
+
+def test_sentinel_stands_down_under_scaling_amp():
+    """A scaling AMP loss scaler owns Inf-grad handling (overflow-skip);
+    the sentinel must not turn that routine event into a fatal error."""
+    import jax.numpy as jnp
+    mx.config.set("nan_sentinel", True)
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.array(np.ones((2, 4), np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for p in net.collect_params().values():
+        g = p.grad()
+        g._data = jnp.full_like(g._data, jnp.inf)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+
+    class _Scaler:
+        loss_scale = 1024.0
+        _pending_unscaled = False
+
+        def has_overflow(self, params):
+            return True
+
+        def update_scale(self, overflow):
+            pass
+
+    trainer._amp_loss_scaler = _Scaler()
+    trainer.step(2)  # overflow-skip, no NonFiniteError
+
+
+def test_scope_cleared_when_step_raises():
+    diagnostics.enable()
+    with pytest.raises(RuntimeError):
+        with diagnostics.scope("doomed", step=9):
+            assert diagnostics._current_scope[0] == "doomed"
+            raise RuntimeError("mid-step failure")
+    assert diagnostics._current_scope[0] == ""
+
+
+def test_postmortem_report_two_rank_divergence_is_ambiguous(tmp_path):
+    """Two disagreeing finite ranks cannot name a culprit — the report
+    says so instead of coin-flipping."""
+    for rank, loss in ((0, 0.5), (1, 1.0)):
+        d = tmp_path / str(rank)
+        d.mkdir()
+        ring = [{"ts": 1.0, "kind": "step", "step": 1, "loss": loss}]
+        (d / "postmortem.json").write_text(json.dumps(
+            {"schema": 1, "rank": rank, "reason": "exit", "ring": ring}))
+    rep = subprocess.run([sys.executable, PM_REPORT, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0
+    assert "divergence: ranks 0, 1 at step 1" in rep.stdout
+    assert "need a third rank" in rep.stdout
+
+
+def test_recovered_watchdog_fire_still_exits_clean(tmp_path):
+    """A watchdog fire the run recovers from must not leave a stale HUNG
+    post-mortem: the exit dump wins, with the fire kept in prior_dumps."""
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    diagnostics.record_step(1, loss=1.0)
+    now = [0.0]
+    w = diagnostics.Watchdog(deadline_s=1.0, clock=lambda: now[0])
+    w.notify(step=1)
+    now[0] = 5.0
+    assert w._check()  # fires, dumps reason='watchdog'
+    diagnostics.record_step(2, loss=0.5)  # run recovers and continues
+    diagnostics._atexit_dump()
+    pm = json.load(open(tmp_path / "0" / "postmortem.json"))
+    assert pm["reason"] == "exit"
+    assert [d["reason"] for d in pm["prior_dumps"]] == ["watchdog"]
+    assert pm["ring"][-1]["step"] == 2
+    # and the report calls the rank clean, noting the recovery
+    rep = subprocess.run([sys.executable, PM_REPORT, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert "rank 0: clean" in rep.stdout
+    assert "recovered from earlier watchdog" in rep.stdout
+
+
+def test_watchdog_thread_survives_a_failing_check(tmp_path):
+    """One bad poll (e.g. a transient dump error) must not kill the
+    watchdog thread."""
+    diagnostics.install(diagnostics_dir=str(tmp_path), rank=0)
+    calls = []
+
+    def flaky(msg):
+        calls.append(msg)
+        if len(calls) == 1:
+            raise RuntimeError("transient")
+
+    w = diagnostics.arm_watchdog(deadline_s=0.03, interval=0.01,
+                                 on_fire=flaky)
+    deadline = time.monotonic() + 5.0
+    while len(calls) < 2 and time.monotonic() < deadline:
+        w.notify(step=len(calls))  # re-arm so it can fire again
+        time.sleep(0.05)
+    diagnostics.disarm_watchdog()
+    assert len(calls) >= 2  # fired again after the first check raised

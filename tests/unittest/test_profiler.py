@@ -68,3 +68,54 @@ def test_bad_config_key_raises():
         assert "bogus" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_chrome_trace_events_well_formed(tmp_path):
+    """Every dumped event — scopes, markers, counters, and the telemetry
+    Counter mirror — must be a valid chrome://tracing record: ph/ts/pid
+    present, X durations non-negative, and the file JSON round-trips."""
+    from mxnet_tpu import telemetry
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    profiler.dump()  # drain events from earlier tests
+    telemetry.reset()
+    telemetry.enable()
+    profiler.start()
+    try:
+        with profiler.Scope("outer"):
+            with profiler.Scope("inner"):
+                time.sleep(0.001)
+        profiler.Domain("d").new_marker("mark").mark()
+        c = profiler.Domain("d").new_counter("depth", 1)
+        c.increment()
+        # telemetry counter/gauge updates mirror in as 'C' events
+        telemetry.counter("t_trace_probe_total").inc(2)
+        telemetry.gauge("t_trace_probe_depth").set(5)
+        telemetry.histogram("t_trace_probe_seconds").observe(0.1)
+    finally:
+        profiler.stop()
+        telemetry.disable()
+
+    path = profiler.dump()
+    text = open(path).read()
+    trace = json.loads(text)                      # valid JSON
+    assert json.loads(json.dumps(trace)) == trace  # round-trips
+    events = trace["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "outer" in names and "inner" in names and "mark" in names
+    assert "t_trace_probe_total" in names and "t_trace_probe_depth" in names
+    for e in events:
+        assert isinstance(e.get("name"), str) and e["name"]
+        assert e.get("ph") in ("X", "B", "E", "i", "C", "M")
+        assert isinstance(e.get("ts"), (int, float)) and e["ts"] >= 0
+        assert isinstance(e.get("pid"), int)
+        if e["ph"] == "X":
+            assert isinstance(e.get("dur"), (int, float)) and e["dur"] >= 0
+            assert isinstance(e.get("tid"), int)
+        if e["ph"] == "i":
+            assert e.get("s") in ("p", "g", "t")
+        if e["ph"] == "C":
+            args = e.get("args")
+            assert isinstance(args, dict) and e["name"] in args
+            assert isinstance(args[e["name"]], (int, float))
+    mirrors = [e for e in events if e["name"] == "t_trace_probe_total"]
+    assert mirrors and mirrors[-1]["args"]["t_trace_probe_total"] == 2.0

@@ -307,3 +307,32 @@ def test_estimator_telemetry_handler_throughput():
     assert len(telemetry.events("step")) == 3
     assert telemetry.histogram("fit_batch_seconds").count == 3
     assert any("samples/s" in l for l in logs if "epoch" in l)
+
+
+def test_report_cli_merges_multiple_rank_files(tmp_path):
+    """Several JSONL files (one per rank) get rank-labelled sections plus
+    a merged cross-rank summary; missing fields and malformed lines are
+    tolerated, not fatal."""
+    for rank, durs in ((0, (0.010, 0.012)), (1, (0.050, 0.090))):
+        d = tmp_path / str(rank)
+        d.mkdir()
+        with open(d / "run.jsonl", "w") as f:
+            for dur in durs:
+                f.write(json.dumps({"ts": 1.0, "kind": "step",
+                                    "dur_s": dur}) + "\n")
+            f.write(json.dumps({"kind": "step"}) + "\n")       # no dur_s
+            f.write(json.dumps({"no_kind": True}) + "\n")      # no kind
+            f.write("{half-written junk\n")                    # bad JSON
+            f.write(json.dumps({"kind": "snapshot",
+                                "metrics": {}}) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "telemetry_report.py"),
+         str(tmp_path / "0" / "run.jsonl"), str(tmp_path / "1" / "run.jsonl")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "telemetry report [rank 0]" in out
+    assert "telemetry report [rank 1]" in out
+    assert "merged summary: 2 ranks" in out
+    assert "rank 0: 2 steps" in out
+    assert "slowest by p99: rank 1" in out

@@ -13,13 +13,21 @@ XLA collectives replace the parameter server. So this launcher:
     JAX_PROCESS_ID (read by `jax.distributed.initialize()` and by
     `mxnet_tpu.parallel.init_distributed()`),
   * also exports the DMLC_* names so reference scripts that inspect
-    `kv.rank` / `kv.num_workers` keep working.
+    `kv.rank` / `kv.num_workers` keep working,
+  * prefixes every worker output line with `[rank N]` so interleaved
+    multi-rank logs stay attributable, and — with `--diagnostics-dir` —
+    tees each worker's raw output to `<dir>/<rank>/worker.log` and points
+    `mx.diagnostics` at `<dir>` so crashes leave
+    `<dir>/<rank>/postmortem.json` (merge with tools/postmortem_report.py),
+  * exits with the FIRST nonzero worker exit code (by rank) instead of
+    flattening every failure to 1.
 
 `-s` (servers) is accepted and ignored with a warning: there are no
 parameter servers on TPU (SURVEY.md §2.5).
 
 Usage:
   python tools/launch.py -n 4 --launcher local python train.py
+  python tools/launch.py -n 2 --diagnostics-dir diag python train.py
   python tools/launch.py -n 2 -H hosts.txt --launcher ssh python train.py
 """
 from __future__ import annotations
@@ -29,9 +37,10 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 
 
-def build_env(rank, num_workers, coordinator):
+def build_env(rank, num_workers, coordinator, diagnostics_dir=None):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -47,14 +56,68 @@ def build_env(rank, num_workers, coordinator):
         "DMLC_PS_ROOT_URI": coordinator.split(":")[0],
         "DMLC_PS_ROOT_PORT": coordinator.split(":")[1],
     })
+    if diagnostics_dir:
+        # arm mx.diagnostics in every worker: the module appends /<rank>
+        # (from JAX_PROCESS_ID) so ranks never clobber each other's dumps
+        env["MXNET_TPU_DIAGNOSTICS"] = "1"
+        env["MXNET_TPU_DIAGNOSTICS_DIR"] = diagnostics_dir
     return env
 
 
-def launch_local(num_workers, command, coordinator):
-    procs = []
+def _pump(stream, rank, tee_file):
+    """Forward one worker's merged stdout/stderr line-by-line, prefixed
+    with its rank; raw (unprefixed) lines tee into the per-rank log."""
+    prefix = f"[rank {rank}] "
+    for line in stream:
+        sys.stdout.write(prefix + line)
+        sys.stdout.flush()
+        if tee_file is not None:
+            tee_file.write(line)
+            tee_file.flush()
+    stream.close()
+    if tee_file is not None:
+        tee_file.close()
+
+
+def _spawn(command, env, rank, diagnostics_dir, extra_args=()):
+    tee = None
+    if diagnostics_dir:
+        rank_dir = os.path.join(diagnostics_dir, str(rank))
+        os.makedirs(rank_dir, exist_ok=True)
+        tee = open(os.path.join(rank_dir, "worker.log"), "w")
+    proc = subprocess.Popen(
+        list(extra_args) + list(command), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, errors="replace", bufsize=1)
+    pump = threading.Thread(target=_pump, args=(proc.stdout, rank, tee),
+                            daemon=True)
+    pump.start()
+    return proc, pump
+
+
+def _reap(procs, pumps):
+    """Wait for every worker; return the first nonzero exit code by rank
+    (the acceptance contract: a CI wrapper sees the real failure code,
+    not a flattened 1)."""
+    codes = [p.wait() for p in procs]
+    for t in pumps:
+        t.join(timeout=5.0)
+    first_bad = 0
+    for rank, code in enumerate(codes):
+        if code != 0:
+            print(f"worker {rank} exited with code {code}", file=sys.stderr)
+            if first_bad == 0:
+                first_bad = code
+    return first_bad
+
+
+def launch_local(num_workers, command, coordinator, diagnostics_dir=None):
+    procs, pumps = [], []
     for rank in range(num_workers):
-        env = build_env(rank, num_workers, coordinator)
-        procs.append(subprocess.Popen(command, env=env))
+        env = build_env(rank, num_workers, coordinator, diagnostics_dir)
+        proc, pump = _spawn(command, env, rank, diagnostics_dir)
+        procs.append(proc)
+        pumps.append(pump)
 
     def _kill(*_):
         for p in procs:
@@ -63,30 +126,30 @@ def launch_local(num_workers, command, coordinator):
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    codes = [p.wait() for p in procs]
-    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
-    if bad:
-        for i, c in bad:
-            print(f"worker {i} exited with code {c}", file=sys.stderr)
-        return 1
-    return 0
+    return _reap(procs, pumps)
 
 
-def launch_ssh(hosts, num_workers, command, coordinator, username=None):
-    procs = []
+def launch_ssh(hosts, num_workers, command, coordinator, username=None,
+               diagnostics_dir=None):
+    procs, pumps = [], []
     for rank in range(num_workers):
         host = hosts[rank % len(hosts)]
         target = f"{username}@{host}" if username else host
-        env = build_env(rank, num_workers, coordinator)
+        env = build_env(rank, num_workers, coordinator, diagnostics_dir)
         exports = " ".join(
             f"{k}={v!r}" for k, v in env.items()
-            if k.startswith(("JAX_", "DMLC_")))
+            if k.startswith(("JAX_", "DMLC_", "MXNET_TPU_")))
         remote_cmd = f"cd {os.getcwd()!r} && env {exports} " + \
             " ".join(command)
-        procs.append(subprocess.Popen(
-            ["ssh", "-o", "StrictHostKeyChecking=no", target, remote_cmd]))
-    codes = [p.wait() for p in procs]
-    return 1 if any(codes) else 0
+        # the per-rank worker.log tees the ssh-forwarded output on THIS
+        # host; the remote-side postmortem.json still lands on the remote
+        # filesystem (collect with scp before merging)
+        proc, pump = _spawn(
+            [remote_cmd], env, rank, diagnostics_dir,
+            extra_args=["ssh", "-o", "StrictHostKeyChecking=no", target])
+        procs.append(proc)
+        pumps.append(pump)
+    return _reap(procs, pumps)
 
 
 def main(argv=None):
@@ -101,6 +164,10 @@ def main(argv=None):
     p.add_argument("--coordinator", default="127.0.0.1:9876",
                    help="host:port for jax.distributed coordination")
     p.add_argument("--username", default=None)
+    p.add_argument("--diagnostics-dir", default=None,
+                   help="arm mx.diagnostics in every worker and tee each "
+                        "worker's output to <dir>/<rank>/worker.log; "
+                        "crashes leave <dir>/<rank>/postmortem.json")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
@@ -117,8 +184,10 @@ def main(argv=None):
         with open(args.hostfile) as f:
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
-                          args.coordinator, args.username)
-    return launch_local(args.num_workers, args.command, args.coordinator)
+                          args.coordinator, args.username,
+                          args.diagnostics_dir)
+    return launch_local(args.num_workers, args.command, args.coordinator,
+                        args.diagnostics_dir)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Merge per-rank mx.diagnostics post-mortem dumps into one verdict.
+
+    python tools/postmortem_report.py diagnostics_dir
+    python tools/postmortem_report.py rank0/postmortem.json rank1/postmortem.json
+
+Given a diagnostics dir (as written by `tools/launch.py --diagnostics-dir`:
+`<dir>/<rank>/postmortem.json`) or explicit dump files, prints:
+
+  * per-rank status (clean exit / exception / watchdog fire / NaN), last
+    recorded step, and the crashing exception,
+  * the FAILING rank(s) with their last step records from the flight
+    recorder — the first thing to read after a dead multi-host job,
+  * step-timeline alignment across ranks: the straggler (lowest last
+    step — in a hung collective the rank every other rank is waiting on)
+    and the diverging rank (loss departing from the per-step median, or
+    going non-finite first).
+
+Reads only the stdlib so it runs anywhere the dumps land (no jax import).
+"""
+import json
+import math
+import os
+import sys
+
+LAST_N_STEPS = 5
+
+
+def find_dumps(args):
+    """[(rank, path)] from a diagnostics dir or explicit dump paths."""
+    out = []
+    for arg in args:
+        if os.path.isdir(arg):
+            for name in sorted(os.listdir(arg), key=lambda s: (len(s), s)):
+                if not name.isdigit():
+                    continue
+                path = os.path.join(arg, name, "postmortem.json")
+                if os.path.exists(path):
+                    out.append((int(name), path))
+        else:
+            out.append((None, arg))
+    return out
+
+
+def _rank_key(label):
+    """Sort helper: numeric rank order for digit labels, stable otherwise."""
+    s = str(label)
+    return (len(s), s)
+
+
+def load_dumps(found):
+    """{rank_label: pm}. Labels are strings; two dumps carrying the same
+    embedded rank (e.g. two single-process runs, both rank 0) stay
+    distinct as '0', '0#2', ... instead of silently overwriting."""
+    dumps = {}
+    for rank, path in found:
+        try:
+            with open(path) as f:
+                pm = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        r = pm.get("rank", rank)
+        r = rank if r is None else r
+        label = str(r if r is not None else len(dumps))
+        if label in dumps:
+            n = 2
+            while f"{label}#{n}" in dumps:
+                n += 1
+            print(f"warning: duplicate rank {label} in {path}; "
+                  f"labelling it {label}#{n}", file=sys.stderr)
+            label = f"{label}#{n}"
+        dumps[label] = pm
+    return dumps
+
+
+def _steps(pm):
+    """This rank's step records (flight-recorder ring, step kind only)."""
+    return [e for e in pm.get("ring", []) if e.get("kind") == "step"
+            and isinstance(e.get("step"), (int, float))]
+
+
+def _last_step(pm):
+    steps = _steps(pm)
+    return max((int(e["step"]) for e in steps), default=None)
+
+
+def _status(pm):
+    reason = pm.get("reason", "?")
+    if reason == "exception":
+        exc = pm.get("exception", {})
+        return "CRASHED", f"{exc.get('type', '?')}: {exc.get('message', '')}"
+    if reason == "nan":
+        return "NAN", pm.get("note", "non-finite value")
+    if reason == "watchdog":
+        return "HUNG", pm.get("note", "watchdog fired")
+    if reason == "exit":
+        prior = {d.get("reason") for d in pm.get("prior_dumps", [])}
+        flagged = sorted(prior & {"watchdog", "nan"})
+        if flagged:
+            return "clean", f"(recovered from earlier {'+'.join(flagged)})"
+        return "clean", ""
+    return reason, pm.get("note", "")
+
+
+def _fmt_record(e):
+    bits = [f"step {int(e['step'])}"]
+    for key, fmt in (("loss", "loss={:.6g}"), ("lr", "lr={:.4g}"),
+                     ("grad_norm", "grad_norm={:.6g}")):
+        v = e.get(key)
+        if isinstance(v, (int, float)):
+            bits.append(fmt.format(v))
+    if e.get("scope"):
+        bits.append(f"scope={e['scope']}")
+    if e.get("compiled"):
+        bits.append("compiled")
+    return "  ".join(bits)
+
+
+def align_steps(dumps):
+    """{step: {rank: loss}} for steps where a loss was recorded."""
+    timeline = {}
+    for rank, pm in dumps.items():
+        for e in _steps(pm):
+            loss = e.get("loss")
+            if isinstance(loss, (int, float)):
+                timeline.setdefault(int(e["step"]), {})[rank] = loss
+    return timeline
+
+
+def _median(values):
+    s = sorted(values)
+    mid = len(s) // 2
+    return s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def diverging_rank(timeline, rel_tol=0.05):
+    """(ranks, step, detail) of the first per-step loss divergence: a rank
+    whose loss goes non-finite, or departs from the OTHER ranks' median
+    (leave-one-out, so the outlier can't drag its own reference) by more
+    than rel_tol; earliest step wins. `ranks` is a list — with exactly two
+    disagreeing ranks no single culprit can be named, so both are
+    returned. None when ranks agree."""
+    for step in sorted(timeline):
+        by_rank = timeline[step]
+        if len(by_rank) < 2:
+            continue
+        for rank, loss in sorted(by_rank.items(), key=lambda kv: _rank_key(kv[0])):
+            if not math.isfinite(loss):
+                return [rank], step, f"loss {loss} (non-finite)"
+        devs = {}
+        for rank, loss in by_rank.items():
+            m = _median([l for r, l in by_rank.items() if r != rank])
+            devs[rank] = (abs(loss - m) / max(abs(m), 1e-12), m)
+        worst = max(sorted(devs, key=_rank_key), key=lambda r: devs[r][0])
+        rel, m = devs[worst]
+        if rel > rel_tol:
+            if len(by_rank) == 2:
+                # two disagreeing finite losses carry no majority: naming
+                # either rank would be a coin flip that sends the operator
+                # to the wrong host
+                pair = sorted(by_rank, key=_rank_key)
+                return pair, step, (
+                    "losses disagree "
+                    f"({', '.join(f'{by_rank[r]:.6g}' for r in pair)}) — "
+                    "need a third rank to name the culprit")
+            return [worst], step, (f"loss {by_rank[worst]:.6g} vs others' "
+                                   f"median {m:.6g}")
+    return None
+
+
+def report(args):
+    found = find_dumps(args)
+    if not found:
+        return f"no postmortem.json dumps under {' '.join(args)}"
+    dumps = load_dumps(found)
+    if not dumps:
+        return "no readable postmortem dumps"
+    lines = [f"post-mortem report: {len(dumps)} rank(s)", "=" * 60]
+
+    failing = []
+    for rank in sorted(dumps, key=_rank_key):
+        pm = dumps[rank]
+        status, detail = _status(pm)
+        last = _last_step(pm)
+        line = f"rank {rank}: {status:<8} last step {last}"
+        if detail:
+            line += f"  {detail}"
+        lines.append(line)
+        if status != "clean":
+            failing.append(rank)
+
+    # -- failing rank detail ---------------------------------------------
+    for rank in failing:
+        pm = dumps[rank]
+        lines.append("")
+        lines.append(f"rank {rank} — last {LAST_N_STEPS} step records:")
+        for e in _steps(pm)[-LAST_N_STEPS:]:
+            lines.append("  " + _fmt_record(e))
+        exc = pm.get("exception")
+        if exc and exc.get("traceback"):
+            tail = "".join(exc["traceback"]).strip().splitlines()
+            lines.append("  traceback (last 3 lines):")
+            for t in tail[-3:]:
+                lines.append("    " + t)
+
+    # -- cross-rank timeline ---------------------------------------------
+    lines.append("")
+    last_by_rank = {r: _last_step(pm) for r, pm in dumps.items()}
+    known = {r: s for r, s in last_by_rank.items() if s is not None}
+    if len(known) >= 2:
+        lo = min(known, key=known.get)
+        hi = max(known, key=known.get)
+        if known[lo] != known[hi]:
+            lines.append(
+                f"straggler:  rank {lo} stopped at step {known[lo]} while "
+                f"rank {hi} reached {known[hi]} — in a hung collective the "
+                f"other ranks are waiting on rank {lo}")
+        else:
+            lines.append(
+                f"timeline:   all ranks reached step {known[hi]} (aligned)")
+    div = diverging_rank(align_steps(dumps))
+    if div is not None:
+        ranks, step, detail = div
+        who = f"rank {ranks[0]}" if len(ranks) == 1 \
+            else "ranks " + ", ".join(str(r) for r in ranks)
+        lines.append(f"divergence: {who} at step {step}: {detail}")
+
+    if failing:
+        lines.append("")
+        lines.append(f"verdict:    rank {failing[0]} failed first-by-rank "
+                     f"({_status(dumps[failing[0]])[0]})"
+                     + (f"; also failing: {failing[1:]}"
+                        if len(failing) > 1 else ""))
+    else:
+        lines.append("")
+        lines.append("verdict:    all ranks exited clean")
+    return "\n".join(lines)
+
+
+def main(argv):
+    if len(argv) >= 2 and argv[1] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if len(argv) < 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    print(report(argv[1:]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

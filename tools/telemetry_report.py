@@ -1,18 +1,27 @@
 #!/usr/bin/env python
-"""Summarize one telemetry JSONL run (mx.telemetry.dump_jsonl output, or a
-telemetry_jsonl_path auto-flush file).
+"""Summarize telemetry JSONL runs (mx.telemetry.dump_jsonl output, or
+telemetry_jsonl_path auto-flush files).
 
     python tools/telemetry_report.py run.jsonl
+    python tools/telemetry_report.py diag/0/run.jsonl diag/1/run.jsonl
 
-Prints: recompile count with per-event causes, step-time p50/p99,
+With several files (one per rank — e.g. each worker pointing
+telemetry_jsonl_path into its tools/launch.py rank dir), every file gets a
+rank-labelled section plus a cross-rank summary naming the slowest rank by
+step p99. Rank labels come from the nearest all-digit path component
+(`diag/3/run.jsonl` → rank 3), falling back to argument order.
+
+Per file prints: recompile count with per-event causes, step-time p50/p99,
 collective/kvstore bytes moved, and the input-stall fraction (time blocked
 on the input pipeline as a share of run time) — the triage order for a slow
 TPU training run: recompiling? input-bound? comms-bound? only then look at
 the kernels (mx.profiler / jax.profiler).
 
-Reads only the stdlib so it runs anywhere the JSONL lands (no jax import).
+Reads only the stdlib so it runs anywhere the JSONL lands (no jax import);
+malformed lines and records with missing fields are skipped, not fatal.
 """
 import json
+import os
 import sys
 
 
@@ -23,7 +32,12 @@ def load(path):
             line = line.strip()
             if not line:
                 continue
-            ev = json.loads(line)
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # half-written line from a crashed flush
+            if not isinstance(ev, dict):
+                continue
             if ev.get("kind") == "snapshot":
                 snapshot = ev.get("metrics", {})  # last snapshot wins
             else:
@@ -66,13 +80,15 @@ def fmt_bytes(n):
     return f"{n:.1f} TiB"
 
 
-def report(path):
-    events, snapshot = load(path)
-    lines = [f"telemetry report: {path}", "=" * 60]
+def report(path, label=None, data=None):
+    events, snapshot = data if data is not None else load(path)
+    title = f"telemetry report: {path}" if label is None \
+        else f"telemetry report [{label}]: {path}"
+    lines = [title, "=" * 60]
 
     # -- compiles / recompiles -------------------------------------------
-    compiles = [e for e in events if e["kind"] == "compile"]
-    recompiles = [e for e in events if e["kind"] == "recompile"]
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    recompiles = [e for e in events if e.get("kind") == "recompile"]
     compile_s = _metric_sum(snapshot, "compile_seconds")
     lines.append(f"compiles:   {len(compiles)} first-time, "
                  f"{len(recompiles)} recompiles, "
@@ -80,11 +96,12 @@ def report(path):
     for e in recompiles:
         causes = "; ".join(e.get("causes", [])) or "unknown"
         lines.append(f"  recompile {e.get('block', '?')}: {causes} "
-                     f"({e.get('compile_time_s', 0):.2f}s)")
+                     f"({(e.get('compile_time_s') or 0):.2f}s)")
 
     # -- step time --------------------------------------------------------
     steps = [e["dur_s"] for e in events
-             if e["kind"] == "step" and "dur_s" in e]
+             if e.get("kind") == "step"
+             and isinstance(e.get("dur_s"), (int, float))]
     if steps:
         p50, p99 = percentile(steps, 50), percentile(steps, 99)
         lines.append(f"steps:      {len(steps)}  "
@@ -123,14 +140,64 @@ def report(path):
     return "\n".join(lines)
 
 
+def _rank_label(path, ordinal):
+    """Nearest all-digit path component (launch.py's <dir>/<rank>/ layout),
+    else the argument position."""
+    for part in reversed(os.path.normpath(os.path.dirname(path)).split(os.sep)):
+        if part.isdigit():
+            return f"rank {int(part)}"
+    return f"rank {ordinal}"
+
+
+def _step_stats(events):
+    steps = [e["dur_s"] for e in events
+             if e.get("kind") == "step"
+             and isinstance(e.get("dur_s"), (int, float))]
+    recompiles = sum(1 for e in events if e.get("kind") == "recompile")
+    return steps, recompiles
+
+
+def report_merged(paths):
+    """Per-file sections labelled by rank, plus the cross-rank summary:
+    step counts, per-rank p99, and the slowest rank (the straggler
+    candidate before reaching for tools/postmortem_report.py). Each file
+    is parsed once and shared by its section and the summary."""
+    labels = [_rank_label(p, i) for i, p in enumerate(paths)]
+    loaded = [load(p) for p in paths]
+    sections = [report(p, label=l, data=d)
+                for p, l, d in zip(paths, labels, loaded)]
+
+    lines = [f"merged summary: {len(paths)} ranks", "=" * 60]
+    slowest = None
+    for (events, _), label in zip(loaded, labels):
+        steps, recompiles = _step_stats(events)
+        if steps:
+            p50, p99 = percentile(steps, 50), percentile(steps, 99)
+            lines.append(f"  {label}: {len(steps)} steps  "
+                         f"p50 {p50 * 1e3:.2f} ms  p99 {p99 * 1e3:.2f} ms  "
+                         f"{recompiles} recompiles")
+            if slowest is None or p99 > slowest[1]:
+                slowest = (label, p99)
+        else:
+            lines.append(f"  {label}: no step events  "
+                         f"{recompiles} recompiles")
+    if slowest is not None and len(paths) > 1:
+        lines.append(f"  slowest by p99: {slowest[0]} "
+                     f"({slowest[1] * 1e3:.2f} ms)")
+    return "\n\n".join(sections + ["\n".join(lines)])
+
+
 def main(argv):
-    if len(argv) == 2 and argv[1] in ("-h", "--help"):
+    if len(argv) >= 2 and argv[1] in ("-h", "--help"):
         print(__doc__.strip())
         return 0
-    if len(argv) != 2:
+    if len(argv) < 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    print(report(argv[1]))
+    if len(argv) == 2:
+        print(report(argv[1]))
+    else:
+        print(report_merged(argv[1:]))
     return 0
 
 
