@@ -37,6 +37,38 @@ assert c.value == 0 and h.count == 0, 'disabled metric still counted'
 assert telemetry.events() == [], 'disabled fast path allocated events'
 print('telemetry disabled fast path OK')
 "
+    # the async sharded-step hot path with telemetry+diagnostics disabled
+    # must be fence-free and transfer-free: zero block_until_ready, zero
+    # device_put (batches pre-staged by prefetch_to_mesh are reused as-is),
+    # zero host->device scalar conversions (t/lr live on device / in-jit)
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np, jax, jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, dataflow, telemetry, diagnostics
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not telemetry.enabled() and not diagnostics.enabled()
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+batches = list(dataflow.prefetch_to_mesh(iter([([x], [y])] * 6), tr, depth=2))
+tr.step_async(*batches[0])   # compile outside the counted window
+counts = {'fence': 0, 'device_put': 0, 'asarray': 0}
+real = (jax.block_until_ready, jax.device_put, jnp.asarray)
+jax.block_until_ready = lambda v: (counts.__setitem__('fence', counts['fence'] + 1), real[0](v))[1]
+jax.device_put = lambda *a, **k: (counts.__setitem__('device_put', counts['device_put'] + 1), real[1](*a, **k))[1]
+jnp.asarray = lambda *a, **k: (counts.__setitem__('asarray', counts['asarray'] + 1), real[2](*a, **k))[1]
+try:
+    for d, l in batches[1:]:
+        tr.step_async(d, l)
+finally:
+    jax.block_until_ready, jax.device_put, jnp.asarray = real
+assert counts == {'fence': 0, 'device_put': 0, 'asarray': 0}, counts
+print('async step disabled fast path OK (no fence, no transfers)')
+"
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "

@@ -54,6 +54,7 @@ _LAZY = {
     "profiler": ".profiler",
     "telemetry": ".telemetry",
     "diagnostics": ".diagnostics",
+    "dataflow": ".dataflow",
     "parallel": ".parallel",
     "test_utils": ".test_utils",
     "lr_scheduler": ".lr_scheduler",

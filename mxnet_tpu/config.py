@@ -180,6 +180,36 @@ register_option(
     "post-mortem, then re-arms on the next step). 0 disables the "
     "watchdog thread entirely.")
 register_option(
+    "compile_cache_dir", "",
+    "Directory for jax's persistent XLA compilation cache, wired at first "
+    "trainer construction (mx.dataflow.ensure_compile_cache). Relaunches "
+    "then skip cold compiles: executables serialize to disk and reload in "
+    "milliseconds. Empty disables persistence. Cache hits/misses land in "
+    "the compile_cache_hits_total / compile_cache_misses_total telemetry "
+    "counters (tools/telemetry_report.py separates warm from cold "
+    "compiles).")
+register_option(
+    "trainer_async_fence_every", 0,
+    "Host-fence the trainers every N steps (block_until_ready on the "
+    "step's loss / updated params) to bound how far dispatch runs ahead "
+    "of the device. 0 (default) never fences on the hot path — the fence "
+    "then only happens on an explicit .item()/asscalar() or when "
+    "telemetry/nan_sentinel (which document that they fence) are "
+    "enabled.")
+register_option(
+    "device_prefetch_depth", 2,
+    "Batches mx.dataflow.prefetch_to_mesh stages onto the mesh ahead of "
+    "the consumer (H2D transfer overlaps device compute). Also the depth "
+    "the Estimator uses when fit() is handed a gluon DataLoader. 0 "
+    "disables device-side prefetch in the estimator.")
+register_option(
+    "bucket_pad_min", 32,
+    "Smallest bucket mx.dataflow.BucketPad rounds a varlen axis up to "
+    "under the default power-of-two policy; explicit axis_buckets lists "
+    "override it. Bounds the jit-cache population for varlen workloads "
+    "(padding overhead is visible in the bucket_pad_waste_ratio "
+    "histogram).")
+register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "
     "the loss (ShardedTrainer/estimator DiagnosticsHandler) or global "

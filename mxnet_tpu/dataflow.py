@@ -1,0 +1,478 @@
+"""mx.dataflow — the input-to-device performance layer.
+
+The reference framework hid host-side input work behind device compute with
+an async `PrefetcherIter` (`src/io/iter_prefetcher.h`, SURVEY §2.1): a
+background thread stages the *next* batches while the current one trains.
+The TPU-native equivalent staged here is stronger — batches are not just
+decoded ahead of time, they are already mesh-sharded `jax.Array`s by the
+time the train step sees them, so the H2D transfer itself overlaps device
+compute instead of serializing with it:
+
+  * `prefetch_to_mesh(it, trainer, depth=2)` — background thread converts
+    host batches (numpy / NDArray trees) into sharded device arrays for the
+    next `depth` steps using the trainer's own batch shardings (including
+    data_specs/label_specs overrides); worker exceptions surface at
+    `next()` with their original traceback; the thread shuts down cleanly
+    on close()/GC/partial iteration.
+  * `BucketPad(axis_buckets=...)` — pads varlen batches up to configured or
+    power-of-two buckets (pairing each pad with a valid-length input) so a
+    stream of novel sequence lengths compiles a handful of executables
+    instead of one per length.
+  * `ensure_compile_cache()` — wires jax's persistent XLA compilation cache
+    from the `compile_cache_dir` knob at first trainer construction, so
+    relaunches skip cold compiles entirely.
+
+Telemetry (all series degrade to a module-bool check when disabled):
+`dataloader_prefetch_depth{stage="device"}` (staged-batch depth, distinct
+from the host DataLoader's series so input-stall attribution can name the
+bottleneck stage), `device_prefetch_wait_seconds` (consumer blocked on
+staging), `h2d_bytes_total` (payload staged onto the mesh), and
+`bucket_pad_waste_ratio` (padding overhead next to the recompiles it
+eliminates).
+"""
+from __future__ import annotations
+
+import math
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from . import config as _config
+from . import telemetry as _telemetry
+
+__all__ = ["prefetch_to_mesh", "MeshPrefetcher", "BucketPad",
+           "ensure_compile_cache"]
+
+_M_DEPTH = _telemetry.gauge(
+    "dataloader_prefetch_depth", "batches buffered ahead of the consumer "
+    "(0 while the consumer is starved = input-bound); fanned out by stage: "
+    "host (DataLoader worker batches) vs device (mesh-staged arrays)")
+_M_STAGE_WAIT = _telemetry.histogram(
+    "device_prefetch_wait_seconds", "time the training loop spent blocked "
+    "waiting for a mesh-staged batch — the H2D-staging share of the input "
+    "stall (compare dataloader_wait_seconds for the host-batch share)")
+_M_H2D_BYTES = _telemetry.counter(
+    "h2d_bytes_total", "payload bytes staged host-to-device by "
+    "prefetch_to_mesh")
+_M_PAD_WASTE = _telemetry.histogram(
+    "bucket_pad_waste_ratio", "fraction of each BucketPad-padded batch "
+    "that is padding (0 = exact bucket fit) — the overhead bought to "
+    "bound the jit-cache population",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0))
+_M_CACHE_HITS = _telemetry.counter(
+    "compile_cache_hits_total", "compiles served from the persistent XLA "
+    "compilation cache (warm: deserialized, not rebuilt)")
+_M_CACHE_MISSES = _telemetry.counter(
+    "compile_cache_misses_total", "compiles the persistent cache could not "
+    "serve (cold: full XLA compile, then written back)")
+
+
+# ---------------------------------------------------------------------------
+# tree helpers (nested tuple/list/dict/namedtuple batches of NDArray /
+# numpy / jax arrays — jax.tree_util preserves the node types exactly, and
+# NDArray, being unregistered, is a leaf)
+# ---------------------------------------------------------------------------
+
+def _raw(leaf):
+    """Strip an NDArray wrapper down to its jax/numpy payload."""
+    from .ndarray import NDArray
+    if isinstance(leaf, NDArray):
+        return leaf._data
+    return leaf
+
+
+# ---------------------------------------------------------------------------
+# prefetch_to_mesh
+# ---------------------------------------------------------------------------
+
+class _WorkerExit(Exception):
+    """Internal: the prefetcher was closed under the worker."""
+
+
+_STOP = object()
+
+
+class MeshPrefetcher:
+    """Background-staged iterator: host batches in, mesh-sharded device
+    batches out, `depth` steps ahead of the consumer.
+
+    `shardings` may be a ShardedTrainer (its `_batch_shardings` — including
+    data_specs/label_specs overrides — decide placement; batches must then
+    be `(data, labels)` pairs), an explicit list of `jax.sharding.Sharding`
+    per leaf, or None (plain committed default-device placement — the eager
+    gluon/Estimator path). `transform` (e.g. a BucketPad) runs inside the
+    worker thread so host-side padding overlaps device compute too."""
+
+    def __init__(self, iterator, shardings=None, depth=2, transform=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q = queue.Queue(maxsize=depth)
+        self._closed = threading.Event()
+        self._exhausted = False
+        # the worker closes over locals (not self) so a consumer dropping
+        # its last reference lets __del__ run while the thread is alive
+        closed, q = self._closed, self._q
+        stage = _Stager(shardings)
+        source = iter(iterator)
+
+        def _worker():
+            try:
+                for item in source:
+                    if closed.is_set():
+                        return
+                    if transform is not None:
+                        item = transform(item)
+                    staged = stage(item)
+                    _q_put(q, staged, closed)
+                _q_put(q, _STOP, closed)
+            except _WorkerExit:
+                return
+            except BaseException as e:   # noqa: BLE001 — relayed to consumer
+                try:
+                    _q_put(q, e, closed)
+                except _WorkerExit:
+                    return
+
+        self._thread = threading.Thread(
+            target=_worker, name="mx-dataflow-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._closed.is_set():
+            raise StopIteration
+        if _telemetry._enabled:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            if item is not _STOP and not isinstance(item, BaseException):
+                # waits that produced a batch are the H2D-staging stall;
+                # waiting for the end-of-stream marker is not a stall
+                _M_STAGE_WAIT.observe(time.perf_counter() - t0)
+                _M_DEPTH.labels(stage="device").set(self._q.qsize())
+        else:
+            item = self._q.get()
+        if item is _STOP:
+            self._exhausted = True
+            self._thread.join()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._exhausted = True
+            self._thread.join()
+            # re-raise the worker's exception object: its __traceback__
+            # still points at the failing frame inside the worker
+            raise item
+        return item
+
+    def close(self):
+        """Stop the worker and release the staged batches. Idempotent;
+        called by __del__ and __exit__, safe mid-iteration. A worker
+        blocked INSIDE the source iterator's next() cannot be interrupted
+        (no thread cancellation in Python) — it is abandoned as a daemon
+        and exits at the source's next yield; the join timeout bounds how
+        long close() waits for that."""
+        self._closed.set()
+        # drain so a worker blocked on put() observes the close promptly
+        self._drain()
+        self._thread.join(timeout=5)
+        # a put already in flight during the first drain can land in the
+        # emptied queue; drain again after the join so close() really does
+        # release every staged device batch
+        self._drain()
+
+    def _drain(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _q_put(q, item, closed):
+    """Bounded put that aborts when the prefetcher closes underneath the
+    worker (the consumer stopped iterating; blocking forever would leak
+    the thread)."""
+    while not closed.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return
+        except queue.Full:
+            continue
+    raise _WorkerExit
+
+
+class _Stager:
+    """Per-batch host->mesh staging: flatten the batch tree, device_put
+    every leaf with its target sharding (one batched transfer), rebuild
+    the tree as NDArrays."""
+
+    def __init__(self, shardings):
+        self._shardings = shardings
+
+    def __call__(self, item):
+        import jax
+
+        from .ndarray import NDArray
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            item, is_leaf=lambda x: isinstance(x, NDArray))
+        raw = [_raw(x) for x in leaves]
+        targets = self._targets(item, raw)
+        if _telemetry._enabled:
+            moved = 0
+            for r, s in zip(raw, targets or [None] * len(raw)):
+                if isinstance(r, np.ndarray):
+                    moved += r.nbytes
+                elif s is not None and getattr(r, "sharding", None) != s:
+                    moved += getattr(r, "nbytes", 0)
+            if moved:
+                _M_H2D_BYTES.inc(moved)
+        if targets is None:
+            staged = [jax.device_put(r) for r in raw]
+        else:
+            staged = [r if getattr(r, "sharding", None) == t
+                      else jax.device_put(r, t)
+                      for r, t in zip(raw, targets)]
+        return jax.tree_util.tree_unflatten(
+            treedef, [NDArray(s) for s in staged])
+
+    def _targets(self, item, raw):
+        sh = self._shardings
+        if sh is None:
+            return None
+        if isinstance(sh, (list, tuple)):
+            if len(sh) != len(raw):
+                raise ValueError(
+                    f"got {len(sh)} shardings for a batch of {len(raw)} "
+                    "arrays")
+            return list(sh)
+        # a ShardedTrainer (or anything exposing _batch_shardings): batches
+        # are (data, labels) pairs; count leaves on each side
+        if hasattr(sh, "_batch_shardings"):
+            if not (isinstance(item, (tuple, list)) and len(item) == 2):
+                n_data, n_label = len(raw), 0
+            else:
+                import jax
+
+                from .ndarray import NDArray
+                n_data = len(jax.tree_util.tree_leaves(
+                    item[0], is_leaf=lambda x: isinstance(x, NDArray)))
+                n_label = len(raw) - n_data
+            shapes = tuple(tuple(getattr(r, "shape", ())) for r in raw)
+            return list(sh._batch_shardings(n_data, n_label, shapes))
+        raise TypeError(
+            "shardings must be None, a list of jax shardings, or a trainer "
+            f"with _batch_shardings; got {type(sh).__name__}")
+
+
+def prefetch_to_mesh(iterator, trainer_or_shardings=None, depth=None,
+                     transform=None):
+    """Stage batches onto the mesh `depth` steps ahead of the consumer.
+
+    Wrap any host batch iterator (a gluon DataLoader, a generator of
+    `(data, labels)` pairs) and iterate the result instead: a background
+    thread converts each batch into mesh-sharded device arrays while the
+    current step runs, so H2D transfer overlaps compute. Pass the
+    ShardedTrainer to reuse its batch shardings (data_specs/label_specs
+    included), an explicit sharding list, or None for default-device
+    placement (the eager gluon path). `transform` (e.g. `BucketPad`) runs
+    in the worker thread. Close via `close()`, a `with` block, or just
+    dropping the iterator; worker exceptions re-raise at `next()` with
+    their original traceback."""
+    if depth is None:
+        depth = _config.get("device_prefetch_depth") or 2
+    return MeshPrefetcher(iterator, trainer_or_shardings, depth=depth,
+                          transform=transform)
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+# ---------------------------------------------------------------------------
+
+class BucketPad:
+    """Pad varlen batches up to configured (or power-of-two) buckets so a
+    stream of novel raw lengths compiles a bounded set of step executables.
+
+    axis_buckets: {axis: buckets} where buckets is a sorted sequence of
+    sizes or the string "pow2" (next power of two, floored at the
+    `bucket_pad_min` knob). Default: {1: "pow2"} — the sequence axis.
+    Lengths above the largest configured bucket keep their raw size (a
+    compile per such outlier, same as unbucketed).
+
+    Each padded DATA array is paired with a valid-length input (int32,
+    shape (batch,), the raw length) appended to the data list, so masked
+    models/losses can ignore the pad; pass append_valid_length=False for
+    workloads (e.g. BERT) whose batch already carries one. Labels are
+    padded along the same axes with `label_pad_value` but never grow a
+    valid-length input.
+
+    Use per batch (`bp((data, labels))`), over an iterator (`bp.wrap(it)`),
+    or as `prefetch_to_mesh(..., transform=bp)` — there the padding happens
+    in the prefetch worker thread and overlaps device compute."""
+
+    def __init__(self, axis_buckets=None, pad_value=0, label_pad_value=0,
+                 append_valid_length=True):
+        self.axis_buckets = dict(axis_buckets) if axis_buckets else {1: "pow2"}
+        for axis, buckets in self.axis_buckets.items():
+            if buckets != "pow2":
+                bl = sorted(int(b) for b in buckets)
+                if not bl:
+                    raise ValueError(f"axis {axis}: empty bucket list")
+                self.axis_buckets[axis] = bl
+        self.pad_value = pad_value
+        self.label_pad_value = label_pad_value
+        self.append_valid_length = append_valid_length
+
+    def _bucket(self, length, buckets):
+        if buckets == "pow2":
+            floor = max(1, int(_config.get("bucket_pad_min")))
+            return max(floor, 1 << max(0, math.ceil(math.log2(max(length, 1)))))
+        for b in buckets:
+            if b >= length:
+                return b
+        return length  # above the largest bucket: keep raw (one-off compile)
+
+    def _pad_leaf(self, leaf, pad_value, collect_valid):
+        arr = _raw(leaf)
+        padded = arr
+        raw_elems = int(np.prod(arr.shape)) if arr.ndim else 1
+        valid = None
+        pads = [(0, 0)] * arr.ndim
+        grew = False
+        for axis, buckets in self.axis_buckets.items():
+            if axis >= arr.ndim:
+                continue
+            length = arr.shape[axis]
+            target = self._bucket(length, buckets)
+            if target > length:
+                pads[axis] = (0, target - length)
+                grew = True
+            if collect_valid and valid is None:
+                valid = np.full(arr.shape[0] if arr.ndim else 1, length,
+                                dtype=np.int32)
+        if grew:
+            host = np.asarray(arr)
+            padded = np.pad(host, pads, constant_values=pad_value)
+            if _telemetry._enabled:
+                _M_PAD_WASTE.observe(
+                    1.0 - raw_elems / max(int(np.prod(padded.shape)), 1))
+        elif _telemetry._enabled and any(
+                ax < arr.ndim for ax in self.axis_buckets):
+            _M_PAD_WASTE.observe(0.0)
+        return padded, (valid if grew or collect_valid else None)
+
+    def _pad_side(self, side, pad_value, collect_valid):
+        single = not isinstance(side, (list, tuple))
+        items = [side] if single else list(side)
+        out, valids = [], []
+        for leaf in items:
+            padded, valid = self._pad_leaf(leaf, pad_value, collect_valid)
+            out.append(padded)
+            if valid is not None:
+                valids.append(valid)
+        if collect_valid:
+            out.extend(valids)
+            return out
+        return out[0] if single else out
+
+    def __call__(self, batch):
+        """One batch: a `(data, labels)` pair, or a bare data array/list."""
+        if isinstance(batch, tuple) and len(batch) == 2 and any(
+                isinstance(s, (list, tuple)) or hasattr(_raw(s), "ndim")
+                for s in batch):
+            data, labels = batch
+            data = self._pad_side(data, self.pad_value,
+                                  self.append_valid_length)
+            labels = self._pad_side(labels, self.label_pad_value, False)
+            return (data, labels)
+        return self._pad_side(batch, self.pad_value, self.append_valid_length)
+
+    def wrap(self, iterator):
+        """Generator applying the pad to every batch of `iterator`."""
+        for batch in iterator:
+            yield self(batch)
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache
+# ---------------------------------------------------------------------------
+
+# None = not attempted yet (knob may still be set later); "" = attempted
+# and failed (don't retry, don't claim success); path = wired
+_cache_state = None
+_cache_lock = threading.Lock()
+
+
+def ensure_compile_cache():
+    """Wire jax's persistent compilation cache from the `compile_cache_dir`
+    knob (idempotent; called at first trainer construction). Relaunches
+    then deserialize executables instead of recompiling — the BERT-large
+    cold-compile killer. No-op when the knob is empty or the backend
+    cannot serialize executables. Returns the wired cache dir, or None
+    when the knob is empty or wiring failed."""
+    global _cache_state
+    with _cache_lock:
+        if _cache_state is not None:
+            return _cache_state or None
+        cache_dir = _config.get("compile_cache_dir")
+        if not cache_dir:
+            return None          # knob empty: stays re-armable
+        try:
+            import jax
+            cache_dir = os.path.abspath(cache_dir)
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.1)
+            _register_cache_listener()
+            _cache_state = cache_dir
+            return cache_dir
+        except Exception as e:  # pragma: no cover - backend-dependent
+            _cache_state = ""    # don't retry, and never report success
+            import warnings
+            warnings.warn(f"persistent compile cache unavailable: {e}")
+            return None
+
+
+_listener_registered = False
+
+
+def _register_cache_listener():
+    """Mirror jax's compilation-cache hit/miss monitoring events into the
+    telemetry counters, so reports can separate warm (deserialized) from
+    cold (full XLA) compiles."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_event(event, **kwargs):
+            if event == "/jax/compilation_cache/cache_hits":
+                _M_CACHE_HITS.inc()
+            elif event == "/jax/compilation_cache/cache_misses":
+                _M_CACHE_MISSES.inc()
+
+        monitoring.register_event_listener(_on_event)
+        _listener_registered = True
+    except Exception:  # pragma: no cover - older jax without monitoring
+        pass

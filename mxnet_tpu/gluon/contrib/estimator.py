@@ -353,6 +353,22 @@ class Estimator:
                 m.update([label], [out])
         return val_metrics
 
+    def _epoch_iter(self, train_data):
+        """One epoch's batch iterator. A gluon DataLoader is wrapped in
+        dataflow.prefetch_to_mesh (depth: the device_prefetch_depth knob,
+        0 disables) so batches are staged onto the device while the
+        current batch trains — H2D transfer overlaps compute. Returns
+        (iterator, closer); the closer shuts the prefetch thread down
+        even when the epoch ends early (StoppingHandler, exception)."""
+        from ... import config, dataflow
+        from ..data.dataloader import DataLoader
+        depth = config.get("device_prefetch_depth")
+        if depth and isinstance(train_data, DataLoader):
+            pf = dataflow.prefetch_to_mesh(iter(train_data), None,
+                                           depth=depth)
+            return pf, pf.close
+        return train_data, lambda: None
+
     def _handlers(self, event_handlers, epochs):
         hs = list(event_handlers or [])
         if not any(isinstance(h, StoppingHandler) for h in hs):
@@ -375,20 +391,24 @@ class Estimator:
         fire("train_begin")
         while not self.stop_training:
             fire("epoch_begin")
-            for data, label in train_data:
-                if self.stop_training:
-                    break
-                fire("batch_begin")
-                with autograd.record():
-                    out = self.net(data)
-                    loss = self.loss(out, label)
-                loss.backward()
-                self.trainer.step(data.shape[0])
-                self.last_outputs = [out]
-                self.last_labels = [label]
-                self.last_loss = loss
-                self.num_batch += 1
-                fire("batch_end")
+            epoch_iter, close_iter = self._epoch_iter(train_data)
+            try:
+                for data, label in epoch_iter:
+                    if self.stop_training:
+                        break
+                    fire("batch_begin")
+                    with autograd.record():
+                        out = self.net(data)
+                        loss = self.loss(out, label)
+                    loss.backward()
+                    self.trainer.step(data.shape[0])
+                    self.last_outputs = [out]
+                    self.last_labels = [label]
+                    self.last_loss = loss
+                    self.num_batch += 1
+                    fire("batch_end")
+            finally:
+                close_iter()
             self.num_epoch += 1
             fire("epoch_end")
             if self.max_epoch is not None \

@@ -30,11 +30,19 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 _M_WAIT = _telemetry.histogram(
     "dataloader_wait_seconds", "time the training loop spent blocked "
-    "waiting for the next batch — compare against trainer_step_seconds to "
-    "tell input-bound from compute-bound steps")
+    "waiting for the next HOST batch — compare against "
+    "trainer_step_seconds to tell input-bound from compute-bound steps, "
+    "and against device_prefetch_wait_seconds to tell host batch "
+    "production from H2D staging")
+# labeled stage="host": the device-side staging pipeline
+# (mx.dataflow.prefetch_to_mesh) reports the same gauge under
+# stage="device", so telemetry_report's input-stall attribution can name
+# WHICH pipeline stage starved the consumer
 _M_DEPTH = _telemetry.gauge(
     "dataloader_prefetch_depth", "batches buffered ahead of the consumer "
-    "(0 while the consumer is starved = input-bound)")
+    "(0 while the consumer is starved = input-bound); fanned out by stage: "
+    "host (DataLoader worker batches) vs device (mesh-staged arrays)"
+).labels(stage="host")
 
 __all__ = ["DataLoader", "default_batchify_fn", "numpy_batchify_fn",
            "in_worker"]

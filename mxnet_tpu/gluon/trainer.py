@@ -123,6 +123,13 @@ class Trainer:
         else:
             self._optimizer.rescale_grad = 1.0 / batch_size
         self._update(ignore_stale_grad)
+        fence_every = _config.get("trainer_async_fence_every")
+        if fence_every and self._num_update % int(fence_every) == 0:
+            # eager update ops dispatch async too: a periodic fence bounds
+            # how many in-flight updates (and their buffers) the host can
+            # queue ahead of the device
+            import jax
+            jax.block_until_ready([p.data()._data for p in self._params])
 
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)

@@ -100,3 +100,57 @@ class FunctionalOptimizer:
     def lr_at(self, num_update):
         o = self.opt
         return o.lr_scheduler(num_update) if o.lr_scheduler else o.lr
+
+    def lr_traced(self):
+        """A jit-traceable `f(t) -> lr` for the attached schedule, or None
+        when it cannot be expressed (a custom LRScheduler subclass).
+
+        When this returns a function, the sharded step computes lr from the
+        device-resident step counter INSIDE the jitted step — removing the
+        two per-step host->device scalar transfers (t, lr) the host-side
+        `lr_at` path pays. A constant-lr optimizer returns a closure over
+        the current `o.lr`; the step cache keys on that value so
+        `set_learning_rate` mid-run still takes effect (one warm re-jit
+        instead of a transfer every step)."""
+        from .. import lr_scheduler as _lrs
+        o = self.opt
+        sch = o.lr_scheduler
+        if sch is None:
+            base = float(o.lr)
+            return lambda t: jnp.float32(base)
+        # exact types only: a subclass may override __call__ with
+        # arbitrary host logic that would mistrace under jit
+        if type(sch) is _lrs.FactorScheduler:
+            def main(t):
+                lr = sch.base_lr * sch.factor ** jnp.floor(t / sch.step)
+                return jnp.maximum(lr, sch.stop_factor_lr)
+        elif type(sch) is _lrs.MultiFactorScheduler:
+            steps = jnp.asarray(sch.step, jnp.float32)
+            def main(t):
+                return sch.base_lr * sch.factor ** jnp.sum(t >= steps)
+        elif type(sch) is _lrs.PolyScheduler:
+            def main(t):
+                frac = jnp.clip((t - sch.warmup_steps)
+                                / max(sch.max_steps, 1), 0.0, 1.0)
+                return sch.final_lr + (sch.base_lr - sch.final_lr) \
+                    * (1.0 - frac) ** sch.power
+        elif type(sch) is _lrs.CosineScheduler:
+            def main(t):
+                frac = jnp.clip((t - sch.warmup_steps)
+                                / max(sch.max_steps, 1), 0.0, 1.0)
+                return sch.final_lr + (sch.base_lr - sch.final_lr) \
+                    * (1.0 + jnp.cos(jnp.pi * frac)) / 2.0
+        else:
+            return None
+        if not sch.warmup_steps:
+            return lambda t: jnp.float32(main(t))
+        span = sch.warmup_final_lr - sch.warmup_begin_lr
+        if sch.warmup_mode == "linear":
+            def warm(t):
+                return sch.warmup_begin_lr + span * t / sch.warmup_steps
+        else:
+            def warm(t):
+                return sch.warmup_begin_lr + span * (
+                    1.0 - jnp.exp(-t / max(sch.warmup_steps, 1)))
+        return lambda t: jnp.float32(
+            jnp.where(t < sch.warmup_steps, warm(t), main(t)))

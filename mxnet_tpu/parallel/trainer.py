@@ -85,6 +85,10 @@ class ShardedTrainer:
         self._ready = False
         self._tele_sig = None
         self._tele_reduce_bytes = 0
+        # persistent XLA compilation cache (compile_cache_dir knob): wired
+        # once, at first trainer construction, before anything compiles
+        from .. import dataflow as _dataflow
+        _dataflow.ensure_compile_cache()
         from ..gluon.parameter import DeferredInitializationError
         try:
             self._setup()
@@ -140,6 +144,18 @@ class ShardedTrainer:
                 for st, s in zip(self.fopt.init(self.params), self._pshard)]
         self.aux = [jax.device_put(p.data()._data, s)
                     for (_, p), s in zip(self._aux_params, self._aux_shard)]
+        # the step counter lives ON DEVICE, incremented inside the jitted
+        # step and donated like the rest of the train state: the hot path
+        # then ships zero per-step scalars (the old host-side t/lr pair
+        # cost two H2D transfers per step). int32 so `t + 1` stays exact
+        # past 2^24 steps (a float32 counter would silently freeze there,
+        # and with it the lr schedule and bias correction). When the lr
+        # schedule is traceable (lr_traced), lr is computed from it inside
+        # the step too; otherwise lr falls back to a host-computed traced
+        # argument.
+        self._t_dev = jax.device_put(
+            jnp.asarray(self.num_update, jnp.int32), rep)
+        self._lr_inside = self.fopt.lr_traced() is not None
         # gradient-reduction payload per step, for the collective counters:
         # XLA psums grads over the data axes iff they span >1 device
         reduce_degree = self.mesh.shape.get("dp", 1) * \
@@ -162,8 +178,23 @@ class ShardedTrainer:
         fopt = self.fopt
         fused = self._fused
         fl = self._fl if fused else None
+        # re-snapshotted per build: a constant-lr schedule bakes the
+        # CURRENT o.lr into the executable (the step-cache key carries the
+        # value, so set_learning_rate costs one warm re-jit, not a
+        # per-step transfer)
+        lr_fn = self.fopt.lr_traced() if self._lr_inside else None
 
-        def step(params, aux, opt_state, t, lr, rng, *batch):
+        def step(params, aux, opt_state, t, *rest):
+            if lr_fn is None:
+                lr, rng = rest[0], rest[1]
+                batch = rest[2:]
+            else:
+                rng = rest[0]
+                batch = rest[1:]
+            t = t + 1            # device-resident num_update (int32: exact)
+            tf = t.astype(jnp.float32)
+            if lr_fn is not None:
+                lr = lr_fn(tf)
             data, labels = batch[:n_data], batch[n_data:]
 
             def loss_of(ps):
@@ -179,13 +210,14 @@ class ShardedTrainer:
                 loss_of, has_aux=True)(params)
             if fused:
                 new_params, new_m, new_v = fl.apply_flat(
-                    params, grads, opt_state[0], opt_state[1], t, lr)
+                    params, grads, opt_state[0], opt_state[1], tf, lr)
                 new_opt = (new_m, new_v)
             else:
-                new_params, new_opt = fopt.apply(params, grads, opt_state, t, lr)
-            return loss, new_params, new_aux, new_opt
+                new_params, new_opt = fopt.apply(params, grads, opt_state,
+                                                 tf, lr)
+            return loss, new_params, new_aux, new_opt, t
 
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 3) if self._donate else (3,)
         if fused:
             pshard = self._rep
             oshard = (self._rep, self._rep)
@@ -193,11 +225,13 @@ class ShardedTrainer:
             pshard = self._pshard
             oshard = [tuple(s for _ in st)
                       for st, s in zip(self.opt_state, self._pshard)]
+        scalar_in = () if lr_fn is not None else (self._rep,)
         in_shardings = (
-            pshard, self._aux_shard, oshard,
-            self._rep, self._rep, self._rep,
-        ) + tuple(self._batch_shardings(n_data, n_label, batch_shapes))
-        out_shardings = (self._rep, pshard, self._aux_shard, oshard)
+            pshard, self._aux_shard, oshard, self._rep,
+        ) + scalar_in + (self._rep,) \
+            + tuple(self._batch_shardings(n_data, n_label, batch_shapes))
+        out_shardings = (self._rep, pshard, self._aux_shard, oshard,
+                         self._rep)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings, out_shardings=out_shardings)
 
@@ -214,7 +248,45 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def step(self, data, labels):
         """Run one train step. data/labels: NDArray or list of NDArrays
-        (global batch; sharded onto the mesh's data axes here)."""
+        (global batch; sharded onto the mesh's data axes here — batches
+        already staged by dataflow.prefetch_to_mesh skip the transfer).
+        Dispatch is asynchronous: the returned loss is lazy, and with
+        telemetry/diagnostics/nan_sentinel disabled this path performs no
+        host fence and no scalar device transfers. The
+        `trainer_async_fence_every` knob adds a periodic host fence
+        (every N steps) to bound dispatch run-ahead."""
+        fence_every = _config.get("trainer_async_fence_every")
+        return self._step_impl(data, labels, fence_every)
+
+    def step_async(self, data, labels):
+        """`step` minus the periodic fence: pure async dispatch returning
+        a lazy loss handle. Nothing blocks until an explicit
+        `.asscalar()`/`.item()`/`asnumpy()` on the handle (or telemetry/
+        nan_sentinel, which document that they fence). Use with
+        `dataflow.prefetch_to_mesh` so neither H2D transfer nor host
+        bookkeeping sits between consecutive device steps."""
+        return self._step_impl(data, labels, 0)
+
+    def _lr_cache_key(self):
+        """The step-cache component for everything the in-jit lr bakes
+        into the executable: None when lr is a traced argument (host
+        fallback — nothing baked), the current lr for constant schedules,
+        or the built-in scheduler's hyperparameter values. Mid-run
+        mutation (set_learning_rate, editing scheduler fields) then
+        re-jits warm instead of silently training at the stale schedule;
+        the eviction in _step_impl bounds the cache at one entry per
+        shape."""
+        if not self._lr_inside:
+            return None
+        sch = self._opt.lr_scheduler
+        if sch is None:
+            return float(self._opt.lr)
+        return (type(sch).__name__,) + tuple(
+            (k, tuple(v) if isinstance(v, (list, tuple)) else v)
+            for k, v in sorted(vars(sch).items())
+            if isinstance(v, (int, float, str, list, tuple)))
+
+    def _step_impl(self, data, labels, fence_every):
         data = data if isinstance(data, (list, tuple)) else [data]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         if not self._ready:
@@ -228,7 +300,7 @@ class ShardedTrainer:
         batch = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
                  for b in list(data) + list(labels)]
         shapes = tuple(b.shape for b in batch)
-        key = (len(data), len(labels), shapes)
+        key = (len(data), len(labels), shapes, self._lr_cache_key())
         is_miss = key not in self._step_cache
         # per-step config read (sub-µs vs a ms-scale step) so
         # mx.config.set("nan_sentinel", ...) takes effect mid-run
@@ -237,13 +309,33 @@ class ShardedTrainer:
         t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
-        self.num_update += 1
-        lr_host = self.fopt.lr_at(self.num_update)
-        t = jnp.asarray(self.num_update, jnp.float32)
-        lr = jnp.asarray(lr_host, jnp.float32)
-        batch = [jax.device_put(b, s) for b, s in
-                 zip(batch, self._batch_shardings(len(data), len(labels),
-                                                  shapes))]
+        if is_miss and key[3] is not None:
+            # in-jit-lr executables are keyed on the schedule's values:
+            # evict the stale entry so set_learning_rate / scheduler-edit
+            # loops don't accumulate one dead executable per value
+            for k in [k for k in self._step_cache
+                      if k[:3] == key[:3] and k[3] != key[3]]:
+                del self._step_cache[k]
+        # committed only AFTER the jitted call returns, so a trace-time
+        # error or failed dispatch can't desync the host counter from the
+        # device-resident _t_dev (which only advances on a completed call)
+        step_no = self.num_update + 1
+        scalars = ()
+        lr_host = None
+        if not self._lr_inside:
+            # untraceable (custom) schedule: lr stays host-computed, one
+            # scalar transfer per step — the documented fallback. Computed
+            # ONCE (a custom scheduler may be stateful; the diagnostics
+            # record below reuses this value rather than re-invoking it)
+            lr_host = self.fopt.lr_at(step_no)
+            scalars = (jnp.asarray(lr_host, jnp.float32),)
+        shardings = self._batch_shardings(len(data), len(labels), shapes)
+        # prefetch_to_mesh already staged these: an array whose sharding
+        # matches the target skips device_put entirely (no transfer, no
+        # new buffer) — that is the zero-copy hot path ci sanity asserts
+        batch = [b if getattr(b, "sharding", None) == s
+                 else jax.device_put(b, s)
+                 for b, s in zip(batch, shardings)]
         # StepTraceAnnotation: jax.profiler device traces group work by
         # train step (the reference profiler's per-iteration ranges —
         # SURVEY §5.1); free when no trace is active
@@ -255,14 +347,16 @@ class ShardedTrainer:
             # gradient psum waiting on a straggler/dead rank
             _diagnostics._scope_begin(
                 "sharded_step(psum)" if self._tele_reduce_bytes
-                else "sharded_step(dispatch)", self.num_update)
+                else "sharded_step(dispatch)", step_no)
         try:
             with jax.profiler.StepTraceAnnotation("train_step",
-                                                  step_num=self.num_update):
-                loss, self.params, self.aux, self.opt_state = \
+                                                  step_num=step_no):
+                loss, self.params, self.aux, self.opt_state, self._t_dev = \
                     self._step_cache[key](
-                        self.params, self.aux, self.opt_state, t, lr,
-                        _random.next_key(), *batch)
+                        self.params, self.aux, self.opt_state, self._t_dev,
+                        *scalars, _random.next_key(), *batch)
+            self.num_update = step_no
+            fenced = False
             if observing:
                 if _telemetry._enabled or sentinel:
                     # fence on the loss (one output of the step executable
@@ -274,11 +368,22 @@ class ShardedTrainer:
                     # host/device overlap — so its records mean "step
                     # dispatched" there
                     jax.block_until_ready(loss)
+                    fenced = True
                 if _telemetry._enabled:
                     self._tele_record_step(batch, t_build, t_step)
                 if _diagnostics._enabled or sentinel:
-                    self._diag_record_step(loss, lr_host, shapes, t_build,
-                                           sentinel)
+                    self._diag_record_step(
+                        loss,
+                        lr_host if lr_host is not None
+                        else self.fopt.lr_at(self.num_update),
+                        shapes, t_build, sentinel)
+            if not fenced and fence_every \
+                    and self.num_update % int(fence_every) == 0:
+                # bound async run-ahead: without an observer fencing for
+                # us (diagnostics-only mode included), the host could
+                # otherwise queue unbounded steps (and their batch
+                # buffers) ahead of the device
+                jax.block_until_ready(loss)
         finally:
             if in_scope:
                 _diagnostics._scope_end()
@@ -398,6 +503,9 @@ class ShardedTrainer:
             self.opt_state = [tuple(st) for st in state["opt_state"]]
         self.aux = list(state["aux"])
         self.num_update = int(state["num_update"])
+        # re-seed the device-resident step counter from the restored count
+        self._t_dev = jax.device_put(
+            jnp.asarray(self.num_update, jnp.int32), self._rep)
 
     @property
     def param_count(self):

@@ -24,6 +24,10 @@ Instrumented layers (each site degrades to the bool check when disabled):
                               tokens/s
   * kvstore/                — push/pull call counts + bytes moved
   * gluon/data/dataloader   — batch-wait histogram, prefetch-queue depth
+                              (stage="host")
+  * dataflow.py             — device-staging depth (stage="device"), H2D
+                              bytes, staging-wait histogram, bucket-pad
+                              waste, persistent compile-cache hits/misses
 
 Config: `telemetry` (enable at import), `telemetry_jsonl_path` (auto-flush
 target), `telemetry_flush_interval` (seconds between auto-flushes) — all in

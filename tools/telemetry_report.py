@@ -93,6 +93,13 @@ def report(path, label=None, data=None):
     lines.append(f"compiles:   {len(compiles)} first-time, "
                  f"{len(recompiles)} recompiles, "
                  f"{compile_s:.2f}s total compile time")
+    cache_hits = _metric_sum(snapshot, "compile_cache_hits_total")
+    cache_misses = _metric_sum(snapshot, "compile_cache_misses_total")
+    if cache_hits or cache_misses:
+        # persistent XLA cache (compile_cache_dir knob): hits deserialized
+        # an executable instead of rebuilding it — warm, not cold, compiles
+        lines.append(f"  persistent cache: {int(cache_hits)} warm hits, "
+                     f"{int(cache_misses)} cold misses")
     for e in recompiles:
         causes = "; ".join(e.get("causes", [])) or "unknown"
         lines.append(f"  recompile {e.get('block', '?')}: {causes} "
@@ -126,7 +133,16 @@ def report(path, label=None, data=None):
             lines.append(f"  {tag}{k}: {fmt_bytes(v)}")
 
     # -- input pipeline ---------------------------------------------------
-    wait_s = _metric_sum(snapshot, "dataloader_wait_seconds")
+    host_wait = _metric_sum(snapshot, "dataloader_wait_seconds")
+    dev_wait = _metric_sum(snapshot, "device_prefetch_wait_seconds")
+    dev_present = bool(snapshot.get("device_prefetch_wait_seconds",
+                                    {}).get("count"))
+    # with prefetch_to_mesh in the pipeline, the host DataLoader is
+    # consumed by the PREFETCH WORKER — its waits overlap device compute
+    # and are producer-side, not consumer stalls; only the staging wait
+    # blocks the train loop. Without a device stage, host wait IS the
+    # consumer stall.
+    wait_s = dev_wait if dev_present else host_wait
     step_s = sum(steps) if steps else _metric_sum(snapshot,
                                                   "trainer_step_seconds")
     denom = wait_s + step_s
@@ -135,6 +151,15 @@ def report(path, label=None, data=None):
         verdict = "input-bound" if frac > 0.5 else "compute-bound"
         lines.append(f"input:      {wait_s:.2f}s waiting on batches, "
                      f"stall fraction {frac:.1%} ({verdict})")
+        if dev_present:
+            # two-stage attribution: host batch production (DataLoader
+            # workers, overlapped) vs H2D staging (prefetch_to_mesh, the
+            # consumer-visible wait) — fix the stage that dominates
+            stage = "host batch production" if host_wait >= dev_wait \
+                else "H2D staging"
+            lines.append(f"  host batch {host_wait:.2f}s (overlapped), "
+                         f"H2D staging {dev_wait:.2f}s -> "
+                         f"bottleneck stage: {stage}")
     else:
         lines.append("input:      no wait/step time recorded")
     return "\n".join(lines)
