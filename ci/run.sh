@@ -69,6 +69,58 @@ finally:
 assert counts == {'fence': 0, 'device_put': 0, 'asarray': 0}, counts
 print('async step disabled fast path OK (no fence, no transfers)')
 "
+    # inspect must be disabled by default: the step path makes zero
+    # cost_analysis/memory_analysis calls (no analysis lower+compile) and
+    # allocates no CostRecords — the hook sites reduce to one bool check
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, telemetry, diagnostics
+from mxnet_tpu import inspect as mxi
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not mxi.enabled(), 'inspect must default to off'
+calls = {'analyze': 0, 'record': 0, 'note': 0}
+real = (mxi.analyze_jit, mxi.record_compiled, mxi.note_step)
+mxi.analyze_jit = lambda *a, **k: (calls.__setitem__('analyze', calls['analyze'] + 1), real[0](*a, **k))[1]
+mxi.record_compiled = lambda *a, **k: (calls.__setitem__('record', calls['record'] + 1), real[1](*a, **k))[1]
+mxi.note_step = lambda *a, **k: (calls.__setitem__('note', calls['note'] + 1), real[2](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+net2 = nn.Dense(4, in_units=8); net2.initialize(); net2.hybridize()
+net2(x)
+mxi.analyze_jit, mxi.record_compiled, mxi.note_step = real
+assert calls == {'analyze': 0, 'record': 0, 'note': 0}, calls
+assert mxi.records() == [], 'disabled fast path allocated CostRecords'
+print('inspect disabled fast path OK (no analysis calls, no records)')
+"
+    # the driver bench contract: the JSON line must carry the efficiency
+    # fields (nullable on CPU — mfu null, never 0/inf) so the BENCH_*
+    # trajectory can track MFU, not just throughput
+    # no pipe: a non-zero bench exit must fail this stage (set -e), not
+    # vanish behind tail's status
+    JAX_PLATFORMS=cpu MXNET_TPU_BENCH_FORCE_CPU=1 python bench.py \
+        > /tmp/_bench_sanity.out 2>/dev/null
+    tail -1 /tmp/_bench_sanity.out > /tmp/_bench_sanity.json
+    python -c "
+import json
+d = json.load(open('/tmp/_bench_sanity.json'))
+for k in ('mfu', 'achieved_tflops', 'peak_device_bytes',
+          'comm_bytes_per_step'):
+    assert k in d, f'bench JSON missing {k}: {sorted(d)}'
+    assert d[k] is None or isinstance(d[k], (int, float)), (k, d[k])
+assert d['mfu'] is None, 'CPU run must report mfu null, not a number'
+assert d['achieved_tflops'] is None or d['achieved_tflops'] > 0
+print('bench efficiency fields OK:', {k: d[k] for k in
+      ('mfu', 'achieved_tflops', 'peak_device_bytes',
+       'comm_bytes_per_step')})
+"
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "

@@ -54,6 +54,7 @@ _LAZY = {
     "profiler": ".profiler",
     "telemetry": ".telemetry",
     "diagnostics": ".diagnostics",
+    "inspect": ".inspect",
     "dataflow": ".dataflow",
     "parallel": ".parallel",
     "test_utils": ".test_utils",

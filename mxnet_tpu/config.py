@@ -210,6 +210,29 @@ register_option(
     "(padding overhead is visible in the bucket_pad_waste_ratio "
     "histogram).")
 register_option(
+    "inspect", False,
+    "Enable mx.inspect at import: every jit-cache miss additionally "
+    "lowers+compiles the same computation for XLA cost_analysis() / "
+    "memory_analysis() and keeps a per-executable CostRecord (flops, bytes "
+    "accessed, device memory, estimated collective traffic, MFU). Off by "
+    "default: every hook site then reduces to a single module-bool check "
+    "and no analysis compile happens (asserted by ci/run.sh sanity). "
+    "mx.inspect.enable()/disable() toggle at runtime. Trainers fence each "
+    "step while enabled so recorded step time is device time.")
+register_option(
+    "inspect_dir", "",
+    "When set, mx.inspect writes its registry to <dir>/<rank>/inspect.json "
+    "at process exit and refreshes it periodically during the run (so "
+    "tools/inspect_report.py can read a live job). Empty keeps the "
+    "registry in-memory only; mx.inspect.dump(path) still works.")
+register_option(
+    "peak_flops", 0.0,
+    "Per-chip peak FLOP/s used for MFU and roofline classification. 0 "
+    "(default) auto-detects from the device kind (TPU generation table in "
+    "mx.inspect; bf16 peaks); set explicitly for backends the table does "
+    "not know (e.g. CPU) or for non-bf16 workloads. When neither yields a "
+    "value, MFU is reported null, never 0 or inf.")
+register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "
     "the loss (ShardedTrainer/estimator DiagnosticsHandler) or global "

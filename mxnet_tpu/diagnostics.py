@@ -571,6 +571,15 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
     except Exception as e:
         pm["memory"] = [{"error": str(e)}]
     try:
+        # cost attribution (mx.inspect — imported lazily: inspect imports
+        # this module): an OOM post-mortem then names the executable with
+        # the largest peak_bytes right next to the memory watermarks
+        from . import inspect as _inspect_mod
+        if _inspect_mod._registry:
+            pm["inspect"] = _inspect_mod.snapshot()
+    except Exception as e:
+        pm["inspect"] = {"error": str(e)}
+    try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:
         pm["profiler_tail"] = []

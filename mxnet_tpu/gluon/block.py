@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import _engine
 from .. import diagnostics as _diagnostics
+from .. import inspect as _inspect
 from .. import ndarray as nd_mod
 from .. import random as _random
 from .. import telemetry as _telemetry
@@ -329,6 +330,18 @@ class HybridBlock(Block):
                     shapes=[list(a.shape) for a in args])
         elif _telemetry._enabled and not is_miss:
             _M_CACHE_HITS.inc()
+        if is_miss and _inspect._enabled and not any(
+                isinstance(d, jax.core.Tracer) for d in in_data):
+            # cost attribution for the freshly built executable: one extra
+            # lower+compile at the same signature. Runs AFTER the measured
+            # first call and its telemetry/ring records so the analysis
+            # compile neither inflates compile_seconds nor steals the
+            # persistent-cache cold miss (it is served warm from the real
+            # compile when compile_cache_dir is set). A child block
+            # compiling INSIDE a parent trace (tracer inputs) is skipped —
+            # the parent's executable subsumes its cost
+            _inspect.analyze_jit(type(self).__name__, _inspect.key_repr(key),
+                                 jitted, gp_data, aux_data, rng, *in_data)
         for (_, p), v in zip(aux_params, new_aux):
             p.data()._data = v
 

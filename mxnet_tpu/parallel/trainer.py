@@ -22,6 +22,7 @@ from .. import random as _random
 from .. import _engine
 from .. import config as _config
 from .. import diagnostics as _diagnostics
+from .. import inspect as _inspect
 from .. import telemetry as _telemetry
 from ..gluon.block import functional_call
 from ..ndarray import NDArray
@@ -85,6 +86,7 @@ class ShardedTrainer:
         self._ready = False
         self._tele_sig = None
         self._tele_reduce_bytes = 0
+        self._coll_est = {}
         # persistent XLA compilation cache (compile_cache_dir knob): wired
         # once, at first trainer construction, before anything compiles
         from .. import dataflow as _dataflow
@@ -169,6 +171,17 @@ class ShardedTrainer:
                     p.size * p.dtype.itemsize for p in self.params))
         else:
             self._tele_reduce_bytes = 0
+        # per-collective traffic estimate (mx.inspect): bytes each step's
+        # gradient reduction / fsdp gather-scatter moves, from the specs
+        # just chosen + mesh shape. One-time host arithmetic at setup
+        if self._fused:
+            sized = [(self._tele_reduce_bytes or
+                      int(self.params.size * self.params.dtype.itemsize),
+                      self._rep)]
+        else:
+            sized = [(int(p.size * p.dtype.itemsize), s)
+                     for p, s in zip(self.params, self._pshard)]
+        self._coll_est = _inspect.estimate_collectives(self.mesh, sized)
         self._ready = True
 
     # ------------------------------------------------------------------
@@ -305,7 +318,8 @@ class ShardedTrainer:
         # per-step config read (sub-µs vs a ms-scale step) so
         # mx.config.set("nan_sentinel", ...) takes effect mid-run
         sentinel = _config.get("nan_sentinel")
-        observing = _telemetry._enabled or _diagnostics._enabled or sentinel
+        observing = (_telemetry._enabled or _diagnostics._enabled or sentinel
+                     or _inspect._enabled)
         t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
@@ -349,16 +363,17 @@ class ShardedTrainer:
                 "sharded_step(psum)" if self._tele_reduce_bytes
                 else "sharded_step(dispatch)", step_no)
         try:
+            rngk = _random.next_key()
             with jax.profiler.StepTraceAnnotation("train_step",
                                                   step_num=step_no):
                 loss, self.params, self.aux, self.opt_state, self._t_dev = \
                     self._step_cache[key](
                         self.params, self.aux, self.opt_state, self._t_dev,
-                        *scalars, _random.next_key(), *batch)
+                        *scalars, rngk, *batch)
             self.num_update = step_no
             fenced = False
             if observing:
-                if _telemetry._enabled or sentinel:
+                if _telemetry._enabled or sentinel or _inspect._enabled:
                     # fence on the loss (one output of the step executable
                     # fences the whole executable) so the histogram records
                     # device step time, not just async dispatch; on tunnel
@@ -366,9 +381,11 @@ class ShardedTrainer:
                     # degrades to dispatch time. Diagnostics-only mode
                     # skips the fence — a ring append must not cost the
                     # host/device overlap — so its records mean "step
-                    # dispatched" there
+                    # dispatched" there. Inspect fences too: its step time
+                    # is the MFU denominator and must be device time
                     jax.block_until_ready(loss)
                     fenced = True
+                t_done = time.perf_counter()
                 if _telemetry._enabled:
                     self._tele_record_step(batch, t_build, t_step)
                 if _diagnostics._enabled or sentinel:
@@ -377,6 +394,12 @@ class ShardedTrainer:
                         lr_host if lr_host is not None
                         else self.fopt.lr_at(self.num_update),
                         shapes, t_build, sentinel)
+                if _inspect._enabled:
+                    # LAST observer: the miss-path analysis lower+compile
+                    # takes real wall time that must not leak into the
+                    # compile_seconds / ring compile records above
+                    self._inspect_record_step(key, scalars, rngk, batch,
+                                              t_build, t_step, t_done)
             if not fenced and fence_every \
                     and self.num_update % int(fence_every) == 0:
                 # bound async run-ahead: without an observer fencing for
@@ -408,6 +431,27 @@ class ShardedTrainer:
             # checked AFTER recording so the fatal step — non-finite loss
             # included — is the ring's last entry in the post-mortem
             _diagnostics.sentinel_check(loss_val, "loss", self.num_update)
+
+    def _inspect_record_step(self, key, scalars, rngk, batch, t_build,
+                             t_step, t_done):
+        """Cost attribution for one sharded step. On a step-cache miss the
+        freshly built executable is lowered+compiled once more for XLA
+        cost/memory analysis (warm via the persistent cache when
+        compile_cache_dir is set; the post-call state has the same avals
+        and shardings the executed call had, donation included). On a warm
+        step the fenced dispatch→fence window [t_step, t_done] feeds the
+        executable's MFU denominator — compile steps are excluded, like
+        the telemetry histogram, and so is the other observers' own
+        recording overhead (t_done is stamped right after the fence)."""
+        name = f"ShardedTrainer({type(self.block).__name__})"
+        ikey = _inspect.key_repr(key)
+        if t_build is not None:
+            _inspect.analyze_jit(
+                name, ikey, self._step_cache[key], self.params, self.aux,
+                self.opt_state, self._t_dev, *scalars, rngk, *batch,
+                collectives=self._coll_est)
+        elif t_step is not None:
+            _inspect.note_step(name, ikey, t_done - t_step)
 
     def _tele_record_step(self, batch, t_build, t_step):
         """Telemetry for one sharded step: compile accounting on a

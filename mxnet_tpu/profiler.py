@@ -19,7 +19,8 @@ import time
 
 __all__ = [
     "set_config", "set_state", "start", "stop", "pause", "resume",
-    "dump", "dumps", "Domain", "Scope", "scope", "Task", "Frame",
+    "dump", "dumps", "get_summary", "Domain", "Scope", "scope", "Task",
+    "Frame",
     "Event", "Counter", "Marker", "start_jax_trace", "stop_jax_trace",
 ]
 
@@ -96,28 +97,66 @@ def _record(ev, name, dur_us=None):
 
 def dump(finished=True, filename=None):
     """Write collected events as chrome://tracing JSON
-    (reference: MXDumpProfile → chrome tracing format)."""
+    (reference: MXDumpProfile → chrome tracing format). With
+    `aggregate_stats` configured, the per-scope aggregate table rides
+    along under an "aggregateStats" key (chrome://tracing ignores unknown
+    top-level keys), mirroring the reference's AggregateStats dump."""
     path = filename or _config["filename"]
     with _lock:
+        # events and aggregates drain in ONE critical section: a scope
+        # exiting between two separate locks would land its aggregate row
+        # in this file but its trace event in the next, and the two tables
+        # in one dump would disagree
         events = list(_events)
         if finished:
             _events.clear()
+        agg = _agg_rows(reset=finished) if _config["aggregate_stats"] \
+            else None
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if agg is not None:
+        doc["aggregateStats"] = agg
     with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        json.dump(doc, f)
     return path
 
 
-def dumps(reset=False):
-    """Aggregate per-name stats table (reference: AggregateStats::Dump)."""
+def _agg_rows(reset):
+    """Copy (and optionally clear) the aggregate table. Caller holds
+    _lock. Values are COPIED — a concurrent Scope.__exit__ updates
+    [count, total, min, max] fields one by one, so handing out the live
+    lists (as dumps() once did) let a reader see count incremented before
+    total, i.e. rows whose avg undercuts min."""
+    rows = {name: {"count": s[0],
+                   "total_ms": s[1] / 1e3,
+                   "min_ms": s[2] / 1e3,
+                   "max_ms": s[3] / 1e3,
+                   "avg_ms": s[1] / s[0] / 1e3}
+            for name, s in _agg.items()}
+    if reset:
+        _agg.clear()
+    return dict(sorted(rows.items(), key=lambda kv: -kv[1]["total_ms"]))
+
+
+def get_summary(reset=False):
+    """Aggregate per-scope stats as structured rows, total-time
+    descending (reference: AggregateStats::DumpTable in
+    `src/profiler/aggregate_stats.cc`). With reset=True the read and the
+    clear are one atomic critical section, so no update between them can
+    be lost."""
     with _lock:
-        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
-        if reset:
-            _agg.clear()
+        return _agg_rows(reset=reset)
+
+
+def dumps(reset=False):
+    """Aggregate per-name stats table (reference: AggregateStats::Dump).
+    Snapshot + optional reset are atomic (see get_summary)."""
+    rows = get_summary(reset=reset)
     lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
              f"{'Max(ms)':>10}{'Avg(ms)':>10}"]
-    for name, (cnt, tot, mn, mx) in rows:
-        lines.append(f"{name:<40}{cnt:>8}{tot / 1e3:>12.3f}{mn / 1e3:>10.3f}"
-                     f"{mx / 1e3:>10.3f}{tot / cnt / 1e3:>10.3f}")
+    for name, r in rows.items():
+        lines.append(f"{name:<40}{r['count']:>8}{r['total_ms']:>12.3f}"
+                     f"{r['min_ms']:>10.3f}{r['max_ms']:>10.3f}"
+                     f"{r['avg_ms']:>10.3f}")
     return "\n".join(lines)
 
 

@@ -2,6 +2,8 @@
 import json
 import time
 
+import pytest
+
 import mxnet_tpu as mx
 from mxnet_tpu import profiler
 
@@ -119,3 +121,89 @@ def test_chrome_trace_events_well_formed(tmp_path):
             assert isinstance(args[e["name"]], (int, float))
     mirrors = [e for e in events if e["name"] == "t_trace_probe_total"]
     assert mirrors and mirrors[-1]["args"]["t_trace_probe_total"] == 2.0
+
+
+def test_get_summary_structured_rows():
+    """The aggregate table as data (upstream aggregate_stats.cc analog):
+    per-scope count/total/min/max/avg, total-time descending, and an
+    atomic reset."""
+    profiler.dumps(reset=True)  # drop aggregates from earlier tests
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+    for _ in range(3):
+        with profiler.Scope("sum_region"):
+            time.sleep(0.001)
+    with profiler.Scope("sum_other"):
+        time.sleep(0.004)
+    profiler.stop()
+    rows = profiler.get_summary()
+    r = rows["sum_region"]
+    assert r["count"] == 3
+    assert r["min_ms"] <= r["avg_ms"] <= r["max_ms"]
+    assert r["total_ms"] == pytest.approx(r["avg_ms"] * 3)
+    # sorted by total desc
+    assert list(rows)[0] == max(rows, key=lambda n: rows[n]["total_ms"])
+    # reset=True drains atomically
+    assert profiler.get_summary(reset=True)["sum_region"]["count"] == 3
+    assert profiler.get_summary() == {}
+
+
+def test_dump_includes_aggregate_table(tmp_path):
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=str(tmp_path / "agg.json"),
+                        aggregate_stats=True)
+    profiler.start()
+    with profiler.Scope("agg_in_dump"):
+        time.sleep(0.001)
+    profiler.stop()
+    doc = json.load(open(profiler.dump()))
+    assert "traceEvents" in doc  # chrome trace stays intact
+    assert doc["aggregateStats"]["agg_in_dump"]["count"] == 1
+    # finished=True drained the aggregates along with the events
+    assert profiler.get_summary() == {}
+
+
+def test_dumps_reset_concurrent_with_scopes():
+    """dumps(reset=True) racing active Scope exits: the snapshot+clear is
+    one critical section and rows are value copies, so (a) no update is
+    ever lost across resets and (b) no reader sees a torn row (count
+    bumped before total -> avg below min)."""
+    import threading
+
+    profiler.dumps(reset=True)
+    profiler.set_config(aggregate_stats=True)
+    profiler.start()
+    N_THREADS, N_SCOPES = 4, 300
+    stop = threading.Event()
+    seen = []
+    errors = []
+
+    def worker():
+        for _ in range(N_SCOPES):
+            with profiler.Scope("race_region"):
+                pass
+
+    def reader():
+        while not stop.is_set():
+            rows = profiler.get_summary(reset=True)
+            r = rows.get("race_region")
+            if r is None:
+                continue
+            if not (r["min_ms"] - 1e-9 <= r["avg_ms"] <= r["max_ms"] + 1e-9):
+                errors.append(f"torn row: {r}")
+            seen.append(r["count"])
+
+    threads = [threading.Thread(target=worker) for _ in range(N_THREADS)]
+    rd = threading.Thread(target=reader)
+    rd.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rd.join()
+    profiler.stop()
+    tail = profiler.get_summary(reset=True)
+    total = sum(seen) + tail.get("race_region", {}).get("count", 0)
+    assert not errors, errors[:3]
+    assert total == N_THREADS * N_SCOPES  # nothing lost between read+reset

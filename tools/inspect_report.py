@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Dump the mx.inspect cost-attribution registry of a finished or live run.
+
+    python tools/inspect_report.py inspect.json
+    python tools/inspect_report.py run_dir            # <dir>/<rank>/inspect.json
+    python tools/inspect_report.py diag/0/inspect.json diag/1/inspect.json
+
+Input files are mx.inspect.dump() JSON (written to
+`inspect_dir/<rank>/inspect.json` at exit and refreshed periodically while
+the run is live, so this works on a job that is still training). A
+directory argument expands to every `*/inspect.json` under it, one section
+per rank.
+
+Per file prints one row per compiled executable — flops, bytes accessed,
+arithmetic intensity, device memory (peak / args / temp / donated),
+steps timed, achieved TFLOP/s, MFU, roofline class, and the estimated
+per-collective traffic — then names the executable with the largest peak
+device memory (the first suspect after an OOM) and the compute-vs-comm
+budget. Reads only the stdlib; missing/null fields (CPU backends report
+flops but little else) print as "-", never crash.
+"""
+import json
+import os
+import sys
+
+
+def fmt_bytes(n):
+    if not isinstance(n, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def fmt(v, spec="{:.2f}", null="-"):
+    return spec.format(v) if isinstance(v, (int, float)) else null
+
+
+def expand(args):
+    """Files as given; directories become their <rank>/inspect.json files
+    (rank-ordered), or the directory's own inspect.json when it IS a
+    per-rank dir (`inspect_report.py diag/0`)."""
+    paths = []
+    for a in args:
+        if os.path.isdir(a):
+            direct = os.path.join(a, "inspect.json")
+            if os.path.isfile(direct):
+                paths.append(direct)
+                continue
+            found = []
+            for sub in os.listdir(a):
+                p = os.path.join(a, sub, "inspect.json")
+                if os.path.isfile(p):
+                    found.append((int(sub) if sub.isdigit() else 1 << 30, p))
+            if not found:
+                print(f"inspect_report: no inspect.json under {a!r}",
+                      file=sys.stderr)
+            paths.extend(p for _, p in sorted(found))
+        else:
+            paths.append(a)
+    return paths
+
+
+def report(path):
+    try:
+        with open(path) as f:
+            snap = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"inspect report: {path}\n  unreadable: {e}"
+    lines = [f"inspect report: {path}", "=" * 60]
+    backend = snap.get("backend") or "unknown backend"
+    peak = snap.get("peak_flops_per_chip")
+    bw = snap.get("peak_bandwidth_per_chip")
+    lines.append(
+        f"backend:    {backend}"
+        + (f"  peak {peak / 1e12:.0f} TFLOP/s"
+           if isinstance(peak, (int, float)) else "  peak FLOP/s unknown"
+           " (set the peak_flops knob for MFU)")
+        + (f"  HBM {bw / 1e9:.0f} GB/s" if isinstance(bw, (int, float))
+           else ""))
+    recs = snap.get("records") or []
+    if not recs:
+        lines.append("no executables recorded (was mx.inspect enabled?)")
+        return "\n".join(lines)
+    for r in sorted(recs, key=lambda r: -(r.get("flops") or 0)):
+        lines.append(f"executable: {r.get('name', '?')}")
+        lines.append(
+            f"  compiles {r.get('compiles', 0)}  "
+            f"flops {fmt(r.get('flops'), '{:,.0f}')}  "
+            f"bytes accessed {fmt_bytes(r.get('bytes_accessed'))}  "
+            f"AI {fmt(r.get('arithmetic_intensity'))} FLOP/B")
+        lines.append(
+            f"  memory: peak {fmt_bytes(r.get('peak_bytes'))}  "
+            f"args {fmt_bytes(r.get('argument_bytes'))}  "
+            f"out {fmt_bytes(r.get('output_bytes'))}  "
+            f"temp {fmt_bytes(r.get('temp_bytes'))}  "
+            f"donated {fmt_bytes(r.get('donated_bytes'))}")
+        ach = r.get("achieved_flops")
+        avg = r.get("avg_step_s")
+        perf = (f"  perf: {r.get('steps', 0)} steps  "
+                f"avg {fmt(avg * 1e3 if isinstance(avg, (int, float)) else None)}"
+                " ms/step  "
+                f"achieved {fmt(ach / 1e12 if isinstance(ach, (int, float)) else None, '{:.3f}')}"
+                " TFLOP/s  "
+                f"MFU {fmt(r.get('mfu'), '{:.1%}', 'null')}")
+        roof = r.get("roofline")
+        if roof:
+            perf += f"  [{roof}]"
+        lines.append(perf)
+        coll = r.get("collectives") or {}
+        if coll:
+            ops = ", ".join(f"{op} {fmt_bytes(b)}/step"
+                            for op, b in sorted(coll.items()))
+            lines.append(f"  est. collectives: {ops}")
+        if r.get("analysis_error"):
+            lines.append(f"  analysis degraded: {r['analysis_error']}")
+    largest = snap.get("largest_peak_bytes_executable")
+    if largest:
+        lines.append(f"largest device footprint: {largest} "
+                     "(first suspect after an OOM)")
+    return "\n".join(lines)
+
+
+def main(argv):
+    if len(argv) >= 2 and argv[1] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    paths = expand(argv[1:])
+    if not paths:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    print("\n\n".join(report(p) for p in paths))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -12,10 +12,14 @@ step p99. Rank labels come from the nearest all-digit path component
 (`diag/3/run.jsonl` → rank 3), falling back to argument order.
 
 Per file prints: recompile count with per-event causes, step-time p50/p99,
-collective/kvstore bytes moved, and the input-stall fraction (time blocked
-on the input pipeline as a share of run time) — the triage order for a slow
-TPU training run: recompiling? input-bound? comms-bound? only then look at
-the kernels (mx.profiler / jax.profiler).
+a "cost & efficiency" section when mx.inspect cost events are present (top
+executables by device memory, flops / arithmetic intensity / roofline, MFU
+against the recorded per-chip peak, estimated collective-traffic share,
+and a one-line input/comm/compute-bound verdict), collective/kvstore bytes
+moved, and the input-stall fraction (time blocked on the input pipeline as
+a share of run time) — the triage order for a slow TPU training run:
+recompiling? input-bound? comms-bound? only then look at the kernels
+(mx.profiler / jax.profiler).
 
 Reads only the stdlib so it runs anywhere the JSONL lands (no jax import);
 malformed lines and records with missing fields are skipped, not fatal.
@@ -80,6 +84,84 @@ def fmt_bytes(n):
     return f"{n:.1f} TiB"
 
 
+def _cost_records(events):
+    """Latest mx.inspect `cost` event per executable (later compiles of
+    the same executable supersede earlier ones)."""
+    recs = {}
+    for e in events:
+        if e.get("kind") == "cost" and e.get("executable"):
+            recs[e["executable"]] = e
+    return recs
+
+
+def _cost_efficiency(events, step_p50):
+    """The "Cost & efficiency" lines plus (mfu, comm_share) for the
+    verdict: top executables by device memory, per-executable flops /
+    arithmetic intensity / roofline, MFU of the hottest (most-flops)
+    executable against the per-backend peak recorded in the event, and
+    the estimated collective traffic share of all bytes moved. Every
+    input is nullable (CPU backends report flops but little else) —
+    missing pieces drop out of the lines rather than crashing."""
+    recs = _cost_records(events)
+    if not recs:
+        return [], None, None
+    lines = ["cost:"]
+    by_mem = sorted([r for r in recs.values()
+                     if isinstance(r.get("peak_bytes"), (int, float))],
+                    key=lambda r: -r["peak_bytes"])
+    for r in by_mem[:3]:
+        parts = [f"args {fmt_bytes(r['argument_bytes'])}"
+                 if isinstance(r.get("argument_bytes"), (int, float)) else "",
+                 f"temp {fmt_bytes(r['temp_bytes'])}"
+                 if isinstance(r.get("temp_bytes"), (int, float)) else "",
+                 f"donated {fmt_bytes(r['donated_bytes'])}"
+                 if isinstance(r.get("donated_bytes"), (int, float)) else ""]
+        detail = ", ".join(p for p in parts if p)
+        lines.append(f"  {r['executable']}: peak device memory "
+                     f"{fmt_bytes(r['peak_bytes'])}"
+                     + (f" ({detail})" if detail else ""))
+    mfu = None
+    hot = max((r for r in recs.values()
+               if isinstance(r.get("flops"), (int, float))),
+              key=lambda r: r["flops"], default=None)
+    if hot is not None:
+        desc = f"  {hot['executable']}: {hot['flops'] / 1e9:.3f} GFLOP/step"
+        ba = hot.get("bytes_accessed")
+        if isinstance(ba, (int, float)) and ba:
+            ai = hot["flops"] / ba
+            desc += f", arithmetic intensity {ai:.1f} FLOP/B"
+            peak, bw = hot.get("peak_flops"), hot.get("peak_bandwidth")
+            if peak and bw:
+                bound = "compute-bound" if ai >= peak / bw \
+                    else "memory-bound"
+                desc += f" ({bound})"
+        peak = hot.get("peak_flops")
+        if peak and step_p50:
+            mfu = hot["flops"] / step_p50 / peak
+            desc += (f", MFU {mfu:.1%} of {peak / 1e12:.0f} TFLOP/s peak "
+                     f"@ p50 step")
+        lines.append(desc)
+    agg_ops = {}
+    for r in recs.values():
+        for op, b in (r.get("collectives") or {}).items():
+            if isinstance(b, (int, float)):
+                agg_ops[op] = agg_ops.get(op, 0) + b
+    comm = sum(agg_ops.values())
+    comm_share = None
+    if comm:
+        total_accessed = sum(r["bytes_accessed"] for r in recs.values()
+                             if isinstance(r.get("bytes_accessed"),
+                                           (int, float)))
+        ops = ", ".join(f"{op} {fmt_bytes(b)}/step"
+                        for op, b in sorted(agg_ops.items()))
+        line = f"  est. collective traffic: {ops}"
+        if total_accessed:
+            comm_share = comm / (comm + total_accessed)
+            line += f" — {comm_share:.1%} of bytes moved"
+        lines.append(line)
+    return lines, mfu, comm_share
+
+
 def report(path, label=None, data=None):
     events, snapshot = data if data is not None else load(path)
     title = f"telemetry report: {path}" if label is None \
@@ -123,6 +205,12 @@ def report(path, label=None, data=None):
         else:
             lines.append("steps:      none recorded")
 
+    # -- cost & efficiency (mx.inspect cost events) -----------------------
+    step_p50 = percentile(steps, 50) if steps else \
+        snapshot.get("trainer_step_seconds", {}).get("p50")
+    cost_lines, mfu, comm_share = _cost_efficiency(events, step_p50)
+    lines.extend(cost_lines)
+
     # -- comms ------------------------------------------------------------
     coll = _label_values(snapshot, "collective_bytes_total")
     kv = _label_values(snapshot, "kvstore_bytes_total")
@@ -151,6 +239,17 @@ def report(path, label=None, data=None):
         verdict = "input-bound" if frac > 0.5 else "compute-bound"
         lines.append(f"input:      {wait_s:.2f}s waiting on batches, "
                      f"stall fraction {frac:.1%} ({verdict})")
+        if mfu is not None:
+            # one verdict that folds MFU in, printed NEXT to the stall
+            # attribution and derived from the same stall fraction, so the
+            # two diagnoses can never silently disagree
+            kind = "input-bound" if frac > 0.5 else \
+                "comm-bound" if (comm_share or 0.0) > 0.5 else \
+                "compute-bound"
+            lines.append(
+                f"  verdict: {kind}, MFU={mfu:.1%}"
+                + (f", comm share {comm_share:.1%}"
+                   if comm_share is not None else ""))
         if dev_present:
             # two-stage attribution: host batch production (DataLoader
             # workers, overlapped) vs H2D staging (prefetch_to_mesh, the
