@@ -54,6 +54,7 @@ _LAZY = {
     "profiler": ".profiler",
     "telemetry": ".telemetry",
     "diagnostics": ".diagnostics",
+    "resilience": ".resilience",
     "inspect": ".inspect",
     "dataflow": ".dataflow",
     "parallel": ".parallel",

@@ -233,6 +233,64 @@ register_option(
     "not know (e.g. CPU) or for non-bf16 workloads. When neither yields a "
     "value, MFU is reported null, never 0 or inf.")
 register_option(
+    "resilience", False,
+    "Arm mx.resilience at import: SIGTERM/SIGINT preemption handler "
+    "(finish the in-flight step, write a final checkpoint, exit the "
+    "distinct EXIT_PREEMPTED code), periodic verified checkpoints "
+    "(checkpoint_dir / checkpoint_every_n_steps), auto-resume (resume "
+    "knob), transient-fault retries, and the fault_inject harness. Off "
+    "by default: the trainer hook reduces to a single module-bool check, "
+    "no signal handlers are installed, and save/restore do no manifest "
+    "hashing (asserted by ci/run.sh sanity). mx.resilience.install() "
+    "arms at runtime.")
+register_option(
+    "checkpoint_dir", "",
+    "Base directory for mx.resilience managed checkpoints "
+    "(<dir>/step_<n>/ with an atomic-renamed manifest.json carrying "
+    "per-file checksums + step + mesh fingerprint). Used by the "
+    "ShardedTrainer periodic-checkpoint hook, the preemption final save, "
+    "auto-resume, and Estimator.fit checkpointing. Empty disables "
+    "managed checkpoints.")
+register_option(
+    "checkpoint_every_n_steps", 0,
+    "Save a managed checkpoint every N completed ShardedTrainer steps "
+    "(requires checkpoint_dir and mx.resilience enabled). 0 disables "
+    "periodic saves — the preemption final save still fires.")
+register_option(
+    "checkpoint_keep", 3,
+    "Managed checkpoints retained under checkpoint_dir (keep-last-N; "
+    "older ones and stale *.tmp-* leftovers from killed saves are "
+    "GC'd after each save, on process 0). <=0 keeps everything.")
+register_option(
+    "resume", "",
+    "Auto-resume policy for a fresh ShardedTrainer / Estimator.fit while "
+    "mx.resilience is enabled: 'auto' restores the newest checkpoint "
+    "under checkpoint_dir that passes checksum+mesh verification "
+    "(falling back past torn/corrupt ones), an explicit path restores "
+    "that checkpoint, '' (default) starts fresh.")
+register_option(
+    "fault_inject", "",
+    "mx.resilience fault-injection spec (comma-separated): "
+    "'sigterm@step:5' (graceful-preemption path), 'kill@step:3' (rank "
+    "death via SIGKILL), 'corrupt_ckpt@step:4' (flip bytes in that "
+    "step's checkpoint after its manifest is written), 'stall_input:250' "
+    "(one 250ms input-pipeline stall), 'exc@step:2' (crash). Append "
+    "'@rank:N' to target one rank, '@every_restart' to re-fire after a "
+    "supervised relaunch. Empty (default) injects nothing.")
+register_option(
+    "retry_max_attempts", 3,
+    "Total tries mx.resilience.RetryPolicy makes on a retryable "
+    "transient fault (prefetch staging, DataLoader worker respawn, "
+    "checkpoint I/O). 1 disables retries.")
+register_option(
+    "retry_backoff_s", 0.5,
+    "Base backoff before the first RetryPolicy retry; doubles per "
+    "attempt (exponential), jittered +-25%.")
+register_option(
+    "retry_max_backoff_s", 30.0,
+    "Upper bound on a single RetryPolicy backoff sleep, whatever the "
+    "attempt count.")
+register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "
     "the loss (ShardedTrainer/estimator DiagnosticsHandler) or global "

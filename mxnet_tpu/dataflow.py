@@ -41,6 +41,7 @@ import time
 import numpy as np
 
 from . import config as _config
+from . import resilience as _resilience
 from . import telemetry as _telemetry
 
 __all__ = ["prefetch_to_mesh", "MeshPrefetcher", "BucketPad",
@@ -112,11 +113,17 @@ class MeshPrefetcher:
         self._q = queue.Queue(maxsize=depth)
         self._closed = threading.Event()
         self._exhausted = False
+        # close() is idempotent and may be called concurrently — including
+        # from a SIGTERM/preemption path re-entering while the first close
+        # is mid-join — so its bookkeeping sits behind an RLock
+        self._close_lock = threading.RLock()
+        self._close_done = False
         # the worker closes over locals (not self) so a consumer dropping
         # its last reference lets __del__ run while the thread is alive
         closed, q = self._closed, self._q
         stage = _Stager(shardings)
         source = iter(iterator)
+        policy_cell = [None]   # RetryPolicy built once, on first enabled use
 
         def _worker():
             try:
@@ -125,7 +132,8 @@ class MeshPrefetcher:
                         return
                     if transform is not None:
                         item = transform(item)
-                    staged = stage(item)
+                    staged = _stage_resilient(stage, item, closed,
+                                              policy_cell)
                     _q_put(q, staged, closed)
                 _q_put(q, _STOP, closed)
             except _WorkerExit:
@@ -170,20 +178,31 @@ class MeshPrefetcher:
         return item
 
     def close(self):
-        """Stop the worker and release the staged batches. Idempotent;
-        called by __del__ and __exit__, safe mid-iteration. A worker
+        """Stop the worker and release the staged batches. Idempotent and
+        thread-safe — callable again from a SIGTERM/preemption path while
+        a worker is mid-`device_put` (the in-flight transfer completes,
+        its result is drained, the worker exits at the next bounded put).
+        Called by __del__ and __exit__, safe mid-iteration. A worker
         blocked INSIDE the source iterator's next() cannot be interrupted
         (no thread cancellation in Python) — it is abandoned as a daemon
         and exits at the source's next yield; the join timeout bounds how
         long close() waits for that."""
-        self._closed.set()
-        # drain so a worker blocked on put() observes the close promptly
-        self._drain()
-        self._thread.join(timeout=5)
-        # a put already in flight during the first drain can land in the
-        # emptied queue; drain again after the join so close() really does
-        # release every staged device batch
-        self._drain()
+        with self._close_lock:
+            if self._close_done:
+                return
+            self._closed.set()
+            # drain so a worker blocked on put() observes the close promptly
+            self._drain()
+            if self._thread is not threading.current_thread():
+                self._thread.join(timeout=5)
+            # a put already in flight during the first drain can land in the
+            # emptied queue; drain again after the join so close() really
+            # does release every staged device batch
+            self._drain()
+            # only a confirmed-dead worker makes close() a no-op next time:
+            # a timed-out join leaves it retryable
+            if not self._thread.is_alive():
+                self._close_done = True
 
     def _drain(self):
         while True:
@@ -204,6 +223,24 @@ class MeshPrefetcher:
             self.close()
         except Exception:
             pass
+
+
+def _stage_resilient(stage, item, closed, policy_cell):
+    """One batch through the stager. With mx.resilience enabled, the
+    `stall_input` fault point fires here and transient staging failures
+    (OSError/ConnectionError/TimeoutError — e.g. a flaky remote
+    filesystem feeding device_put) retry under the configured
+    RetryPolicy. The policy is built ONCE per prefetcher (policy_cell) —
+    not per batch, this is the input hot path — and retries abort early
+    if the prefetcher closes underneath. Disabled: one bool check, then
+    the plain call."""
+    if not _resilience._enabled:
+        return stage(item)
+    _resilience.fault_point("input")
+    if policy_cell[0] is None:
+        policy_cell[0] = _resilience.RetryPolicy()
+    return policy_cell[0].call(
+        stage, item, site="prefetch-stage", abort=closed.is_set)
 
 
 def _q_put(q, item, closed):

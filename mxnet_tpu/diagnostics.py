@@ -580,6 +580,20 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
     except Exception as e:
         pm["inspect"] = {"error": str(e)}
     try:
+        # resume provenance (mx.resilience — checked via sys.modules so a
+        # run that never touched resilience pays no import): names the
+        # checkpoint this process restored from, so a post-mortem of a
+        # relaunched run shows where it picked up
+        _res = sys.modules.get(__package__ + ".resilience")
+        if _res is not None:
+            if _res._resume_info:
+                pm["resume"] = dict(_res._resume_info)
+            if _res.restart_count():
+                pm.setdefault("resume", {})["restart_count"] = \
+                    _res.restart_count()
+    except Exception as e:
+        pm["resume"] = {"error": str(e)}
+    try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:
         pm["profiler_tail"] = []

@@ -377,10 +377,44 @@ class Estimator:
             hs.insert(1, MetricHandler(self.train_metrics))
         return hs
 
-    def fit(self, train_data, epochs=1, event_handlers=None):
+    def _resilience_setup(self, resume, checkpoint_dir):
+        """Resolve the fit loop's checkpoint/resume behavior. Explicit
+        `resume`/`checkpoint_dir` arguments are their own opt-in; the
+        config knobs additionally require mx.resilience to be enabled, so
+        the disabled default path stays a single module-bool check with
+        no manifest hashing."""
+        from ... import config, resilience
+        cd = checkpoint_dir
+        if cd is None and (resilience._enabled or resume):
+            cd = config.get("checkpoint_dir") or None
+        pol = resume
+        if pol is None and resilience._enabled:
+            pol = config.get("resume") or None
+        if pol and not cd:
+            raise ValueError(
+                "fit(resume=...) needs a checkpoint directory: pass "
+                "checkpoint_dir= or set the checkpoint_dir config knob")
+        restored = None
+        if pol and cd:
+            restored = resilience.restore_estimator(self, cd, pol)
+        return resilience, cd, restored
+
+    def fit(self, train_data, epochs=1, event_handlers=None, resume=None,
+            checkpoint_dir=None):
+        """Run the fit loop. `resume="auto"` (with `checkpoint_dir` here
+        or the config knob) restores the newest VERIFIED fit checkpoint —
+        net params, optimizer state, RNG, epoch/batch counters — and
+        skips the already-consumed epochs; an explicit `resume=<path>`
+        restores that checkpoint. When a checkpoint directory is
+        configured, every completed epoch writes an atomic manifest'd
+        checkpoint (keep-last-N per the checkpoint_keep knob), and a
+        SIGTERM handled by mx.resilience saves state and exits
+        EXIT_PREEMPTED at the next batch boundary."""
         from .. import utils as _gutils
         from ... import autograd
 
+        _res, ckpt_dir, _restored = self._resilience_setup(
+            resume, checkpoint_dir)
         handlers = self._handlers(event_handlers, epochs)
 
         def fire(kind):
@@ -389,6 +423,8 @@ class Estimator:
 
         self.stop_training = False
         fire("train_begin")
+        if self.max_epoch is not None and self.num_epoch >= self.max_epoch:
+            self.stop_training = True   # resumed past the last epoch
         while not self.stop_training:
             fire("epoch_begin")
             epoch_iter, close_iter = self._epoch_iter(train_data)
@@ -407,10 +443,27 @@ class Estimator:
                     self.last_loss = loss
                     self.num_batch += 1
                     fire("batch_end")
+                    if _res._enabled and _res.preempted():
+                        # NO mid-epoch save: fit checkpoints are epoch-
+                        # granular, and the resumed run replays the
+                        # interrupted epoch from its start — saving the
+                        # mid-epoch params here would overwrite the clean
+                        # end-of-epoch checkpoint and double-apply this
+                        # epoch's partial updates on replay. The retained
+                        # boundary checkpoint IS the resume point.
+                        _res.note_preemption(
+                            step=self.num_epoch,
+                            path=_res.list_checkpoints(ckpt_dir)[-1][1]
+                            if ckpt_dir and _res.list_checkpoints(ckpt_dir)
+                            else None)
+                        raise _res.PreemptedExit(
+                            f"preempted during epoch {self.num_epoch}")
             finally:
                 close_iter()
             self.num_epoch += 1
             fire("epoch_end")
+            if ckpt_dir:
+                _res.save_estimator(self, ckpt_dir)
             if self.max_epoch is not None \
                     and self.num_epoch >= self.max_epoch:
                 self.stop_training = True

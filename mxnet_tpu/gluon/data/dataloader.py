@@ -220,10 +220,56 @@ class DataLoader:
                 yield batch
             t.join()
 
+    def _respawn_or_raise(self, workers, dead, respawns, ctx, bfn,
+                          key_q, data_q, inflight):
+        """A worker died silently (segfault / OOM-kill) with work
+        outstanding. With mx.resilience enabled and retry budget left,
+        replace the dead process(es) and re-enqueue every in-flight batch
+        (duplicates from still-live workers dedupe at receipt); otherwise
+        raise the classic fatal error. Returns (workers, respawns)."""
+        from ... import resilience as _resilience
+        policy = _resilience.RetryPolicy() if _resilience._enabled else None
+        if policy is None or respawns + 1 >= policy.max_attempts:
+            raise RuntimeError(
+                f"DataLoader worker (pid {dead[0].pid}) died with exit "
+                f"code {dead[0].exitcode} without reporting a result"
+                + (f" ({respawns} respawn(s) already used)" if respawns
+                   else "")) from None
+        respawns += 1
+        import sys as _sys
+        print(f"mx.resilience: DataLoader worker (pid {dead[0].pid}, exit "
+              f"code {dead[0].exitcode}) died — respawning and re-queuing "
+              f"{len(inflight)} in-flight batch(es) (respawn "
+              f"{respawns}/{policy.max_attempts - 1})", file=_sys.stderr)
+        if _telemetry._enabled:
+            _resilience._M_RETRIES.labels(site="dataloader-respawn").inc()
+        workers = [w for w in workers if w.is_alive()]
+        for w in dead:
+            w.join(timeout=1)           # reap the corpse
+        import warnings
+        with warnings.catch_warnings():
+            # same accepted fork caveat as the initial spawn: workers obey
+            # the numpy-only contract, so the jax fork warning is noise
+            warnings.filterwarnings("ignore", message=".*fork.*")
+            for _ in dead:
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(self._dataset, bfn, key_q, data_q,
+                          int(np.random.randint(0, 2 ** 31 - 1))),
+                    daemon=True)
+                w.start()
+                workers.append(w)
+        for item in list(inflight.items()):
+            key_q.put(item)             # may duplicate: receipt dedupes
+        return workers, respawns
+
     def _iter_processes(self):
         """Forked-worker pipeline (reference: _MultiWorkerIter): tasks fan
         out to `num_workers` processes, results reorder by batch index so
-        iteration order matches num_workers=0 exactly."""
+        iteration order matches num_workers=0 exactly. A worker that dies
+        without reporting (segfault/OOM-kill) is fatal by default; with
+        mx.resilience enabled it is respawned and its in-flight work
+        re-enqueued, up to the RetryPolicy attempt budget."""
         import multiprocessing as mp
 
         ctx = mp.get_context("fork")    # fork: closures/lambdas in
@@ -253,14 +299,21 @@ class DataLoader:
                 w.start()
         try:
             batches = iter(enumerate(self._batch_sampler))
-            sent = recvd = 0
+            inflight = {}      # idx -> indices: sent to a worker, no result
             buf = {}
-            for _ in range(max(self._prefetch, 1)):
+            respawns = 0
+
+            def _send():
                 item = next(batches, None)
                 if item is None:
-                    break
+                    return False
+                inflight[item[0]] = item[1]
                 key_q.put(item)
-                sent += 1
+                return True
+
+            for _ in range(max(self._prefetch, 1)):
+                if not _send():
+                    break
             next_yield = 0
             while True:
                 if next_yield in buf:
@@ -271,23 +324,22 @@ class DataLoader:
                     continue
                 if _telemetry._enabled:
                     _M_DEPTH.set(0)     # consumer is starved: input-bound
-                if recvd >= sent:       # nothing in flight, nothing buffered
+                if not inflight:        # nothing in flight, nothing buffered
                     break
                 from ... import config as _config
                 stall_limit = float(_config.get("dataloader_timeout"))
                 waited = 0.0
                 while True:             # bounded get: a worker that died OR
                     try:                # deadlocked must not hang us forever
-                        idx, batch, err = data_q.get(timeout=5)
+                        idx, batch, err = data_q.get(timeout=1)
                         break
                     except queue.Empty:
-                        waited += 5
+                        waited += 1
                         dead = [w for w in workers if not w.is_alive()]
                         if dead:
-                            raise RuntimeError(
-                                f"DataLoader worker (pid {dead[0].pid}) "
-                                f"died with exit code {dead[0].exitcode} "
-                                "without reporting a result") from None
+                            workers, respawns = self._respawn_or_raise(
+                                workers, dead, respawns, ctx, bfn,
+                                key_q, data_q, inflight)
                         if stall_limit > 0 and waited >= stall_limit:
                             raise RuntimeError(
                                 f"DataLoader workers produced no batch for "
@@ -298,14 +350,13 @@ class DataLoader:
                                 "dataloader_timeout config option "
                                 "(MXNET_TPU_DATALOADER_TIMEOUT)."
                             ) from None
-                recvd += 1
+                if idx not in inflight:
+                    continue    # duplicate of work re-enqueued at a respawn
+                inflight.pop(idx)
                 if err is not None:
                     raise RuntimeError(f"DataLoader worker failed: {err}")
                 buf[idx] = batch
-                item = next(batches, None)
-                if item is not None:
-                    key_q.put(item)
-                    sent += 1
+                _send()
         finally:
             for _ in workers:
                 key_q.put(None)

@@ -29,6 +29,15 @@ Design points:
   * retention keeps the newest `keep` complete checkpoints; deletion runs
     on process 0 only (orbax shards are written per-host, the directory
     layout is shared).
+
+Relation to `mx.resilience` (the full fault-tolerance layer): this class
+is the minimal in-loop wrapper; resilience adds atomic verified
+checkpoints (manifest + checksums + mesh fingerprint — which these saves
+inherit automatically while resilience is enabled, since save_states
+routes through the same atomic writer), knob-driven periodic checkpoints
+with auto-resume inside ShardedTrainer itself, graceful-preemption exit
+codes, supervised relaunch via tools/launch.py --max-restarts, retry
+policies, and fault injection. New code should prefer the knobs.
 """
 from __future__ import annotations
 

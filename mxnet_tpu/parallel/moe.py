@@ -100,7 +100,7 @@ def moe_apply(x, router_w, w1, w2, mesh=None, axis_name="ep",
               capacity_factor=1.25, activation=jax.nn.gelu):
     """shard_map wrapper: x (N, D) sharded on tokens, experts sharded on
     `axis_name`; router replicated. Returns (y, aux_loss)."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mesh = mesh or current_mesh()
     fn = shard_map(

@@ -82,7 +82,7 @@ def pipeline_shard_map(stage_fn, stacked_params, microbatches, mesh=None,
                        axis_name="pp", remat=True):
     """Top-level homogeneous helper: stacked_params pytree with leading
     stage dim sharded over `pp`; microbatches (M, mb, ...) replicated."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mesh = mesh or current_mesh()
     pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
@@ -244,7 +244,7 @@ class SeqPipelineTrainer(PipelineCheckpointMixin):
             for st, s in zip(self.fopt.init(self.params), self._pshard)]
 
     def _build_step(self, n_data, n_label):
-        from jax import shard_map
+        from ._compat import shard_map
         from .. import random as _random
         from .trainer import call_loss
 
@@ -451,7 +451,7 @@ class PipelineTrainer(PipelineCheckpointMixin):
         return out, list(flat[i:])
 
     def _build_step(self, n_data, act_sd):
-        from jax import shard_map
+        from ._compat import shard_map
         from ..ndarray import NDArray
         from .. import _engine
         from .trainer import call_loss

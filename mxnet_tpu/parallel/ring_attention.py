@@ -313,7 +313,7 @@ def ring_self_attention(q, k, v, mask=None, causal=False, mesh=None,
                         axis_name="sp"):
     """Convenience wrapper: shard_map over the mesh's `sp` axis with
     (B, H, L, D) global tensors; L is sharded."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mesh = mesh or current_mesh()
     qspec = P(None, None, axis_name, None)
@@ -344,7 +344,7 @@ def sp_self_attention(q, k, v, mask=None, causal=False, mesh=None,
     inner: the per-shard attention (q, k, v, axis_name, mask=, causal=) —
     defaults to `ring_attention`; pass `ulysses.ulysses_attention` for the
     all-to-all head↔sequence reshard instead of the ring."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mesh = mesh or current_mesh()
     B, H, L, D = q.shape

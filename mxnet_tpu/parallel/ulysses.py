@@ -84,7 +84,7 @@ def ulysses_self_attention(q, k, v, mask=None, causal=False, mesh=None,
                            axis_name="sp"):
     """shard_map wrapper over global (B, H, L, D) tensors, L sharded on
     `axis_name` (mirror of ring_self_attention)."""
-    from jax import shard_map
+    from ._compat import shard_map
 
     mesh = mesh or current_mesh()
     qspec = P(None, None, axis_name, None)

@@ -1,0 +1,1090 @@
+"""mx.resilience — preemption-safe training: atomic verified checkpoints,
+auto-resume, graceful SIGTERM handling, transient-fault retry, and a
+fault-injection harness.
+
+TPU pods are preemptible and multi-host: a production framework must
+survive rank death, SIGTERM preemption, and torn/corrupt checkpoints.
+The reference's KVStore/PS-Lite lineage treated worker failure as a
+first-class event; this module is the TPU-native equivalent. Five pieces:
+
+  * **atomic verified checkpoints** — every managed checkpoint is written
+    to a temp directory, described by a `manifest.json` carrying per-file
+    CRC32 checksums + the step id + a mesh/config fingerprint, fsynced,
+    and atomically renamed into place. A kill mid-save leaves only a
+    `*.tmp-*` directory that restore never considers. On restore the
+    checksums are verified, a mesh mismatch is rejected with a clear
+    error (`MeshMismatchError`), and a torn/corrupt latest checkpoint
+    falls back to the newest previous GOOD one.
+  * **auto-resume** — the `resume` knob ("auto" or an explicit path) makes
+    a fresh `ShardedTrainer` (and `Estimator.fit(resume=...)`) restore
+    model/optimizer/RNG/device-step-counter from the newest verified
+    checkpoint; already-consumed steps/epochs are skipped by the restored
+    counters.
+  * **graceful preemption** — `install()` registers a SIGTERM/SIGINT
+    handler that only sets a flag (async-signal-safe); the trainer
+    finishes the in-flight step, writes a final checkpoint, and exits
+    with the distinct `EXIT_PREEMPTED` code so supervisors can tell
+    "saved and evicted" from "crashed".
+  * **RetryPolicy** — exponential backoff + jitter + retryable-exception
+    classification, applied to transient faults: prefetch staging in
+    `dataflow.prefetch_to_mesh`, silent DataLoader worker death
+    (respawn + work re-enqueue), and checkpoint I/O.
+  * **fault injection** — the `fault_inject` knob ("sigterm@step:5",
+    "kill@step:3@rank:1", "corrupt_ckpt@step:4", "stall_input:250")
+    drives deterministic failures through the SAME hooks production uses,
+    so every recovery path is provable end-to-end (tests/unittest/
+    test_resilience.py; `tools/launch.py --max-restarts` supervises the
+    relaunch side).
+
+Cost model: DISABLED (the default) is the production fast path — the
+trainer hook is one module-bool check, no signal handlers are installed,
+`save_states` writes exactly what it wrote before (no manifest, no
+hashing), and restore verifies nothing (`ci/run.sh sanity` asserts
+this). Enable with `mx.resilience.install()` / `MXNET_TPU_RESILIENCE=1`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random as _pyrandom
+import shutil
+import signal as _signal
+import sys
+import threading
+import time
+import zlib
+
+from . import config as _config
+from . import telemetry as _telemetry
+
+__all__ = [
+    "enable", "disable", "enabled", "install", "uninstall", "preempted",
+    "clear_preempted", "RetryPolicy", "retry_call", "CheckpointCorruptError",
+    "MeshMismatchError", "PreemptedExit", "EXIT_PREEMPTED",
+    "write_checkpoint", "verify_checkpoint", "list_checkpoints",
+    "check_fingerprint", "trainer_fingerprint", "CheckpointManager",
+    "manager_for", "FaultInjector", "fault_point", "restart_count",
+    "last_resume", "note_preemption", "save_estimator", "restore_estimator",
+]
+
+# distinct "preempted: state saved, exiting on request" process exit code —
+# chosen outside the shell (126..128+N) and common-errno ranges so a
+# supervisor (tools/launch.py, k8s) can classify it unambiguously
+EXIT_PREEMPTED = 83
+
+_lock = threading.RLock()
+_enabled = False          # the fast-path bool: trainer hooks check ONLY this
+_installed = False        # signal handlers chained
+_prev_handlers = {}
+_preempt = {"flag": False, "signum": None}
+_injector = None          # FaultInjector parsed from the fault_inject knob
+_resume_info = None       # {"path", "step", "fallbacks"} of the last restore
+
+_M_SAVE_SECONDS = _telemetry.histogram(
+    "checkpoint_save_seconds", "wall time of one managed checkpoint save "
+    "(state write + manifest hash + atomic rename)")
+_M_RESTORE_SECONDS = _telemetry.histogram(
+    "checkpoint_restore_seconds", "wall time of one verified checkpoint "
+    "restore (checksum verify + state load)")
+_M_VERIFY_FAILURES = _telemetry.counter(
+    "checkpoint_verify_failures_total", "checkpoints rejected at restore "
+    "time (torn write, checksum mismatch, missing manifest entry) — each "
+    "one fell back to an older checkpoint")
+_M_RESTARTS = _telemetry.counter(
+    "restarts_total", "supervised gang relaunches this process has been "
+    "through (from MXNET_TPU_RESTART_COUNT, exported by tools/launch.py "
+    "--max-restarts)")
+_M_PREEMPTIONS = _telemetry.counter(
+    "preemptions_total", "SIGTERM/SIGINT preemptions handled gracefully "
+    "(final checkpoint written, exited EXIT_PREEMPTED)")
+_M_RETRIES = _telemetry.counter(
+    "retries_total", "transient-fault retries by site (label site=): "
+    "prefetch staging, dataloader worker respawn, checkpoint I/O")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed verification (torn write / checksum mismatch /
+    missing manifest or entry). Managed restores fall back to the newest
+    previous good checkpoint instead of propagating this."""
+
+
+class MeshMismatchError(RuntimeError):
+    """A verified checkpoint was written for a different mesh/param-mode
+    than the trainer restoring it. Raised (never silently resharded) so a
+    mis-configured relaunch cannot load shards onto the wrong topology."""
+
+
+class PreemptedExit(SystemExit):
+    """SystemExit subclass raised after the final preemption checkpoint;
+    carries EXIT_PREEMPTED so the process exit code is distinct."""
+
+    def __init__(self, message=""):
+        super().__init__(EXIT_PREEMPTED)
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# enable / install
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """True when the resilience layer is armed (hot paths read the module
+    global `_enabled` directly — this accessor is the public spelling)."""
+    return _enabled
+
+
+def enable():
+    """Arm the trainer hooks (periodic checkpoint, fault injection, resume)
+    WITHOUT touching signal handlers — install() adds those."""
+    global _enabled, _injector
+    with _lock:
+        _injector = FaultInjector.from_config()
+        _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def install(signals=(_signal.SIGTERM, _signal.SIGINT)):
+    """Arm everything: enable() plus a preemption handler on `signals`
+    that only sets a flag (async-signal-safe); the in-flight step finishes,
+    a final checkpoint is written at the step boundary, and the process
+    exits EXIT_PREEMPTED. Also publishes the supervised-relaunch count
+    (MXNET_TPU_RESTART_COUNT) into the restarts_total counter and the
+    diagnostics ring. Idempotent."""
+    global _installed
+    enable()
+    with _lock:
+        if not _installed:
+            for sig in signals:
+                try:
+                    _prev_handlers[sig] = _signal.signal(sig, _on_signal)
+                except (ValueError, OSError):
+                    pass           # non-main thread / restricted env
+            _installed = True
+    n = restart_count()
+    if n:
+        _M_RESTARTS.inc(n)
+        try:
+            from . import diagnostics as _diagnostics
+            _diagnostics.record_event("restart", count=n)
+        except Exception:
+            pass
+    return _installed
+
+
+def uninstall():
+    """Undo install() (tests): restore previous signal handlers, disarm
+    the hooks, drop the preemption flag and per-trainer managers."""
+    global _injector, _resume_info
+    with _lock:
+        if _installed:
+            _restore_handlers()
+        _injector = None
+        _resume_info = None
+        clear_preempted()
+    disable()
+
+
+def _on_signal(signum, frame):
+    # First signal: set a flag, nothing else — saving from the signal
+    # frame mid-dispatch could serialize half-updated device state; the
+    # trainer/fit loop checks the flag at the next step boundary.
+    # Second signal: ESCALATE — restore the previous handlers and
+    # re-deliver, so a phase with no step boundary in sight (data prep,
+    # a minutes-long first compile, a plain user loop with no resilience
+    # hook) stays terminable and Ctrl-C twice still kills the process.
+    if _preempt["flag"]:
+        print("mx.resilience: second signal — restoring default handlers "
+              "and terminating without a final checkpoint", file=sys.stderr)
+        _restore_handlers()
+        os.kill(os.getpid(), signum)
+        return
+    _preempt["flag"] = True
+    _preempt["signum"] = signum
+    print(f"mx.resilience: signal {signum} received — finishing the "
+          "in-flight step, then checkpointing and exiting "
+          f"{EXIT_PREEMPTED} (send again to terminate immediately)",
+          file=sys.stderr)
+
+
+def _restore_handlers():
+    global _installed
+    for sig, h in list(_prev_handlers.items()):
+        try:
+            _signal.signal(sig, h if h is not None else _signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+    _prev_handlers.clear()
+    _installed = False
+
+
+def preempted():
+    """True once a preemption signal arrived (sticky until
+    clear_preempted(); the boundary save does not clear it — training
+    loops break on it)."""
+    return _preempt["flag"]
+
+
+def clear_preempted():
+    _preempt["flag"] = False
+    _preempt["signum"] = None
+
+
+def restart_count():
+    """How many supervised relaunches this process has been through
+    (exported by tools/launch.py --max-restarts as
+    MXNET_TPU_RESTART_COUNT; 0 on the first launch)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+    except ValueError:
+        return 0
+
+
+def last_resume():
+    """{"path", "step", "fallbacks"} of the most recent successful restore
+    in this process (None before any). Surfaced as the post-mortem
+    "resume" section by mx.diagnostics."""
+    return dict(_resume_info) if _resume_info else None
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class RetryPolicy:
+    """Exponential backoff + full jitter + retryable-exception
+    classification.
+
+    `max_attempts` counts TOTAL tries (1 = no retry). A non-retryable
+    exception propagates immediately; a retryable one sleeps
+    `backoff_s * 2^k` (capped at `max_backoff_s`, jittered by ±`jitter`
+    fraction) and tries again. `call(fn, ..., abort=...)` stops early —
+    re-raising the last failure — when the abort callable turns true
+    (e.g. a prefetcher closing under the worker)."""
+
+    #: transient by default: filesystem/network hiccups and timeouts.
+    #: Framework code passes explicit lists where it knows better.
+    DEFAULT_RETRYABLE = (OSError, ConnectionError, TimeoutError)
+
+    def __init__(self, max_attempts=None, backoff_s=None, max_backoff_s=None,
+                 jitter=0.25, retryable=None, sleep=time.sleep, rng=None):
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else _config.get("retry_max_attempts"))
+        self.backoff_s = float(backoff_s if backoff_s is not None
+                               else _config.get("retry_backoff_s"))
+        self.max_backoff_s = float(max_backoff_s if max_backoff_s is not None
+                                   else _config.get("retry_max_backoff_s"))
+        self.jitter = float(jitter)
+        self.retryable = tuple(retryable) if retryable is not None \
+            else self.DEFAULT_RETRYABLE
+        self._sleep = sleep
+        self._rng = rng or _pyrandom.Random()
+
+    def is_retryable(self, exc):
+        return isinstance(exc, self.retryable)
+
+    def delay(self, attempt):
+        """Backoff before try `attempt+2` (attempt is the 0-based index of
+        the try that just failed)."""
+        base = min(self.backoff_s * (2.0 ** attempt), self.max_backoff_s)
+        if self.jitter:
+            base *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, base)
+
+    def call(self, fn, *args, site="generic", abort=None, on_retry=None,
+             **kwargs):
+        """Run fn(*args, **kwargs) under this policy. `on_retry(exc,
+        attempt, delay)` observes each retry; `abort()` true stops the
+        loop early, re-raising the last exception."""
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self.is_retryable(e) or attempt + 1 >= self.max_attempts:
+                    raise
+                if abort is not None and abort():
+                    raise
+                delay = self.delay(attempt)
+                if _telemetry._enabled:
+                    _M_RETRIES.labels(site=site).inc()
+                if on_retry is not None:
+                    on_retry(e, attempt, delay)
+                else:
+                    print(f"mx.resilience: retrying {site} after "
+                          f"{type(e).__name__}: {e} (attempt "
+                          f"{attempt + 2}/{self.max_attempts}, "
+                          f"backoff {delay:.2f}s)", file=sys.stderr)
+                self._sleep(delay)
+                attempt += 1
+
+
+def retry_call(fn, *args, **kwargs):
+    """Module-level convenience: RetryPolicy() from the config knobs."""
+    return RetryPolicy().call(fn, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# atomic verified checkpoints
+# ---------------------------------------------------------------------------
+
+_MANIFEST = "manifest.json"
+_STEP_PREFIX = "step_"
+_TMP_MARK = ".tmp-"
+
+
+def _file_crc(path, _bufsize=1 << 20):
+    """Streaming CRC32 of one file (cheap enough to run over multi-GB
+    checkpoints; the point is torn-write detection, not cryptography)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_bufsize)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _walk_files(root):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            yield os.path.relpath(full, root), full
+
+
+def _jax_process_count():
+    """jax.process_count() without cold-initializing a backend: a process
+    that never imported jax cannot be part of a multi-host world."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 1
+    try:
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+def write_checkpoint(directory, writer, step=0, fingerprint=None):
+    """Atomic verified checkpoint write.
+
+    `writer(tmpdir)` produces the payload (orbax state, .params files,
+    anything); then a manifest.json with per-file size+CRC32, the step id
+    and the caller's fingerprint is written, everything is fsynced, and
+    the temp directory is atomically renamed to `directory` (an existing
+    checkpoint there is replaced — see _recover_displaced for the
+    crash-between-renames window). A crash leaves either the previous
+    checkpoint, a recoverable `*.tmp-old` displacement, or an ignorable
+    `*.tmp-<pid>` directory — never a half-written checkpoint that
+    restore would trust.
+
+    Multi-host (jax.process_count() > 1): the temp-dir rename dance is a
+    per-process filesystem operation and cannot wrap a COLLECTIVE orbax
+    save, so the writer runs against the final directory directly (orbax
+    brings its own multi-host commit semantics) and only process 0 writes
+    the manifest afterwards — shared-filesystem assumption, like the
+    orbax layout itself."""
+    directory = os.path.abspath(str(directory))
+    parent = os.path.dirname(directory) or "."
+    os.makedirs(parent, exist_ok=True)
+    if _jax_process_count() > 1:
+        writer(directory)
+        if _process_index() == 0:
+            _write_manifest(directory, step, fingerprint)
+        fault_point("ckpt", step=step, path=directory)
+        return directory
+    tmp = directory + _TMP_MARK + str(os.getpid())
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        writer(tmp)
+        _write_manifest(tmp, step, fingerprint)
+        if os.path.exists(directory):
+            # replace-in-place: move the old checkpoint aside first (rename
+            # over a non-empty directory is not atomic/portable), remove it
+            # only after the new one is in place. A crash between the two
+            # renames leaves the good copy at <dir>.tmp-old, which
+            # _recover_displaced renames back on the next restore/GC.
+            old = directory + _TMP_MARK + "old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(directory, old)
+            os.rename(tmp, directory)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, directory)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _dir_fsync(parent)
+    fault_point("ckpt", step=step, path=directory)
+    return directory
+
+
+def _write_manifest(directory, step, fingerprint):
+    manifest = {
+        "schema": 1,
+        "step": int(step),
+        "ts": time.time(),
+        "fingerprint": fingerprint or {},
+        "files": {},
+    }
+    for rel, full in _walk_files(directory):
+        if rel == _MANIFEST:
+            continue
+        manifest["files"][rel] = {"size": os.path.getsize(full),
+                                  "crc32": _file_crc(full)}
+    mpath = os.path.join(directory, _MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _recover_displaced(base_dir):
+    """Undo a crash caught between write_checkpoint's two renames: a
+    `step_X.tmp-old` directory whose `step_X` is missing IS the last good
+    checkpoint — rename it back before anyone lists or GCs."""
+    try:
+        entries = os.listdir(str(base_dir))
+    except (FileNotFoundError, NotADirectoryError):
+        return
+    suffix = _TMP_MARK + "old"
+    for name in entries:
+        if not (name.startswith(_STEP_PREFIX) and name.endswith(suffix)):
+            continue
+        final = os.path.join(str(base_dir), name[:-len(suffix)])
+        if not os.path.exists(final):
+            try:
+                os.rename(os.path.join(str(base_dir), name), final)
+                print(f"mx.resilience: recovered displaced checkpoint "
+                      f"{final} (crash during a same-step rewrite)",
+                      file=sys.stderr)
+            except OSError:
+                pass
+
+
+def _dir_fsync(path):
+    """fsync a directory so the rename itself is durable (best-effort:
+    not all filesystems/platforms allow O_RDONLY dir fds + fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def verify_checkpoint(directory):
+    """Verify a managed checkpoint: manifest present, every entry present
+    with matching size and CRC32. Returns the manifest dict; raises
+    CheckpointCorruptError naming the first bad file."""
+    directory = str(directory)
+    mpath = os.path.join(directory, _MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{directory}: no {_MANIFEST} — torn write or not a managed "
+            "checkpoint") from None
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{directory}: unreadable {_MANIFEST}: {e}") from None
+    for rel, info in manifest.get("files", {}).items():
+        full = os.path.join(directory, rel)
+        if not os.path.exists(full):
+            raise CheckpointCorruptError(f"{directory}: missing file {rel}")
+        size = os.path.getsize(full)
+        if size != info.get("size"):
+            raise CheckpointCorruptError(
+                f"{directory}: {rel} is {size} bytes, manifest says "
+                f"{info.get('size')}")
+        crc = _file_crc(full)
+        if crc != info.get("crc32"):
+            raise CheckpointCorruptError(
+                f"{directory}: {rel} checksum {crc:#010x} != manifest "
+                f"{info.get('crc32', 0):#010x} (corrupt)")
+    return manifest
+
+
+def check_fingerprint(manifest, expected, directory=""):
+    """Reject a checkpoint written for a different mesh/config. Compares
+    only the keys `expected` carries, so new fingerprint fields stay
+    backward-compatible."""
+    got = manifest.get("fingerprint") or {}
+    bad = {k: (got.get(k), v) for k, v in (expected or {}).items()
+           if k in got and got[k] != v}
+    if bad:
+        detail = ", ".join(f"{k}: checkpoint={g!r} current={c!r}"
+                           for k, (g, c) in sorted(bad.items()))
+        raise MeshMismatchError(
+            f"checkpoint {directory or '<dir>'} was written for a different "
+            f"topology ({detail}). Restore on the original mesh/param-mode, "
+            "or load it explicitly with resilience disabled to reshard.")
+
+
+def list_checkpoints(base_dir):
+    """Step-numbered managed checkpoints under base_dir, oldest first:
+    [(step, path)]. `*.tmp-*` leftovers from killed saves are excluded."""
+    out = []
+    try:
+        entries = os.listdir(str(base_dir))
+    except (FileNotFoundError, NotADirectoryError):
+        return out
+    for name in entries:
+        if not name.startswith(_STEP_PREFIX) or _TMP_MARK in name:
+            continue
+        try:
+            step = int(name[len(_STEP_PREFIX):])
+        except ValueError:
+            continue
+        out.append((step, os.path.join(str(base_dir), name)))
+    return sorted(out)
+
+
+class CheckpointManager:
+    """Keep-last-N atomic verified checkpoints of one trainer under
+    `base_dir/step_<n>`.
+
+    `trainer` is anything exposing save_states/load_states/num_update
+    (ShardedTrainer, the pipeline trainers). Saves go through
+    write_checkpoint (manifest + atomic rename) under the checkpoint-I/O
+    RetryPolicy; restore_latest walks newest→oldest, verifying checksums
+    and the mesh fingerprint, falling back past corrupt checkpoints and
+    GCing beyond `keep` after each save."""
+
+    def __init__(self, trainer, base_dir, keep=None, policy=None):
+        self.trainer = trainer
+        self.base_dir = os.path.abspath(str(base_dir))
+        self.keep = int(keep if keep is not None
+                        else _config.get("checkpoint_keep"))
+        self.policy = policy or RetryPolicy()
+        self._last_saved_step = None
+
+    # ------------------------------------------------------------- save
+    def _step_dir(self, step):
+        return os.path.join(self.base_dir, f"{_STEP_PREFIX}{step:010d}")
+
+    def save(self, force=False):
+        """Checkpoint the trainer's current step. Skips (returns None) if
+        that step is already saved, unless `force`. The write itself is
+        atomic+verified: while resilience is enabled, the trainer's
+        save_states routes through write_checkpoint (see
+        parallel/trainer._ckpt_save)."""
+        step = int(self.trainer.num_update)
+        if not force and self._last_saved_step == step:
+            return None
+        t0 = time.perf_counter()
+        path = self._step_dir(step)
+        self.policy.call(self.trainer.save_states, path,
+                         site="checkpoint-io")
+        self._last_saved_step = step
+        dt = time.perf_counter() - t0
+        if _telemetry._enabled:
+            _M_SAVE_SECONDS.observe(dt)
+            _telemetry.event("checkpoint", step=step, path=path,
+                             dur_s=round(dt, 6))
+        try:
+            from . import diagnostics as _diagnostics
+            _diagnostics.record_event("checkpoint", step=step, path=path,
+                                      dur_s=round(dt, 6))
+        except Exception:
+            pass
+        self._gc()
+        return path
+
+    def _gc(self):
+        """Retention on process 0: newest `keep` complete checkpoints
+        survive; older ones and stale tmp leftovers (killed mid-save,
+        older than 5 minutes) go. Displaced `*.tmp-old` checkpoints are
+        recovered first so the cleanup can never eat the last good copy."""
+        if self.keep <= 0 or not _owns_gc():
+            return
+        _recover_displaced(self.base_dir)
+        for _step, path in list_checkpoints(self.base_dir)[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+        try:
+            for name in os.listdir(self.base_dir):
+                full = os.path.join(self.base_dir, name)
+                if _TMP_MARK in name and \
+                        time.time() - os.path.getmtime(full) > 300:
+                    shutil.rmtree(full, ignore_errors=True)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- restore
+    def restore_latest(self):
+        """Restore the newest checkpoint that verifies, falling back past
+        torn/corrupt ones (each rejection counts
+        checkpoint_verify_failures_total). Returns the restored step, or
+        None when no usable checkpoint exists. A mesh-mismatch raises
+        MeshMismatchError — that is a configuration error, not corruption,
+        and older checkpoints would mismatch identically."""
+        _recover_displaced(self.base_dir)
+        ckpts = list_checkpoints(self.base_dir)
+        fallbacks = 0
+        for step, path in reversed(ckpts):
+            try:
+                self.restore(path)
+            except CheckpointCorruptError as e:
+                fallbacks += 1
+                if _telemetry._enabled:
+                    _M_VERIFY_FAILURES.inc()
+                print(f"mx.resilience: rejecting checkpoint: {e} — "
+                      "falling back to the previous one", file=sys.stderr)
+                continue
+            _note_resume(path, step, fallbacks)
+            return step
+        return None
+
+    def restore(self, path):
+        """Verify + load one specific checkpoint directory. The checksum
+        and fingerprint verification happen INSIDE load_states (the
+        trainer's _ckpt_restore verifies whenever resilience is enabled
+        and a manifest exists) — running them here too would CRC every
+        payload file twice on exactly the relaunch path where recovery
+        speed matters; this only insists a manifest is present so an
+        unmanaged directory can't slip through unverified."""
+        t0 = time.perf_counter()
+        if not os.path.exists(os.path.join(str(path), _MANIFEST)):
+            raise CheckpointCorruptError(
+                f"{path}: no {_MANIFEST} — torn write or not a managed "
+                "checkpoint")
+        if not _enabled:
+            # load_states only self-verifies while resilience is enabled;
+            # a manager used standalone still gets the full check here
+            manifest = verify_checkpoint(path)
+            check_fingerprint(manifest, trainer_fingerprint(self.trainer),
+                              str(path))
+        self.policy.call(self.trainer.load_states, path,
+                         site="checkpoint-io")
+        self._last_saved_step = int(self.trainer.num_update)
+        if _telemetry._enabled:
+            _M_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        return path
+
+    def last_saved_path(self):
+        """Path of this manager's most recent save (None before any)."""
+        if self._last_saved_step is None:
+            return None
+        return self._step_dir(self._last_saved_step)
+
+
+def trainer_fingerprint(trainer):
+    """The topology identity a trainer checkpoint is only valid on:
+    trainer class, mesh axis sizes, param mode. Written into the manifest
+    at save; compared (key-wise) at verified restore."""
+    fp = {"trainer": type(trainer).__name__}
+    mesh = getattr(trainer, "mesh", None)
+    if mesh is not None:
+        try:
+            fp["mesh_shape"] = {str(k): int(v)
+                                for k, v in dict(mesh.shape).items()}
+        except Exception:
+            pass
+    mode = getattr(trainer, "param_mode", None)
+    if mode is not None:
+        fp["param_mode"] = mode
+    return fp
+
+
+def _process_index():
+    """Process index without cold-initializing a backend: env first
+    (tools/launch.py exports JAX_PROCESS_ID), then jax.process_index()
+    if jax is already imported — the same detection order and jax
+    fallback as _jax_process_count, so the multi-host checkpoint path
+    can never see count>1 while every host thinks it is index 0."""
+    for var in ("JAX_PROCESS_ID", "DMLC_WORKER_ID"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+def _owns_gc():
+    """True when this process may delete checkpoints: in a multi-host
+    jax world only process 0 (the directory is shared), but a process
+    that is its own single-process world owns its checkpoint_dir
+    outright — per-rank directories (env rank set, no jax.distributed)
+    must still get retention."""
+    return _jax_process_count() == 1 or _process_index() == 0
+
+
+def _note_resume(path, step, fallbacks=0):
+    global _resume_info
+    _resume_info = {"path": path, "step": int(step),
+                    "fallbacks": int(fallbacks)}
+    print(f"mx.resilience: resumed from {path} (step {step}"
+          + (f", {fallbacks} corrupt checkpoint(s) skipped" if fallbacks
+             else "") + ")", file=sys.stderr)
+    if _telemetry._enabled:
+        _telemetry.event("resume", path=path, step=int(step),
+                         fallbacks=fallbacks)
+    try:
+        from . import diagnostics as _diagnostics
+        _diagnostics.record_event("resume", path=path, step=int(step),
+                                  fallbacks=fallbacks)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# trainer hooks (ShardedTrainer / pipeline trainers call these; both are
+# gated on the module bool so the disabled path is one check)
+# ---------------------------------------------------------------------------
+
+def manager_for(trainer, base_dir=None):
+    """Get-or-create the CheckpointManager for a trainer (None when no
+    checkpoint directory is configured). Cached ON the trainer object so
+    the manager's lifetime is exactly the trainer's — a module-level map
+    would pin every trainer (params, optimizer state and all) for the
+    life of the process."""
+    base_dir = base_dir or _config.get("checkpoint_dir")
+    if not base_dir:
+        return None
+    mgr = getattr(trainer, "_resilience_mgr", None)
+    if mgr is None or os.path.abspath(str(base_dir)) != mgr.base_dir:
+        mgr = CheckpointManager(trainer, base_dir)
+        trainer._resilience_mgr = mgr
+    return mgr
+
+
+def on_trainer_init(trainer):
+    """Called at ShardedTrainer construction while enabled: auto-resume
+    per the `resume` knob ("auto" = newest verified checkpoint under
+    checkpoint_dir; an explicit path = that checkpoint, verified)."""
+    resume = _config.get("resume")
+    if not resume:
+        return None
+    if not getattr(trainer, "_ready", True):
+        print("mx.resilience: trainer has deferred-shape parameters — "
+              "auto-resume skipped (run one step, then load_states "
+              "explicitly)", file=sys.stderr)
+        return None
+    if resume == "auto":
+        mgr = manager_for(trainer)
+        if mgr is None:
+            return None
+        return mgr.restore_latest()
+    mgr = CheckpointManager(trainer, os.path.dirname(
+        os.path.abspath(resume)) or ".")
+    mgr.restore(resume)
+    _note_resume(resume, int(trainer.num_update))
+    return int(trainer.num_update)
+
+
+def on_step(trainer):
+    """The per-step resilience hook (called only while enabled): periodic
+    checkpoint FIRST (so a same-step fault resumes past itself), then
+    fault injection, then the preemption flag — finishing the in-flight
+    step, writing a final checkpoint, and exiting EXIT_PREEMPTED."""
+    step = int(trainer.num_update)
+    mgr = manager_for(trainer)
+    every = _config.get("checkpoint_every_n_steps")
+    if mgr is not None and every > 0 and step % every == 0:
+        mgr.save()
+    if _injector is not None:
+        _injector.fire("step", step=step)
+    if _preempt["flag"]:
+        _finalize_preemption(mgr, step)
+
+
+def note_preemption(step, path=None, signum=None):
+    """Record one graceful preemption in telemetry + diagnostics (shared
+    by the trainer and estimator preemption paths, so preemptions_total
+    means the same thing whichever loop handled the signal)."""
+    signum = signum if signum is not None else _preempt["signum"]
+    if _telemetry._enabled:
+        _M_PREEMPTIONS.inc()
+        _telemetry.event("preempt", step=step, signum=signum, path=path)
+    try:
+        from . import diagnostics as _diagnostics
+        _diagnostics.record_event("preempt", step=step, signum=signum,
+                                  path=path)
+    except Exception:
+        pass
+
+
+def _finalize_preemption(mgr, step):
+    signum = _preempt["signum"]
+    path = None
+    save_failed = False
+    if mgr is not None:
+        try:
+            # save() dedupes a step the periodic hook just wrote — that
+            # existing checkpoint is still THE final state, so report it
+            path = mgr.save() or mgr.last_saved_path()
+        except Exception as e:         # noqa: BLE001 — still exit, loudly
+            save_failed = True
+            print(f"mx.resilience: final preemption checkpoint failed: {e}",
+                  file=sys.stderr)
+    note_preemption(step, path=path, signum=signum)
+    if save_failed:
+        # EXIT_PREEMPTED means "state saved, safe to resume the last
+        # interval" — a failed final save must NOT claim it. Exit with
+        # the conventional fatal-signal code so supervisors see the loss.
+        code = 128 + int(signum or _signal.SIGTERM)
+        print(f"mx.resilience: preempted (signal {signum}) but the final "
+              f"checkpoint FAILED — exiting {code}, resume will use the "
+              "last periodic checkpoint", file=sys.stderr)
+        raise SystemExit(code)
+    msg = (f"mx.resilience: preempted (signal {signum}) — "
+           + (f"checkpoint saved at step {step} ({path}); " if path
+              else "no checkpoint_dir configured; ")
+           + f"exiting {EXIT_PREEMPTED}")
+    print(msg, file=sys.stderr)
+    raise PreemptedExit(msg)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic fault injection driven by the `fault_inject` knob.
+
+    Spec grammar (comma-separated list):
+      sigterm@step:5        — raise SIGTERM in-process after step 5 completes
+      kill@step:3           — SIGKILL the process after step 3 (rank death)
+      corrupt_ckpt@step:4   — flip bytes in the checkpoint written at step 4
+                              (AFTER its manifest: restore must detect it)
+      stall_input:250       — one 250 ms stall inside the input pipeline
+      exc@step:2            — raise RuntimeError after step 2 (crash path)
+    Any spec may append @rank:N to fire on that rank only. Specs fire at
+    most once, and only on the FIRST launch (MXNET_TPU_RESTART_COUNT=0)
+    unless @every_restart is appended — a relaunched gang must not re-kill
+    itself at the same step forever."""
+
+    def __init__(self, specs):
+        self._specs = list(specs)
+
+    @classmethod
+    def from_config(cls):
+        raw = _config.get("fault_inject")
+        if not raw:
+            return None
+        return cls.parse(raw)
+
+    @classmethod
+    def parse(cls, raw):
+        specs = []
+        for part in str(raw).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split("@")
+            head = fields[0]
+            kind, _, arg = head.partition(":")
+            spec = {"kind": kind, "arg": arg, "step": None, "rank": None,
+                    "every_restart": False, "fired": False}
+            for field in fields[1:]:
+                k, _, v = field.partition(":")
+                if k == "step":
+                    spec["step"] = int(v)
+                elif k == "rank":
+                    spec["rank"] = int(v)
+                elif k == "every_restart":
+                    spec["every_restart"] = True
+                else:
+                    raise ValueError(
+                        f"fault_inject: unknown qualifier {field!r} in "
+                        f"{part!r}")
+            if spec["kind"] not in ("sigterm", "kill", "corrupt_ckpt",
+                                    "stall_input", "exc"):
+                raise ValueError(
+                    f"fault_inject: unknown fault {spec['kind']!r} in "
+                    f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
+                    "stall_input, exc)")
+            specs.append(spec)
+        return cls(specs)
+
+    def fire(self, point, step=None, path=None):
+        """Run every armed spec matching this fault point. `point` is
+        "step" (trainer step boundary), "ckpt" (checkpoint just written),
+        or "input" (input pipeline worker)."""
+        rank = _process_index()
+        for spec in self._specs:
+            if spec["fired"]:
+                continue
+            if spec["rank"] is not None and spec["rank"] != rank:
+                continue
+            if not spec["every_restart"] and restart_count() > 0:
+                continue
+            kind = spec["kind"]
+            if point == "step" and kind in ("sigterm", "kill", "exc"):
+                if spec["step"] is not None and step != spec["step"]:
+                    continue
+                spec["fired"] = True
+                self._fire_process_fault(kind, step)
+            elif point == "ckpt" and kind == "corrupt_ckpt":
+                if spec["step"] is not None and step != spec["step"]:
+                    continue
+                spec["fired"] = True
+                self.corrupt_checkpoint(path)
+            elif point == "input" and kind == "stall_input":
+                spec["fired"] = True
+                ms = float(spec["arg"] or 100)
+                print(f"mx.resilience: fault injection: stalling input "
+                      f"{ms:.0f} ms", file=sys.stderr)
+                time.sleep(ms / 1000.0)
+
+    def _fire_process_fault(self, kind, step):
+        print(f"mx.resilience: fault injection: {kind} at step {step} "
+              f"(rank {_process_index()})", file=sys.stderr)
+        sys.stderr.flush()
+        if kind == "sigterm":
+            os.kill(os.getpid(), _signal.SIGTERM)
+        elif kind == "kill":
+            os.kill(os.getpid(), _signal.SIGKILL)   # no cleanup: rank death
+        elif kind == "exc":
+            raise RuntimeError(
+                f"mx.resilience fault injection: crash at step {step}")
+
+    @staticmethod
+    def corrupt_checkpoint(path):
+        """Flip bytes in the largest payload file of a written checkpoint
+        WITHOUT touching its manifest — exactly the torn-write/bit-rot
+        case verify_checkpoint must catch."""
+        if not path or not os.path.isdir(path):
+            return
+        target, size = None, -1
+        for rel, full in _walk_files(path):
+            if rel == _MANIFEST:
+                continue
+            s = os.path.getsize(full)
+            if s > size:
+                target, size = full, s
+        if target is None or size == 0:
+            return
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([chunk[0] ^ 0xFF if chunk else 0xFF]))
+        print(f"mx.resilience: fault injection: corrupted {target}",
+              file=sys.stderr)
+
+
+def fault_point(point, step=None, path=None):
+    """Hook production code paths call (only does anything while enabled
+    AND a fault_inject spec is armed — the common case is one None
+    check)."""
+    inj = _injector
+    if inj is not None and _enabled:
+        inj.fire(point, step=step, path=path)
+
+
+# ---------------------------------------------------------------------------
+# estimator checkpointing (epoch-granularity fit-loop state)
+# ---------------------------------------------------------------------------
+
+_FIT_STATE = "fit_state.json"
+
+
+def save_estimator(est, base_dir):
+    """Atomic verified checkpoint of an Estimator fit loop: net params,
+    gluon-Trainer optimizer state, epoch/batch counters, global RNG.
+    Called at epoch boundaries only — a mid-epoch save would be replayed
+    against from the epoch's start and double-apply the partial epoch."""
+    import jax
+    import numpy as np
+
+    from . import random as _random
+
+    epoch = int(est.num_epoch)
+
+    def _writer(tmp):
+        est.net.save_parameters(os.path.join(tmp, "net.params"))
+        est.trainer.save_states(os.path.join(tmp, "trainer.states"))
+        key = np.asarray(jax.random.key_data(_random.get_state()))
+        state = {"num_epoch": epoch, "num_batch": int(est.num_batch),
+                 "rng_key": [int(x) for x in key.ravel()],
+                 "rng_shape": list(key.shape),
+                 "rng_dtype": str(key.dtype)}
+        with open(os.path.join(tmp, _FIT_STATE), "w") as f:
+            json.dump(state, f)
+    t0 = time.perf_counter()
+    path = RetryPolicy().call(
+        write_checkpoint,
+        os.path.join(str(base_dir), f"{_STEP_PREFIX}{epoch:010d}"),
+        _writer, step=epoch, fingerprint={"trainer": "Estimator"},
+        site="checkpoint-io")
+    if _telemetry._enabled:
+        _M_SAVE_SECONDS.observe(time.perf_counter() - t0)
+        _telemetry.event("checkpoint", step=epoch, path=path,
+                         dur_s=round(time.perf_counter() - t0, 6))
+    _gc_estimator(base_dir)
+    return path
+
+
+def _gc_estimator(base_dir):
+    keep = int(_config.get("checkpoint_keep"))
+    if keep <= 0 or not _owns_gc():
+        return
+    _recover_displaced(base_dir)
+    for _step, path in list_checkpoints(base_dir)[:-keep]:
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def restore_estimator(est, base_dir, resume="auto"):
+    """Restore the newest verified Estimator checkpoint (or the explicit
+    `resume` path), falling back past corrupt ones. Returns the restored
+    epoch or None. The fit loop then skips already-consumed epochs via
+    the restored num_epoch."""
+    import numpy as np
+
+    from . import random as _random
+
+    _recover_displaced(base_dir)
+    if resume != "auto":
+        candidates = [(None, str(resume))]
+    else:
+        candidates = list(reversed(list_checkpoints(base_dir)))
+    fallbacks = 0
+    for _step, path in candidates:
+        try:
+            manifest = verify_checkpoint(path)
+            check_fingerprint(manifest, {"trainer": "Estimator"}, path)
+            with open(os.path.join(path, _FIT_STATE)) as f:
+                state = json.load(f)
+            est.net.load_parameters(os.path.join(path, "net.params"))
+            est.trainer.load_states(os.path.join(path, "trainer.states"))
+        except (CheckpointCorruptError, OSError, ValueError) as e:
+            if resume != "auto":
+                raise
+            fallbacks += 1
+            if _telemetry._enabled:
+                _M_VERIFY_FAILURES.inc()
+            print(f"mx.resilience: rejecting checkpoint: {e} — falling "
+                  "back to the previous one", file=sys.stderr)
+            continue
+        est.num_epoch = int(state["num_epoch"])
+        est.num_batch = int(state["num_batch"])
+        key = np.asarray(state["rng_key"],
+                         dtype=state.get("rng_dtype", "uint32"))
+        _random.set_state(key.reshape(state.get("rng_shape", key.shape)))
+        _note_resume(path, est.num_epoch, fallbacks)
+        return est.num_epoch
+    return None
+
+
+if _config.get("resilience"):
+    install()

@@ -28,6 +28,9 @@ Instrumented layers (each site degrades to the bool check when disabled):
   * dataflow.py             — device-staging depth (stage="device"), H2D
                               bytes, staging-wait histogram, bucket-pad
                               waste, persistent compile-cache hits/misses
+  * resilience.py           — checkpoint save/restore seconds, verify
+                              failures, restarts/preemptions/retries
+                              counters
 
 Config: `telemetry` (enable at import), `telemetry_jsonl_path` (auto-flush
 target), `telemetry_flush_interval` (seconds between auto-flushes) — all in

@@ -60,6 +60,7 @@ def test_ring_memory_linear_in_length():
         f"ring temp {t8k} bytes is within 16x of one dense score matrix")
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_bert_long_config_8k_sp8_trains():
     """bert_long_config at its REAL max_length (8192), sp=8: the step must
     compile, run, and learn. Width is shrunk (the length is what this test

@@ -117,6 +117,7 @@ def test_sharded_trainer_lamb_and_scheduler():
     assert np.isfinite(float(loss.asscalar()))
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_ring_attention_matches_reference():
     parallel.make_mesh(sp=8)
     B, H, L, D = 2, 4, 64, 16
@@ -130,6 +131,7 @@ def test_ring_attention_matches_reference():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_ring_attention_causal_and_mask():
     parallel.make_mesh(sp=8)
     B, H, L, D = 1, 2, 64, 8

@@ -134,6 +134,7 @@ def test_pipeline_microbatch_divisibility():
         tr.step([nd.array(toks)], [nd.array(labels)])
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_pipeline_stage_dropout_varies_per_step():
     """Stage dropout gets a per-(step, stage) folded key — repeated steps on
     the SAME batch must see different masks (different losses)."""
@@ -176,6 +177,7 @@ def test_pipeline_plain_callable_head():
     assert np.isfinite(l0)
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_pipeline_handles_new_sequence_length():
     """Per-shape activation probe: a later batch with a different seq len
     must build a matching pipeline carrier, not reuse the first probe's."""
@@ -209,6 +211,7 @@ def test_homogeneous_pipeline_still_works():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5)
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_pipeline_trainer_save_load_states(tmp_path):
     """PipelineCheckpointMixin: a pipeline trainer checkpoints and a FRESH
     differently-seeded trainer resumes the exact trajectory."""

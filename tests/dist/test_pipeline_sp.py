@@ -96,6 +96,7 @@ def _batches(n, batch=8):
     return out
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_pp_dp_sp_matches_unpiped():
     steps = 4
     batches = _batches(steps)

@@ -31,6 +31,7 @@ def _qkv(B=2, H=4, L=64, D=16, seed=0):
             for _ in range(3)]
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_grad_parity(causal):
     q, k, v = _qkv()
@@ -118,6 +119,7 @@ def test_bert_sp2_loss_parity():
     np.testing.assert_allclose(sp, ref, rtol=2e-4)
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_bert_sp2_ulysses_loss_parity():
     """seq_parallel='ulysses' (all-to-all head<->sequence reshard) through
     the SAME ShardedTrainer path: dp=2 x sp=2 must match the dp=4 dense

@@ -63,6 +63,7 @@ def test_ulysses_causal_and_mask():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heavy compile: runs in ci/run.sh dist, not tier-1
 def test_ulysses_agrees_with_ring():
     parallel.make_mesh(sp=8)
     rng = np.random.RandomState(2)

@@ -1,0 +1,857 @@
+"""mx.resilience tests: retry policy, atomic verified checkpoints with
+corrupt-fallback + mesh rejection, periodic checkpoint + auto-resume,
+graceful SIGTERM preemption, fault injection, estimator fit resume,
+input-pipeline recovery, and the kill-and-relaunch acceptance workflow."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, dataflow, nd, parallel, resilience, telemetry
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    yield
+    resilience.uninstall()
+    resilience.clear_preempted()
+    config.reset()
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _xy():
+    return (nd.array(np.ones((8, 8), np.float32)),
+            nd.array(np.zeros((8, 4), np.float32)))
+
+
+def _trainer(seed=0, optimizer="sgd", dropout=False):
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(seed)
+    if dropout:
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=8), nn.Dropout(0.5),
+                nn.Dense(4, in_units=8))
+    else:
+        net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    params = {"learning_rate": 0.1}
+    return parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), optimizer,
+                                   params)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_policy_recovers_then_exhausts():
+    calls = {"n": 0}
+
+    def flaky(fail_times):
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            raise OSError("transient")
+        return "ok"
+
+    p = resilience.RetryPolicy(max_attempts=3, backoff_s=0.001, jitter=0)
+    assert p.call(flaky, 2) == "ok"
+    assert calls["n"] == 3
+
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        p.call(flaky, 5)
+    assert calls["n"] == 3              # max_attempts total tries
+
+
+def test_retry_policy_nonretryable_immediate():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("logic bug")
+
+    p = resilience.RetryPolicy(max_attempts=5, backoff_s=0.001)
+    with pytest.raises(ValueError):
+        p.call(bad)
+    assert calls["n"] == 1
+
+
+def test_retry_policy_abort_stops_early():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise OSError("transient")
+
+    p = resilience.RetryPolicy(max_attempts=10, backoff_s=0.001, jitter=0)
+    with pytest.raises(OSError):
+        p.call(flaky, abort=lambda: calls["n"] >= 2)
+    assert calls["n"] == 2
+
+
+def test_retry_policy_backoff_exponential_capped():
+    p = resilience.RetryPolicy(max_attempts=10, backoff_s=1.0,
+                               max_backoff_s=5.0, jitter=0)
+    assert [p.delay(k) for k in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    pj = resilience.RetryPolicy(backoff_s=1.0, jitter=0.25)
+    for k in range(4):
+        assert 0.75 * min(2.0 ** k, 30.0) <= pj.delay(k) \
+            <= 1.25 * min(2.0 ** k, 30.0)
+
+
+def test_retry_policy_reads_config_knobs():
+    config.set("retry_max_attempts", 7)
+    config.set("retry_backoff_s", 0.125)
+    p = resilience.RetryPolicy()
+    assert p.max_attempts == 7 and p.backoff_s == 0.125
+
+
+# -- fault-injection spec parsing -------------------------------------------
+
+def test_fault_injector_parse():
+    inj = resilience.FaultInjector.parse(
+        "sigterm@step:5, kill@step:3@rank:1, corrupt_ckpt@step:4,"
+        "stall_input:250, exc@step:2@every_restart")
+    kinds = [s["kind"] for s in inj._specs]
+    assert kinds == ["sigterm", "kill", "corrupt_ckpt", "stall_input", "exc"]
+    assert inj._specs[1]["rank"] == 1 and inj._specs[1]["step"] == 3
+    assert inj._specs[4]["every_restart"]
+    with pytest.raises(ValueError):
+        resilience.FaultInjector.parse("meteor@step:1")
+    with pytest.raises(ValueError):
+        resilience.FaultInjector.parse("kill@when:3")
+
+
+def test_fault_injector_rank_filter_and_one_shot(monkeypatch):
+    fired = []
+    inj = resilience.FaultInjector.parse("exc@step:2@rank:1")
+    monkeypatch.setattr(resilience, "_process_index", lambda: 0)
+    inj.fire("step", step=2)            # wrong rank: nothing
+    monkeypatch.setattr(resilience, "_process_index", lambda: 1)
+    with pytest.raises(RuntimeError, match="fault injection"):
+        inj.fire("step", step=2)
+    inj.fire("step", step=2)            # one-shot: spent
+    assert inj._specs[0]["fired"]
+    del fired
+
+
+def test_fault_injector_disarmed_after_restart(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_RESTART_COUNT", "1")
+    inj = resilience.FaultInjector.parse("exc@step:2")
+    inj.fire("step", step=2)            # relaunched gang: must not re-fire
+    assert not inj._specs[0]["fired"]
+    inj2 = resilience.FaultInjector.parse("exc@step:2@every_restart")
+    with pytest.raises(RuntimeError):
+        inj2.fire("step", step=2)
+
+
+# -- atomic verified checkpoint store ---------------------------------------
+
+def test_write_verify_roundtrip_and_corruption(tmp_path):
+    d = str(tmp_path / "ck" / "step_0000000001")
+
+    def writer(tmp):
+        with open(os.path.join(tmp, "payload.bin"), "wb") as f:
+            f.write(b"x" * 4096)
+        os.makedirs(os.path.join(tmp, "sub"))
+        with open(os.path.join(tmp, "sub", "more.bin"), "wb") as f:
+            f.write(b"y" * 128)
+
+    resilience.write_checkpoint(d, writer, step=1, fingerprint={"k": "v"})
+    man = resilience.verify_checkpoint(d)
+    assert man["step"] == 1 and man["fingerprint"] == {"k": "v"}
+    assert set(man["files"]) == {"payload.bin", os.path.join("sub",
+                                                             "more.bin")}
+    # no tmp leftovers, and the listing sees exactly one checkpoint
+    assert os.listdir(str(tmp_path / "ck")) == ["step_0000000001"]
+    assert resilience.list_checkpoints(str(tmp_path / "ck")) == [(1, d)]
+
+    # corruption: checksum mismatch names the file
+    resilience.FaultInjector.corrupt_checkpoint(d)
+    with pytest.raises(resilience.CheckpointCorruptError,
+                       match="payload.bin"):
+        resilience.verify_checkpoint(d)
+
+    # torn write (no manifest) is corrupt, and tmp dirs are invisible
+    torn = str(tmp_path / "ck" / "step_0000000002")
+    os.makedirs(torn)
+    with pytest.raises(resilience.CheckpointCorruptError, match="manifest"):
+        resilience.verify_checkpoint(torn)
+    os.rename(torn, torn + ".tmp-123")
+    assert resilience.list_checkpoints(str(tmp_path / "ck")) == [(1, d)]
+
+
+def test_write_checkpoint_replaces_existing(tmp_path):
+    d = str(tmp_path / "step_0000000001")
+    for payload in (b"first", b"second-longer"):
+        resilience.write_checkpoint(
+            d, lambda tmp, p=payload: open(
+                os.path.join(tmp, "f.bin"), "wb").write(p), step=1)
+    assert open(os.path.join(d, "f.bin"), "rb").read() == b"second-longer"
+    resilience.verify_checkpoint(d)
+
+
+def test_writer_failure_leaves_no_partial_checkpoint(tmp_path):
+    d = str(tmp_path / "step_0000000003")
+
+    def bad_writer(tmp):
+        with open(os.path.join(tmp, "half.bin"), "wb") as f:
+            f.write(b"z")
+        raise RuntimeError("disk on fire")
+
+    with pytest.raises(RuntimeError):
+        resilience.write_checkpoint(d, bad_writer, step=3)
+    assert not os.path.exists(d)
+    assert resilience.list_checkpoints(str(tmp_path)) == []
+
+
+def test_fingerprint_mismatch_rejected():
+    man = {"fingerprint": {"mesh_shape": {"dp": 8}, "param_mode":
+                           "replicate"}}
+    resilience.check_fingerprint(man, {"mesh_shape": {"dp": 8},
+                                       "param_mode": "replicate"})
+    with pytest.raises(resilience.MeshMismatchError, match="topology"):
+        resilience.check_fingerprint(man, {"mesh_shape": {"dp": 4}})
+    # keys absent from the manifest don't reject (forward compatible)
+    resilience.check_fingerprint(man, {"new_field": 1})
+
+
+# -- CheckpointManager over a real trainer ----------------------------------
+
+def test_manager_save_retention_restore(tmp_path):
+    resilience.enable()
+    config.set("checkpoint_keep", 2)
+    tr = _trainer(seed=1)
+    x, y = _xy()
+    mgr = resilience.CheckpointManager(tr, str(tmp_path / "ck"))
+    for _ in range(4):
+        tr.step(x, y)
+        mgr.save()
+    steps = [s for s, _ in resilience.list_checkpoints(str(tmp_path / "ck"))]
+    assert steps == [3, 4]              # keep-last-2 GC
+    assert mgr.save() is None           # same step: dedup, no new write
+
+    tr2 = _trainer(seed=1)
+    mgr2 = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    assert mgr2.restore_latest() == 4
+    assert tr2.num_update == 4 and float(tr2._t_dev) == 4.0
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path):
+    """Acceptance: a deliberately corrupted latest checkpoint is detected
+    by checksum and restore falls back to the previous good one."""
+    resilience.enable()
+    tr = _trainer(seed=2)
+    x, y = _xy()
+    mgr = resilience.CheckpointManager(tr, str(tmp_path / "ck"))
+    for _ in range(3):
+        tr.step(x, y)
+        mgr.save()
+    ckpts = resilience.list_checkpoints(str(tmp_path / "ck"))
+    resilience.FaultInjector.corrupt_checkpoint(ckpts[-1][1])
+
+    telemetry.reset()
+    telemetry.enable()
+    tr2 = _trainer(seed=2)
+    mgr2 = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    assert mgr2.restore_latest() == 2   # fell back past corrupt step 3
+    assert resilience.last_resume()["fallbacks"] == 1
+    assert telemetry.counter("checkpoint_verify_failures_total").value == 1
+    # and the trainer state really is the step-2 state
+    assert tr2.num_update == 2
+
+
+def test_mesh_mismatch_raises_not_falls_back(tmp_path):
+    resilience.enable()
+    tr = _trainer(seed=3)
+    x, y = _xy()
+    tr.step(x, y)
+    d = str(tmp_path / "ck" / "step_0000000001")
+    tr.save_states(d)
+    mpath = os.path.join(d, "manifest.json")
+    man = json.load(open(mpath))
+    man["fingerprint"]["mesh_shape"]["dp"] = 2
+    json.dump(man, open(mpath, "w"))
+    tr2 = _trainer(seed=3)
+    mgr = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    with pytest.raises(resilience.MeshMismatchError):
+        mgr.restore_latest()
+    with pytest.raises(resilience.MeshMismatchError):
+        tr2.load_states(d)
+
+
+def test_displaced_checkpoint_recovered(tmp_path):
+    """A crash between write_checkpoint's two renames leaves the good
+    copy at step_X.tmp-old; restore must recover it, not lose the step."""
+    resilience.enable()
+    tr = _trainer(seed=4)
+    x, y = _xy()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(tr, str(tmp_path / "ck"))
+    path = mgr.save()
+    # simulate the crash window: old moved aside, new never landed
+    os.rename(path, path + ".tmp-old")
+    assert resilience.list_checkpoints(str(tmp_path / "ck")) == []
+
+    tr2 = _trainer(seed=4)
+    mgr2 = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    assert mgr2.restore_latest() == 1   # recovered, verified, loaded
+    assert os.path.isdir(path)
+
+
+def test_preemption_reports_existing_same_step_checkpoint(tmp_path):
+    """Preemption right after a periodic save must report that
+    checkpoint's path, not pretend nothing was saved."""
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("checkpoint_every_n_steps", 1)   # save fires every step
+    config.set("fault_inject", "sigterm@step:2")
+    resilience.install()
+    tr = _trainer(seed=5)
+    x, y = _xy()
+    telemetry.reset()
+    telemetry.enable()
+    with pytest.raises(SystemExit):
+        for _ in range(5):
+            tr.step(x, y)
+    ev = [e for e in telemetry.events() if e.get("kind") == "preempt"]
+    assert ev and ev[0]["path"] is not None
+    assert ev[0]["path"].endswith("step_0000000002")
+
+
+# -- fused-LAMB + RNG + device-step-counter round trip (satellite) ----------
+
+def test_fused_lamb_rng_counter_roundtrip_bit_exact(tmp_path):
+    resilience.enable()
+    assert config.get("fused_lamb")
+    tr = _trainer(seed=5, optimizer="lamb", dropout=True)
+    assert tr._fused                    # flat f32 master path in play
+    x, y = _xy()
+    for _ in range(3):
+        tr.step(x, y)
+    d = str(tmp_path / "ck" / "step_0000000003")
+    tr.save_states(d)
+    resilience.verify_checkpoint(d)
+    cont = tr.step(x, y).asnumpy()      # uninterrupted step 4
+
+    tr2 = _trainer(seed=99, optimizer="lamb", dropout=True)  # different init
+    tr2.load_states(d)
+    assert tr2.num_update == 3
+    assert int(tr2._t_dev) == 3         # device-resident counter restored
+    resumed = tr2.step(x, y).asnumpy()  # same RNG stream: same dropout mask
+    assert np.array_equal(resumed, cont), (resumed, cont)
+
+
+# -- periodic hook + auto-resume + preemption -------------------------------
+
+def test_periodic_hook_and_auto_resume(tmp_path):
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("checkpoint_every_n_steps", 2)
+    config.set("resume", "auto")
+    resilience.enable()
+    tr = _trainer(seed=6)
+    x, y = _xy()
+    for _ in range(5):
+        tr.step(x, y)
+    steps = [s for s, _ in resilience.list_checkpoints(str(tmp_path / "ck"))]
+    assert steps == [2, 4]
+
+    tr2 = _trainer(seed=6)              # fresh trainer: auto-resumes at 4
+    assert tr2.num_update == 4
+    cont = tr.step(x, y).asnumpy()      # step 6 of the uninterrupted run
+    tr2.step(x, y)                      # 5
+    resumed = tr2.step(x, y).asnumpy()  # 6
+    assert np.array_equal(resumed, cont)
+
+
+def test_sigterm_finishes_step_saves_and_exits_distinct(tmp_path):
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("checkpoint_every_n_steps", 100)   # periodic save never fires
+    resilience.install()
+    assert signal.getsignal(signal.SIGTERM) is resilience._on_signal
+    tr = _trainer(seed=7)
+    x, y = _xy()
+    tr.step(x, y)
+    os.kill(os.getpid(), signal.SIGTERM)          # preemption arrives
+    assert resilience.preempted()
+    with pytest.raises(SystemExit) as ei:
+        tr.step(x, y)                             # in-flight step completes
+    assert ei.value.code == resilience.EXIT_PREEMPTED
+    assert tr.num_update == 2                     # the step DID finish
+    steps = [s for s, _ in resilience.list_checkpoints(str(tmp_path / "ck"))]
+    assert steps == [2]                           # final preemption save
+
+
+def test_sigterm_injection_end_to_end(tmp_path):
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("fault_inject", "sigterm@step:3")
+    resilience.install()
+    tr = _trainer(seed=8)
+    x, y = _xy()
+    with pytest.raises(SystemExit) as ei:
+        for _ in range(10):
+            tr.step(x, y)
+    assert ei.value.code == resilience.EXIT_PREEMPTED
+    assert tr.num_update == 3
+    assert [s for s, _ in resilience.list_checkpoints(
+        str(tmp_path / "ck"))] == [3]
+
+
+def test_corrupt_ckpt_injection_then_fallback(tmp_path):
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("checkpoint_every_n_steps", 2)
+    config.set("fault_inject", "corrupt_ckpt@step:4")
+    resilience.enable()
+    tr = _trainer(seed=9)
+    x, y = _xy()
+    for _ in range(4):
+        tr.step(x, y)
+    tr2 = _trainer(seed=9)
+    mgr = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    assert mgr.restore_latest() == 2    # step-4 checkpoint was corrupted
+
+
+def test_stall_input_injection(monkeypatch):
+    config.set("fault_inject", "stall_input:80")
+    resilience.enable()
+    pf = dataflow.prefetch_to_mesh(iter([]), None, depth=1)
+    pf.close()                          # plumbing only; timing check below
+    t0 = time.perf_counter()
+    resilience.fault_point("input")
+    assert time.perf_counter() - t0 >= 0.08
+    t0 = time.perf_counter()
+    resilience.fault_point("input")     # one-shot: second call is free
+    assert time.perf_counter() - t0 < 0.05
+
+
+# -- estimator fit: checkpoints + resume ------------------------------------
+
+def _make_estimator(lr=0.05):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    return Estimator(net, gloss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": lr})
+
+
+def _fit_loader():
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data import dataset as ds
+    X = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    Y = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    return DataLoader(ds.ArrayDataset(nd.array(X), nd.array(Y)),
+                      batch_size=8, shuffle=False)
+
+
+def test_estimator_fit_resume_bit_exact(tmp_path):
+    ref = _make_estimator()
+    ref.fit(_fit_loader(), epochs=3)
+    w_ref = ref.net.weight.data().asnumpy()
+
+    cd = str(tmp_path / "fit_ck")
+    a = _make_estimator()
+    a.fit(_fit_loader(), epochs=1, checkpoint_dir=cd)
+    assert [s for s, _ in resilience.list_checkpoints(cd)] == [1]
+
+    b = _make_estimator()               # "relaunch": fresh everything
+    b.fit(_fit_loader(), epochs=3, resume="auto", checkpoint_dir=cd)
+    assert b.num_epoch == 3
+    assert np.array_equal(b.net.weight.data().asnumpy(), w_ref)
+
+    # resumed past the end: trains zero additional epochs
+    c = _make_estimator()
+    c.fit(_fit_loader(), epochs=3, resume="auto", checkpoint_dir=cd)
+    assert c.num_epoch == 3
+
+
+def test_estimator_resume_skips_corrupt_checkpoint(tmp_path):
+    cd = str(tmp_path / "fit_ck")
+    a = _make_estimator()
+    a.fit(_fit_loader(), epochs=2, checkpoint_dir=cd)
+    ckpts = resilience.list_checkpoints(cd)
+    assert [s for s, _ in ckpts] == [1, 2]
+    resilience.FaultInjector.corrupt_checkpoint(ckpts[-1][1])
+    b = _make_estimator()
+    b.fit(_fit_loader(), epochs=2, resume="auto", checkpoint_dir=cd)
+    assert resilience.last_resume()["step"] == 1
+
+
+def test_estimator_midepoch_preempt_keeps_boundary_checkpoint(tmp_path):
+    """A SIGTERM mid-epoch must NOT overwrite the clean end-of-epoch
+    checkpoint with mid-epoch params (the resumed run replays the
+    interrupted epoch from its start — a mid-epoch save would double-
+    apply the partial epoch). The boundary checkpoint is the resume
+    point, bit-exact, and the preemption is still counted."""
+    from mxnet_tpu.gluon.contrib.estimator import BatchEnd
+    cd = str(tmp_path / "fit_ck")
+    resilience.install()
+    telemetry.reset()
+    telemetry.enable()
+
+    class KillAt(BatchEnd):
+        def batch_end(self, est):
+            # second epoch's first batch (2 batches/epoch): num_batch == 3
+            if est.num_batch == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    est = _make_estimator()
+    with pytest.raises(SystemExit) as ei:
+        est.fit(_fit_loader(), epochs=3, checkpoint_dir=cd,
+                event_handlers=[KillAt()])
+    assert ei.value.code == resilience.EXIT_PREEMPTED
+    assert telemetry.counter("preemptions_total").value == 1
+    # only the epoch-boundary checkpoint exists; nothing mid-epoch
+    assert [s for s, _ in resilience.list_checkpoints(cd)] == [1]
+
+    resilience.clear_preempted()
+    est2 = _make_estimator()
+    est2.fit(_fit_loader(), epochs=1, resume="auto", checkpoint_dir=cd)
+    assert resilience.last_resume()["step"] == 1
+    assert est2.num_batch == 2          # counter from the epoch boundary
+
+    # the restored params are the CLEAN end-of-epoch-1 state: bit-exact
+    # with an uninterrupted 1-epoch run, untouched by the partial epoch 2
+    ref = _make_estimator()
+    ref.fit(_fit_loader(), epochs=1)
+    assert np.array_equal(est2.net.weight.data().asnumpy(),
+                          ref.net.weight.data().asnumpy())
+
+
+def test_estimator_knob_paths_gated_on_enable(tmp_path):
+    # knob set but resilience disabled: fit must NOT write checkpoints
+    config.set("checkpoint_dir", str(tmp_path / "off"))
+    a = _make_estimator()
+    a.fit(_fit_loader(), epochs=1)
+    assert not os.path.exists(str(tmp_path / "off"))
+    # enabled: the knob drives epoch checkpoints without any fit() args
+    resilience.enable()
+    b = _make_estimator()
+    b.fit(_fit_loader(), epochs=1)
+    assert [s for s, _ in resilience.list_checkpoints(
+        str(tmp_path / "off"))] == [1]
+
+
+# -- input pipeline recovery ------------------------------------------------
+
+def test_prefetch_close_idempotent_and_reentrant():
+    pf = dataflow.prefetch_to_mesh(
+        iter([([nd.array(np.ones((4, 2), np.float32))],
+               [nd.array(np.zeros((4, 1), np.float32))])] * 4), None,
+        depth=2)
+    next(pf)
+    pf.close()
+    assert pf._close_done
+    pf.close()                          # idempotent
+    pf.close()
+    with pf:                            # __exit__ path too
+        pass
+
+
+def test_prefetch_stage_retry_under_resilience(monkeypatch):
+    config.set("retry_backoff_s", 0.01)
+    resilience.enable()
+    real = dataflow._Stager.__call__
+    state = {"fails": 1}
+
+    def flaky(self, item):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise OSError("transient staging failure")
+        return real(self, item)
+
+    monkeypatch.setattr(dataflow._Stager, "__call__", flaky)
+    src = [([nd.array(np.ones((4, 2), np.float32))],
+            [nd.array(np.zeros((4, 1), np.float32))])] * 3
+    got = list(dataflow.prefetch_to_mesh(iter(src), None, depth=2))
+    assert len(got) == 3                # the transient failure was retried
+
+    # disabled: the same failure propagates to the consumer
+    resilience.disable()
+    state["fails"] = 1
+    pf = dataflow.prefetch_to_mesh(iter(src), None, depth=2)
+    with pytest.raises(OSError, match="transient staging"):
+        list(pf)
+
+
+def test_dataloader_worker_death_respawns(tmp_path):
+    from mxnet_tpu.gluon.data import DataLoader
+    marker = str(tmp_path / "died_once")
+
+    class DieOnce:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 3 and not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(9)             # silent death: no result, no error
+            return np.full((2,), i, np.float32)
+
+    config.set("retry_backoff_s", 0.01)
+    resilience.enable()
+    batches = list(DataLoader(DieOnce(), batch_size=2, num_workers=1))
+    assert len(batches) == 4
+    assert os.path.exists(marker)
+    # order preserved despite the respawn re-enqueue
+    assert [float(b[0, 0].asscalar()) for b in batches] == [0, 2, 4, 6]
+
+
+def test_dataloader_worker_death_fatal_when_disabled(tmp_path):
+    from mxnet_tpu.gluon.data import DataLoader
+
+    class AlwaysDie:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                os._exit(9)
+            return np.full((2,), i, np.float32)
+
+    with pytest.raises(RuntimeError, match="died with exit code"):
+        list(DataLoader(AlwaysDie(), batch_size=2, num_workers=1))
+
+
+# -- telemetry / diagnostics surfaces ---------------------------------------
+
+def test_checkpoint_telemetry_and_postmortem_resume(tmp_path):
+    from mxnet_tpu import diagnostics
+    telemetry.reset()
+    telemetry.enable()
+    resilience.enable()
+    tr = _trainer(seed=10)
+    x, y = _xy()
+    tr.step(x, y)
+    mgr = resilience.CheckpointManager(tr, str(tmp_path / "ck"))
+    mgr.save()
+    assert telemetry.histogram("checkpoint_save_seconds").count == 1
+    events = [e for e in telemetry.events() if e.get("kind") == "checkpoint"]
+    assert events and events[0]["step"] == 1
+
+    tr2 = _trainer(seed=10)
+    mgr2 = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
+    mgr2.restore_latest()
+    diagnostics.enable()
+    try:
+        pm_path = diagnostics.dump(
+            reason="manual", path=str(tmp_path / "pm.json"))
+        pm = json.load(open(pm_path))
+        assert pm["resume"]["step"] == 1
+        assert pm["resume"]["path"].endswith("step_0000000001")
+    finally:
+        diagnostics.disable()
+        diagnostics.reset()
+
+
+def test_restart_count_feeds_restarts_total(monkeypatch):
+    telemetry.reset()
+    telemetry.enable()
+    monkeypatch.setenv("MXNET_TPU_RESTART_COUNT", "2")
+    resilience.install()
+    assert telemetry.counter("restarts_total").value == 2
+
+
+# -- disabled fast path ------------------------------------------------------
+
+def test_disabled_fast_path_no_handlers_no_hashing(tmp_path, monkeypatch):
+    assert not resilience.enabled()
+    before = signal.getsignal(signal.SIGTERM)
+    assert before is not resilience._on_signal
+
+    calls = {"on_step": 0, "crc": 0}
+    real_on_step = resilience.on_step
+    real_crc = resilience._file_crc
+    monkeypatch.setattr(resilience, "on_step", lambda t: (
+        calls.__setitem__("on_step", calls["on_step"] + 1),
+        real_on_step(t))[1])
+    monkeypatch.setattr(resilience, "_file_crc", lambda p: (
+        calls.__setitem__("crc", calls["crc"] + 1), real_crc(p))[1])
+
+    tr = _trainer(seed=11)
+    x, y = _xy()
+    for _ in range(3):
+        tr.step(x, y)
+    d = str(tmp_path / "plain")
+    tr.save_states(d)
+    tr.load_states(d)
+    assert calls == {"on_step": 0, "crc": 0}
+    assert not os.path.exists(os.path.join(d, "manifest.json"))
+
+
+# -- launcher: _kill fix + supervised relaunch ------------------------------
+
+def test_launch_sigterm_forwards_reaps_and_flushes_tee(tmp_path):
+    diag = str(tmp_path / "diag")
+    worker = tmp_path / "w.py"
+    worker.write_text(
+        "import sys, time\n"
+        "print('worker alive', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = subprocess.Popen(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--diagnostics-dir", diag, sys.executable, str(worker)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # wait for both workers to be up (their line reached the tee)
+    deadline = time.time() + 60
+    logs = [os.path.join(diag, str(r), "worker.log") for r in (0, 1)]
+    while time.time() < deadline:
+        if all(os.path.exists(p) and "worker alive" in open(p).read()
+               for p in logs):
+            break
+        time.sleep(0.2)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc == 128 + signal.SIGTERM
+    # the tee pumps were joined: tail output flushed, nothing lost
+    for p in logs:
+        assert "worker alive" in open(p).read()
+
+
+def test_launch_max_restarts_relaunches_gang(tmp_path):
+    diag = str(tmp_path / "diag")
+    worker = tmp_path / "w.py"
+    # fails with 7 on the first launch, succeeds on the relaunch
+    worker.write_text(
+        "import os, sys\n"
+        "restart = int(os.environ['MXNET_TPU_RESTART_COUNT'])\n"
+        "rank = os.environ['JAX_PROCESS_ID']\n"
+        "print(f'launch gen {restart} rank {rank}', flush=True)\n"
+        "sys.exit(7 if restart == 0 and rank == '1' else 0)\n")
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "2", "--restart-backoff", "0.1",
+         "--diagnostics-dir", diag, sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "relaunching" in r.stderr
+    events = [json.loads(line) for line in
+              open(os.path.join(diag, "restarts.jsonl"))]
+    assert len(events) == 1
+    assert events[0]["failed_rank"] == 1 and events[0]["exit_code"] == 7
+    # the relaunch APPENDS to worker.log — the failed attempt's output
+    # (the evidence of why it died) must survive the restart
+    log1 = open(os.path.join(diag, "1", "worker.log")).read()
+    assert "launch gen 0 rank 1" in log1
+    assert "=== relaunch attempt 1 ===" in log1
+    assert "launch gen 1 rank 1" in log1
+
+
+def test_launch_max_restarts_exhausted_returns_failure(tmp_path):
+    worker = tmp_path / "w.py"
+    worker.write_text("import sys; sys.exit(5)\n")
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "1", "--launcher", "local",
+         "--max-restarts", "1", "--restart-backoff", "0.1",
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 5
+
+
+# -- kill-and-relaunch acceptance -------------------------------------------
+
+_KILL_WORKER = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + \
+        " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, {root!r})
+import hashlib
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, resilience, config
+from mxnet_tpu.gluon import nn, loss as gloss
+
+rank = int(os.environ.get("JAX_PROCESS_ID", "0"))
+base, total = sys.argv[1], int(sys.argv[2])
+config.set("checkpoint_dir", os.path.join(base, "ck", str(rank)))
+config.set("checkpoint_every_n_steps", 1)
+config.set("resume", "auto")
+resilience.install()
+
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                             {{"learning_rate": 0.1}})
+rs = np.random.RandomState(42)
+batches = [(rs.randn(8, 8).astype(np.float32),
+            rs.randn(8, 4).astype(np.float32)) for _ in range(total)]
+while tr.num_update < total:
+    xb, yb = batches[tr.num_update]
+    tr.step(nd.array(xb), nd.array(yb))
+# final artifact derived purely from final state (safe to recompute when
+# a relaunch resumes past the end): eval loss on the last batch + a
+# digest of the trained parameters
+tr.sync_to_block()
+out = net(nd.array(batches[-1][0]))
+final = float(lfn(out, nd.array(batches[-1][1])).asnumpy().mean())
+w = np.concatenate([p.data().asnumpy().ravel()
+                    for _n, p in sorted(net.collect_params().items())])
+digest = hashlib.sha1(np.ascontiguousarray(w).tobytes()).hexdigest()
+tmp = os.path.join(base, f"final_{{rank}}.txt.tmp")
+with open(tmp, "w") as f:
+    f.write(f"{{final!r}} {{digest}}")
+os.replace(tmp, os.path.join(base, f"final_{{rank}}.txt"))
+print(f"rank {{rank}} done at step {{tr.num_update}}: {{final!r}}",
+      flush=True)
+"""
+
+
+@pytest.mark.slow  # 5 subprocess jax sessions; ci/run.sh sanity runs it
+def test_kill_and_relaunch_resumes_bit_exact(tmp_path):
+    """Acceptance: a 2-rank run killed mid-training (SIGKILL of rank 1 at
+    step 3) is torn down and relaunched by the supervisor, auto-resumes
+    from the last good checkpoint, and reaches the SAME final loss and
+    parameter digest (bit-exact step replay) as an uninterrupted run."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_KILL_WORKER.format(root=ROOT))
+    total = 6
+
+    # uninterrupted reference (single process, rank 0)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PROCESS_ID", "MXNET_TPU_FAULT_INJECT")}
+    r = subprocess.run(
+        [sys.executable, str(worker), str(ref_dir), str(total)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    ref = open(ref_dir / "final_0.txt").read()
+
+    # interrupted run: rank 1 SIGKILLed at step 3, supervisor relaunches
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    env = dict(env)
+    env["MXNET_TPU_FAULT_INJECT"] = "kill@step:3@rank:1"
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "2", "--restart-backoff", "0.1",
+         "--diagnostics-dir", str(run_dir / "diag"),
+         sys.executable, str(worker), str(run_dir), str(total)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "relaunching" in r.stderr
+
+    for rank in (0, 1):
+        got = open(run_dir / f"final_{rank}.txt").read()
+        assert got == ref, (rank, got, ref)
+    # the relaunch really did resume (not restart from scratch): rank 1's
+    # second incarnation logs a resume line
+    log1 = open(run_dir / "diag" / "1" / "worker.log").read()
+    assert "resumed from" in log1
+    events = [json.loads(line) for line in
+              open(run_dir / "diag" / "restarts.jsonl")]
+    assert events[0]["failed_rank"] == 1

@@ -20,7 +20,12 @@ XLA collectives replace the parameter server. So this launcher:
     `mx.diagnostics` at `<dir>` so crashes leave
     `<dir>/<rank>/postmortem.json` (merge with tools/postmortem_report.py),
   * exits with the FIRST nonzero worker exit code (by rank) instead of
-    flattening every failure to 1.
+    flattening every failure to 1,
+  * with `--max-restarts N` supervises the gang: when any rank dies it
+    tears down the peers, backs off exponentially, and relaunches the
+    whole gang (workers running mx.resilience with resume='auto' then
+    continue from the last good checkpoint); restart events append to
+    `<diagnostics-dir>/restarts.jsonl`.
 
 `-s` (servers) is accepted and ignored with a warning: there are no
 parameter servers on TPU (SURVEY.md §2.5).
@@ -33,14 +38,23 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import random
 import signal
 import subprocess
 import sys
 import threading
+import time
+
+# mirror of mxnet_tpu.resilience.EXIT_PREEMPTED (the launcher must stay
+# import-light — no jax): a worker exiting with this code saved a final
+# checkpoint on SIGTERM and is safe to relaunch
+EXIT_PREEMPTED = 83
 
 
-def build_env(rank, num_workers, coordinator, diagnostics_dir=None):
+def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
+              restart_count=0):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -55,6 +69,9 @@ def build_env(rank, num_workers, coordinator, diagnostics_dir=None):
         "DMLC_WORKER_ID": str(rank),
         "DMLC_PS_ROOT_URI": coordinator.split(":")[0],
         "DMLC_PS_ROOT_PORT": coordinator.split(":")[1],
+        # supervised-relaunch generation (read by mx.resilience: feeds the
+        # restarts_total counter and disarms one-shot fault injections)
+        "MXNET_TPU_RESTART_COUNT": str(restart_count),
     })
     if diagnostics_dir:
         # arm mx.diagnostics in every worker: the module appends /<rank>
@@ -79,12 +96,19 @@ def _pump(stream, rank, tee_file):
         tee_file.close()
 
 
-def _spawn(command, env, rank, diagnostics_dir, extra_args=()):
+def _spawn(command, env, rank, diagnostics_dir, extra_args=(),
+           restart_count=0):
     tee = None
     if diagnostics_dir:
         rank_dir = os.path.join(diagnostics_dir, str(rank))
         os.makedirs(rank_dir, exist_ok=True)
-        tee = open(os.path.join(rank_dir, "worker.log"), "w")
+        # relaunches APPEND: truncating would erase the crash output the
+        # supervised-restart feature exists to preserve
+        tee = open(os.path.join(rank_dir, "worker.log"),
+                   "a" if restart_count else "w")
+        if restart_count:
+            tee.write(f"=== relaunch attempt {restart_count} ===\n")
+            tee.flush()
     proc = subprocess.Popen(
         list(extra_args) + list(command), env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -95,38 +119,143 @@ def _spawn(command, env, rank, diagnostics_dir, extra_args=()):
     return proc, pump
 
 
-def _reap(procs, pumps):
-    """Wait for every worker; return the first nonzero exit code by rank
-    (the acceptance contract: a CI wrapper sees the real failure code,
-    not a flattened 1)."""
-    codes = [p.wait() for p in procs]
+def _terminate_gang(procs, pumps, sig=signal.SIGTERM, grace=10.0):
+    """Tear a gang down cleanly: forward `sig` to every live worker (so a
+    preemption-aware worker gets its grace window), wait up to `grace`
+    seconds, SIGKILL stragglers, reap every child (no zombies), and join
+    the pump threads so the worker.log tees are flushed and closed (no
+    lost tail output)."""
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(sig)
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
     for t in pumps:
         t.join(timeout=5.0)
-    first_bad = 0
-    for rank, code in enumerate(codes):
-        if code != 0:
-            print(f"worker {rank} exited with code {code}", file=sys.stderr)
-            if first_bad == 0:
-                first_bad = code
-    return first_bad
 
 
-def launch_local(num_workers, command, coordinator, diagnostics_dir=None):
-    procs, pumps = [], []
-    for rank in range(num_workers):
-        env = build_env(rank, num_workers, coordinator, diagnostics_dir)
-        proc, pump = _spawn(command, env, rank, diagnostics_dir)
-        procs.append(proc)
-        pumps.append(pump)
+def _reap(procs, pumps, early_exit=False, killed=None):
+    """Wait for the workers (polling — a signal handler must never call a
+    blocking Popen.wait the interrupted main thread already sits in: the
+    shared _waitpid_lock deadlocks). Returns (exit_code, failing_rank):
+    exit_code is the FIRST nonzero code by rank (the acceptance contract:
+    a CI wrapper sees the real failure code, not a flattened 1), or 0.
+    With `early_exit` (supervised-relaunch mode) it returns as soon as
+    ANY worker fails, leaving the peers running for the caller to tear
+    down. `killed` is the flag dict the signal handler sets: seeing it,
+    the loop forwards the signal to the gang, reaps, flushes the tee
+    pumps, and exits 128+signum."""
+    while True:
+        if killed and killed.get("sig"):
+            sig = killed["sig"]
+            _terminate_gang(procs, pumps, sig=signal.Signals(sig))
+            sys.exit(128 + sig)
+        codes = [p.poll() for p in procs]
+        if early_exit:
+            bad = [(r, c) for r, c in enumerate(codes)
+                   if c is not None and c != 0]
+            if bad:
+                rank, code = bad[0]
+                print(f"worker {rank} exited with code {code}",
+                      file=sys.stderr)
+                return code, rank
+        if all(c is not None for c in codes):
+            for t in pumps:
+                t.join(timeout=5.0)
+            first_bad, bad_rank = 0, None
+            for rank, code in enumerate(codes):
+                if code != 0:
+                    print(f"worker {rank} exited with code {code}",
+                          file=sys.stderr)
+                    if first_bad == 0:
+                        first_bad, bad_rank = code, rank
+            return first_bad, bad_rank
+        time.sleep(0.2)
 
-    def _kill(*_):
-        for p in procs:
-            p.terminate()
-        sys.exit(1)
+
+def _log_restart(diagnostics_dir, event):
+    """Restart events feed the same observability surfaces as everything
+    else: stderr for the operator, <diagnostics_dir>/restarts.jsonl for
+    tools (the workers' own telemetry counts restarts_total from
+    MXNET_TPU_RESTART_COUNT)."""
+    kind = "preempted" if event["exit_code"] == EXIT_PREEMPTED else "failed"
+    print(f"launch: rank {event['failed_rank']} {kind} with code "
+          f"{event['exit_code']} — tearing down the gang and relaunching "
+          f"in {event['backoff_s']:.1f}s (restart {event['attempt']})",
+          file=sys.stderr)
+    if not diagnostics_dir:
+        return
+    try:
+        os.makedirs(diagnostics_dir, exist_ok=True)
+        with open(os.path.join(diagnostics_dir, "restarts.jsonl"), "a") as f:
+            f.write(json.dumps(event) + "\n")
+    except OSError as e:
+        print(f"launch: cannot record restart event: {e}", file=sys.stderr)
+
+
+def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
+                 max_restarts=0, restart_backoff=3.0):
+    """Run the gang; with --max-restarts, supervise it: when any rank
+    dies (crash, SIGKILL rank death, or a preemption save), tear down the
+    peer ranks, back off exponentially (with jitter), and relaunch the
+    whole gang — which auto-resumes from the last good checkpoint when
+    the workers run with mx.resilience + resume='auto'."""
+    killed = {}
+
+    def _kill(signum, _frame):
+        # flag only (async-signal-safe): the reap loop forwards the
+        # ACTUAL signal so preemption-aware workers save, reaps the
+        # children (no zombies), and flushes/closes the worker.log
+        # tee pumps before exiting 128+signum
+        killed["sig"] = signum
 
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
-    return _reap(procs, pumps)
+    attempt = 0
+    while True:
+        if killed.get("sig"):
+            # signal arrived during the restart backoff: no gang running,
+            # nothing to tear down — just exit with the signal code
+            sys.exit(128 + killed["sig"])
+        procs, pumps = [], []
+        for rank in range(num_workers):
+            env = build_env(rank, num_workers, coordinator, diagnostics_dir,
+                            restart_count=attempt)
+            proc, pump = _spawn(command, env, rank, diagnostics_dir,
+                                restart_count=attempt)
+            procs.append(proc)
+            pumps.append(pump)
+        code, rank = _reap(procs, pumps, early_exit=max_restarts > 0,
+                           killed=killed)
+        if code != 0 and max_restarts > 0:
+            # early-exit reap leaves the peers running: tear the gang down
+            # whether or not a relaunch follows (no orphans on giving up)
+            _terminate_gang(procs, pumps)
+        if code == 0 or attempt >= max_restarts:
+            return code
+        attempt += 1
+        backoff = restart_backoff * (2.0 ** (attempt - 1)) \
+            * random.uniform(0.8, 1.2)
+        _log_restart(diagnostics_dir, {
+            "ts": time.time(), "kind": "restart", "attempt": attempt,
+            "failed_rank": rank, "exit_code": code,
+            "preempted": code == EXIT_PREEMPTED,
+            "backoff_s": round(backoff, 3)})
+        # sliced sleep: PEP 475 restarts a plain sleep after the flag-only
+        # signal handler runs, so a Ctrl-C during a long backoff would
+        # otherwise be ignored until the backoff elapsed
+        end = time.monotonic() + backoff
+        while time.monotonic() < end and not killed.get("sig"):
+            time.sleep(min(0.2, max(0.0, end - time.monotonic())))
 
 
 def launch_ssh(hosts, num_workers, command, coordinator, username=None,
@@ -149,7 +278,8 @@ def launch_ssh(hosts, num_workers, command, coordinator, username=None,
             extra_args=["ssh", "-o", "StrictHostKeyChecking=no", target])
         procs.append(proc)
         pumps.append(pump)
-    return _reap(procs, pumps)
+    code, _rank = _reap(procs, pumps)
+    return code
 
 
 def main(argv=None):
@@ -168,6 +298,16 @@ def main(argv=None):
                    help="arm mx.diagnostics in every worker and tee each "
                         "worker's output to <dir>/<rank>/worker.log; "
                         "crashes leave <dir>/<rank>/postmortem.json")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="supervised relaunch (local launcher): when any "
+                        "rank exits nonzero, tear down the peers, back "
+                        "off, and relaunch the whole gang up to N times "
+                        "(workers see MXNET_TPU_RESTART_COUNT; with "
+                        "mx.resilience + resume=auto they resume from "
+                        "the last good checkpoint)")
+    p.add_argument("--restart-backoff", type=float, default=3.0,
+                   help="base seconds between relaunches; doubles per "
+                        "restart, jittered +-20%%")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
@@ -181,13 +321,18 @@ def main(argv=None):
     if args.launcher == "ssh":
         if not args.hostfile:
             p.error("ssh launcher needs -H hostfile")
+        if args.max_restarts:
+            print("warning: --max-restarts is local-launcher only "
+                  "(supervise ssh gangs externally)", file=sys.stderr)
         with open(args.hostfile) as f:
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
                           args.coordinator, args.username,
                           args.diagnostics_dir)
     return launch_local(args.num_workers, args.command, args.coordinator,
-                        args.diagnostics_dir)
+                        args.diagnostics_dir,
+                        max_restarts=args.max_restarts,
+                        restart_backoff=args.restart_backoff)
 
 
 if __name__ == "__main__":

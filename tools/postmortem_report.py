@@ -187,6 +187,12 @@ def report(args):
         if detail:
             line += f"  {detail}"
         lines.append(line)
+        res = pm.get("resume")
+        if isinstance(res, dict) and res.get("path"):
+            extra = f" after {res['fallbacks']} corrupt fallback(s)" \
+                if res.get("fallbacks") else ""
+            lines.append(f"  resumed from {res['path']} "
+                         f"(step {res.get('step')}){extra}")
         if status != "clean":
             failing.append(rank)
 
