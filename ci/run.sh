@@ -195,6 +195,13 @@ unittest_stage() {
 dist_stage() {
     echo "== dist =="
     python -m pytest tests/dist -q
+    # elastic acceptance: train 4-way, SIGKILL the gang at step 3, the
+    # --elastic supervisor relaunches at the surviving world size, the
+    # resumed worker reshards the 4-way checkpoint onto a 2-way mesh, and
+    # the loss trajectory matches the uninterrupted run
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_reshard.py::test_elastic_kill_shrink_resume_matches_reference \
+        -q -p no:cacheprovider
 }
 
 train_stage() {

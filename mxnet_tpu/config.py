@@ -274,9 +274,48 @@ register_option(
     "'sigterm@step:5' (graceful-preemption path), 'kill@step:3' (rank "
     "death via SIGKILL), 'corrupt_ckpt@step:4' (flip bytes in that "
     "step's checkpoint after its manifest is written), 'stall_input:250' "
-    "(one 250ms input-pipeline stall), 'exc@step:2' (crash). Append "
-    "'@rank:N' to target one rank, '@every_restart' to re-fire after a "
-    "supervised relaunch. Empty (default) injects nothing.")
+    "(one 250ms input-pipeline stall), 'exc@step:2' (crash), "
+    "'shrink@step:3' / 'grow@step:3' (elastic reshape request: save a "
+    "final checkpoint, exit EXIT_SHRINK=84 / EXIT_GROW=85 so a "
+    "tools/launch.py --elastic supervisor relaunches the gang smaller by "
+    "every rank that fired / one worker larger — use 'shrink@step:3"
+    "@rank:N' to lose exactly one worker). Append '@rank:N' to target "
+    "one rank, '@every_restart' to "
+    "re-fire after a supervised relaunch. Empty (default) injects "
+    "nothing.")
+register_option(
+    "reshard", "auto", choices=("auto", "off", "host"),
+    doc="Cross-topology checkpoint redistribution policy "
+        "(parallel/reshard.py). 'auto' (default): a verified checkpoint "
+        "whose mesh/param-mode fingerprint differs from the restoring "
+        "trainer is redistributed onto the current topology via a planned "
+        "reshard (params, optimizer state, RNG and step counter stay "
+        "bit-exact; peak memory bounded by the largest single array). "
+        "'host' forces the host-side gather/scatter path for live "
+        "resizes (degenerate topologies where no collective can run). "
+        "'off' restores the strict behavior: a mesh mismatch raises "
+        "MeshMismatchError naming both fingerprints.")
+register_option(
+    "reshard_chunk_bytes", 64 * 1024 * 1024,
+    "Live-resize arrays larger than this take the host gather/scatter "
+    "path when their move would need a device-side gathered intermediate "
+    "(merge / axis-flip redistributions); smaller ones ride the planned "
+    "device collective. Bounds per-device transient memory during "
+    "elastic.resize_trainer.")
+register_option(
+    "elastic", False,
+    "Elastic gang default for tools/launch.py (read from the env var at "
+    "launcher startup — the launcher stays jax-free): on a rank death or "
+    "shrink/grow request, relaunch the gang at the SURVIVING world size "
+    "(floored at min_workers) instead of the original shape; workers "
+    "resuming with reshard='auto' then redistribute the checkpoint onto "
+    "the new topology. Equivalent to the --elastic flag.")
+register_option(
+    "min_workers", 1,
+    "Smallest world size an elastic tools/launch.py gang may shrink to "
+    "(read from the env var at launcher startup): a relaunch after slot "
+    "losses is clamped to this floor, never below it. Equivalent to the "
+    "--min-workers flag.")
 register_option(
     "retry_max_attempts", 3,
     "Total tries mx.resilience.RetryPolicy makes on a retryable "

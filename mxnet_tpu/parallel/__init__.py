@@ -15,7 +15,8 @@ from .pipeline import (pipeline_apply, pipeline_shard_map,
                        pipeline_apply_hetero, PipelineTrainer,
                        SeqPipelineTrainer)
 from .distributed import init_distributed, is_distributed
-from .elastic import AutoCheckpoint
+from .elastic import AutoCheckpoint, resize_trainer
+from . import reshard
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .moe import moe_apply, moe_ffn
 
@@ -28,4 +29,5 @@ __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding"
            "pipeline_apply_hetero", "PipelineTrainer", "SeqPipelineTrainer",
            "init_distributed",
            "is_distributed", "ulysses_attention", "ulysses_self_attention",
-           "moe_apply", "moe_ffn", "AutoCheckpoint"]
+           "moe_apply", "moe_ffn", "AutoCheckpoint", "resize_trainer",
+           "reshard"]

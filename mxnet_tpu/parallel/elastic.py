@@ -38,6 +38,17 @@ routes through the same atomic writer), knob-driven periodic checkpoints
 with auto-resume inside ShardedTrainer itself, graceful-preemption exit
 codes, supervised relaunch via tools/launch.py --max-restarts, retry
 policies, and fault injection. New code should prefer the knobs.
+
+**Elastic resize** (`resize_trainer`): the in-process half of elastic
+training. Where the launcher answers worker death by relaunching the
+gang at the surviving world size (tools/launch.py --elastic, with the
+checkpoint resharded onto the new topology at resume), resize_trainer
+redistributes a LIVE ShardedTrainer onto a new mesh without any disk
+round-trip: params, optimizer state, aux and the device step counter
+move via parallel/reshard.py's planned redistribution (one array at a
+time — peak memory bounded by the largest array), the step cache and
+collective estimates rebuild for the new topology, and training
+continues at the same step with bit-identical state.
 """
 from __future__ import annotations
 
@@ -48,7 +59,85 @@ import weakref
 
 import jax
 
-__all__ = ["AutoCheckpoint"]
+__all__ = ["AutoCheckpoint", "resize_trainer"]
+
+
+def resize_trainer(trainer, mesh=None, devices=None, **axis_sizes):
+    """Redistribute a live ShardedTrainer onto a new mesh, in place.
+
+    Pass an explicit `mesh`, or `devices`/axis sizes forwarded to
+    make_mesh (e.g. `resize_trainer(tr, dp=2, devices=jax.devices()[:2])`
+    after shrinking, `resize_trainer(tr, dp=-1)` to absorb every device).
+    The new mesh becomes the process-current mesh. Parameter mode is
+    unchanged — per-parameter shardings are re-derived from it on the new
+    mesh (a replicate↔fsdp change rides the checkpoint restore path
+    instead, where the canonical per-tensor layout makes it exact).
+
+    Returns the reshard plan actually executed (arrays, bytes, strategy
+    counts) — also recorded in reshard telemetry and diagnostics."""
+    import jax.numpy as jnp
+
+    from .. import resilience as _resilience
+    from . import reshard as _reshard
+    from . import specs as _specs
+    from .mesh import make_mesh, set_mesh
+
+    if not getattr(trainer, "_ready", False):
+        raise RuntimeError(
+            "resize_trainer: trainer has deferred-shape parameters — run "
+            "one step (or construct on the target mesh) first")
+    src_fp = _resilience.trainer_fingerprint(trainer)
+    if mesh is None:
+        mesh = make_mesh(devices=devices, **axis_sizes)
+    else:
+        set_mesh(mesh)
+
+    from jax.sharding import NamedSharding
+
+    def _on_new_mesh(s):
+        # an explicit Parameter.set_sharding given as a concrete
+        # NamedSharding is pinned to the mesh it was built on; carry its
+        # SPEC onto the new mesh — otherwise redistribute would see
+        # src == dst, no-op, and leave one array on devices the gang no
+        # longer owns (PartitionSpec rules already re-derive via
+        # param_spec)
+        if isinstance(s, NamedSharding) and s.mesh != mesh:
+            return NamedSharding(mesh, s.spec)
+        return s
+
+    rep = _specs.replicated(mesh)
+    pshard = [_on_new_mesh(_specs.param_spec(p, mesh, trainer.param_mode))
+              for _, p in trainer._grad_params]
+    aux_shard = [_specs.replicated(mesh) for _ in trainer._aux_params]
+
+    sess = _reshard.Session()
+    if trainer._fused:
+        # the flat f32 master + moments are replicated by construction
+        # (fused LAMB exists only in replicate mode) — the move is a
+        # replicated→replicated re-placement onto the new device set
+        trainer.params = sess.redistribute(trainer.params, rep)
+        trainer.opt_state = tuple(
+            sess.redistribute(z, rep) for z in trainer.opt_state)
+    else:
+        trainer.params = [sess.redistribute(a, s)
+                          for a, s in zip(trainer.params, pshard)]
+        trainer.opt_state = [
+            tuple(sess.redistribute(z, s) for z in st)
+            for st, s in zip(trainer.opt_state, pshard)]
+    trainer.aux = [sess.redistribute(a, s)
+                   for a, s in zip(trainer.aux, aux_shard)]
+
+    trainer.mesh = mesh
+    trainer._pshard, trainer._aux_shard, trainer._rep = \
+        pshard, aux_shard, rep
+    # executables bake the old mesh/shardings in: every cached step is
+    # stale. The device counter re-places small enough to skip the session
+    trainer._t_dev = jax.device_put(
+        jnp.asarray(trainer.num_update, jnp.int32), rep)
+    trainer._step_cache.clear()
+    trainer._refresh_comm_estimates()
+    return sess.finish("resize", src_fp=src_fp,
+                       dst_fp=_resilience.trainer_fingerprint(trainer))
 
 _MARKER = "DONE"
 

@@ -24,7 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding",
-           "PartitionSpec", "local_mesh_devices", "manual_axes", "in_manual"]
+           "PartitionSpec", "local_mesh_devices", "manual_axes", "in_manual",
+           "mesh_axes"]
 
 _current = {"mesh": None}
 _manual = set()
@@ -113,6 +114,13 @@ def current_mesh():
     if _current["mesh"] is None:
         make_mesh()
     return _current["mesh"]
+
+
+def mesh_axes(mesh):
+    """{axis name: size} for a Mesh (JSON-able; axis order preserved).
+    The topology identity the checkpoint manifest records — compared at
+    restore to decide whether a redistribution is needed."""
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
 
 
 def named_sharding(*spec, mesh=None):

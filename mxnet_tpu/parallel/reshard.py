@@ -1,0 +1,521 @@
+"""mx.reshard — cross-topology array redistribution.
+
+A checkpoint written on an N-device mesh must restore onto an M-device
+mesh (or a different data/model axis split) as a REDISTRIBUTION, not a
+failure: preemption on a shrinking pod is a reshape. Grounding:
+
+  * "Memory-efficient array redistribution through portable collective
+    communication" (arxiv 2112.01075) — redistribution decomposes into a
+    schedule of bounded-size moves; per-device peak memory stays
+    O(src_shard + dst_shard), never O(global array), and a full
+    all-gather is the last resort (only when the TARGET layout itself is
+    replicated), never an intermediate.
+  * "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training" (arxiv 2004.13336) — optimizer state shards like its
+    parameter, so it must reshard ALONGSIDE params (including the
+    fused-LAMB flat-master layout, which checkpoints in the canonical
+    per-tensor form exactly so this module never sees a layout that only
+    one topology can express).
+
+Three surfaces:
+
+  * **layout description** — `state_layouts(trainer)` records one entry
+    per checkpointed array (name, global shape, dtype, PartitionSpec
+    tree, mesh axis sizes). `mx.resilience.write_checkpoint` stores the
+    list in the manifest (`"shardings"`), so a later restore can plan the
+    redistribution from metadata alone, before touching any payload.
+  * **planning** — `plan_restore(manifest, trainer)` matches the
+    checkpoint's recorded layouts against the restoring trainer's and
+    classifies every array move (`aligned` / `split` / `merge` /
+    `replicate` / `redistribute`), with byte and per-array peak-memory
+    accounting. Global-shape disagreement raises `ReshardError` up
+    front: resharding changes layout, never shape.
+  * **execution** — `Session.redistribute(arr, dst_sharding)` moves one
+    live array. The device path is a planned `jax.device_put` (XLA emits
+    the minimal portable collective for the src→dst pair); the host path
+    gathers the array ONCE on the host by assembling addressable shards
+    (per-shard D2H copies, replicated shards copied once — never a
+    device-side all-gather) and scatters per-device slices via
+    `make_array_from_callback` — the fallback for degenerate topologies
+    where no live collective can run. Arrays are processed one at a
+    time, so peak memory during a whole-trainer reshard is bounded by
+    the LARGEST array, not the model.
+
+The checkpoint-restore path needs no executor at all: orbax reads each
+target shard's byte range directly from disk — inherently the
+gather/scatter schedule with the source mesh not even required to exist.
+There, this module contributes the gate (mesh mismatch → planned reshard
+instead of MeshMismatchError while the `reshard` knob allows it), the
+plan, and the telemetry (reshard_seconds / reshard_bytes_total /
+reshard_peak_bytes, a "reshard" event, and the post-mortem topology
+transition). Live in-process resizes (`parallel.elastic.resize_trainer`)
+use the executor directly.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from .. import config as _config
+from .. import telemetry as _telemetry
+
+__all__ = ["ReshardError", "Plan", "Session", "state_layouts",
+           "describe_array", "plan_restore", "plan_arrays", "redistribute",
+           "classify_move", "last_reshard"]
+
+_M_SECONDS = _telemetry.histogram(
+    "reshard_seconds", "wall time of one cross-topology redistribution "
+    "(checkpoint restore onto a different mesh, or a live "
+    "elastic.resize_trainer)")
+_M_BYTES = _telemetry.counter(
+    "reshard_bytes_total", "payload bytes redistributed across topologies, "
+    "by move strategy (label strategy=): aligned moves are free, migrate "
+    "re-places the same split on a new device set (shard-for-shard copy), "
+    "split/merge/redistribute are bounded P2P, replicate is the last-resort "
+    "all-gather (target layout itself replicated)")
+_M_PEAK = _telemetry.gauge(
+    "reshard_peak_bytes", "largest single-array byte count processed by the "
+    "most recent redistribution — the peak-memory bound (arrays move one "
+    "at a time, so the whole-model reshard never holds more than this "
+    "plus the destination shard)")
+
+#: info about the most recent reshard in this process (None before any);
+#: merged into the resilience resume record so post-mortems show the
+#: topology transition
+_last = None
+
+
+class ReshardError(RuntimeError):
+    """A redistribution cannot be planned: the checkpoint's recorded
+    arrays and the restoring trainer disagree on STRUCTURE (names or
+    global shapes). Resharding changes layout, never shape — this is a
+    different model, not a different topology."""
+
+
+# ---------------------------------------------------------------------------
+# layout description (what the manifest records per array)
+# ---------------------------------------------------------------------------
+
+def describe_array(name, arr):
+    """One JSON-able layout record: global shape, dtype, PartitionSpec
+    tree and mesh axis sizes (both None for host/single-device arrays,
+    which behave as replicated)."""
+    from jax.sharding import NamedSharding
+
+    from . import specs as _specs
+    from .mesh import mesh_axes
+
+    try:
+        dtype = str(np.dtype(arr.dtype))
+    except TypeError:                  # extended dtypes (PRNG keys)
+        dtype = str(arr.dtype)
+    entry = {"name": str(name), "shape": [int(s) for s in arr.shape],
+             "dtype": dtype, "spec": None, "mesh": None}
+    sharding = getattr(arr, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        entry["spec"] = _specs.spec_to_tree(sharding.spec)
+        entry["mesh"] = mesh_axes(sharding.mesh)
+    return entry
+
+
+def _leaf_name(path):
+    """Deterministic array name from a tree_flatten_with_path key path:
+    "params/0", "opt_state/1/0", "rng_key"."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def state_layouts(trainer):
+    """Layout records for every leaf of the trainer's checkpointed state
+    pytree (the same `_state_pytree()` save and restore use, so names can
+    never drift from what orbax writes)."""
+    import jax.tree_util as jtu
+
+    state = trainer._state_pytree()
+    leaves, _ = jtu.tree_flatten_with_path(state)
+    return [describe_array(_leaf_name(path), leaf)
+            for path, leaf in leaves]
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def _dim_counts(shape, spec_tree, mesh):
+    """Per-dim shard counts for a layout record: dim i splits into
+    prod(mesh[axis]) pieces over the axes its spec entry names."""
+    counts = []
+    mesh = mesh or {}
+    spec_tree = spec_tree or []
+    for i in range(len(shape)):
+        entry = spec_tree[i] if i < len(spec_tree) else None
+        if entry is None:
+            counts.append(1)
+            continue
+        axes = [entry] if isinstance(entry, str) else list(entry)
+        n = 1
+        for a in axes:
+            n *= int(mesh.get(a, 1))
+        counts.append(max(1, n))
+    return counts
+
+
+def classify_move(src_counts, dst_counts):
+    """Name the redistribution one array needs, from per-dim shard
+    counts:
+
+      aligned      — same split; local shard reads, zero movement
+      split        — every dst shard is a slice of one src shard
+                     (refinement: mesh grew / axis subdivided)
+      merge        — every dst shard concatenates whole src shards
+                     (coarsening: mesh shrank)
+      replicate    — the TARGET layout is replicated while the source is
+                     sharded: the one legitimate all-gather (last resort,
+                     and an endpoint, never an intermediate)
+      redistribute — the split moved to different dims (data↔model axis
+                     change): bounded P2P chunk exchange
+
+    Counts alone cannot see a DEVICE-SET change: the call sites upgrade
+    "aligned" to "migrate" (same split, different devices/mesh — the
+    payload is copied shard-for-shard, so its bytes count as moved) when
+    the shardings or recorded meshes differ.
+    """
+    if src_counts == dst_counts:
+        return "aligned"
+    if all(d == 1 for d in dst_counts) and any(s > 1 for s in src_counts):
+        return "replicate"
+    if all(d % s == 0 for s, d in zip(src_counts, dst_counts)):
+        return "split"
+    if all(s % d == 0 for s, d in zip(src_counts, dst_counts)):
+        return "merge"
+    return "redistribute"
+
+
+class Plan:
+    """A planned whole-state redistribution: one move per array, with
+    byte and peak-memory accounting. Built from layout metadata only —
+    no payload is touched until execution."""
+
+    def __init__(self, moves):
+        self.moves = list(moves)
+
+    @property
+    def bytes_total(self):
+        return sum(m["bytes"] for m in self.moves)
+
+    @property
+    def bytes_moved(self):
+        return sum(m["bytes"] for m in self.moves
+                   if m["strategy"] != "aligned")
+
+    @property
+    def peak_bytes(self):
+        """Per-array peak during execution: the largest single array's
+        source-shard + destination-shard footprint (arrays are processed
+        one at a time — this, not the model size, bounds memory)."""
+        peak = 0
+        for m in self.moves:
+            peak = max(peak, m["src_shard_bytes"] + m["dst_shard_bytes"])
+        return peak
+
+    @property
+    def strategies(self):
+        out = {}
+        for m in self.moves:
+            out[m["strategy"]] = out.get(m["strategy"], 0) + 1
+        return out
+
+    def bytes_by_strategy(self):
+        out = {}
+        for m in self.moves:
+            out[m["strategy"]] = out.get(m["strategy"], 0) + m["bytes"]
+        return out
+
+    def describe(self):
+        strat = ", ".join(f"{v} {k}" for k, v in sorted(self.strategies.items()))
+        return (f"{len(self.moves)} arrays, "
+                f"{self.bytes_total / 1e6:.1f} MB total "
+                f"({self.bytes_moved / 1e6:.1f} MB redistributed: {strat}); "
+                f"peak per-array {self.peak_bytes / 1e6:.1f} MB")
+
+
+def _dtype_itemsize(name):
+    try:
+        return np.dtype(name).itemsize
+    except TypeError:
+        return 4        # jax PRNG key dtypes and other extended dtypes
+
+
+def plan_arrays(src_layouts, dst_layouts):
+    """Plan src→dst for two layout lists (matched by name). Raises
+    ReshardError when the structures disagree — different names, counts,
+    or global shapes mean a different MODEL, which no redistribution can
+    fix."""
+    src_by_name = {e["name"]: e for e in src_layouts}
+    dst_by_name = {e["name"]: e for e in dst_layouts}
+    missing = sorted(set(dst_by_name) - set(src_by_name))
+    extra = sorted(set(src_by_name) - set(dst_by_name))
+    if missing or extra:
+        raise ReshardError(
+            "checkpoint and trainer state structures differ — this is a "
+            f"different model, not a different topology (checkpoint lacks "
+            f"{missing[:5]}, has extra {extra[:5]})")
+    moves = []
+    for name in sorted(dst_by_name):
+        src, dst = src_by_name[name], dst_by_name[name]
+        if list(src["shape"]) != list(dst["shape"]):
+            raise ReshardError(
+                f"array {name!r}: checkpoint global shape "
+                f"{tuple(src['shape'])} != trainer {tuple(dst['shape'])} — "
+                "resharding changes layout, never shape")
+        shape = tuple(dst["shape"])
+        nbytes = int(np.prod(shape)) * _dtype_itemsize(dst["dtype"]) \
+            if shape else _dtype_itemsize(dst["dtype"])
+        s_counts = _dim_counts(shape, src.get("spec"), src.get("mesh"))
+        d_counts = _dim_counts(shape, dst.get("spec"), dst.get("mesh"))
+        strategy = classify_move(s_counts, d_counts)
+        if strategy == "aligned" and \
+                (src.get("mesh") or {}) != (dst.get("mesh") or {}):
+            # same split on a DIFFERENT mesh: every shard is re-read onto
+            # a new device — movement, not a free local read
+            strategy = "migrate"
+        s_parts = int(np.prod(s_counts)) if s_counts else 1
+        d_parts = int(np.prod(d_counts)) if d_counts else 1
+        moves.append({
+            "name": name, "shape": list(shape), "bytes": nbytes,
+            "strategy": strategy,
+            "src_shard_bytes": nbytes // max(1, s_parts),
+            "dst_shard_bytes": nbytes // max(1, d_parts),
+        })
+    return Plan(moves)
+
+
+def plan_restore(manifest, trainer):
+    """Plan restoring a manifest's recorded state onto `trainer`'s
+    current placement. Checkpoints from before per-array shardings were
+    recorded (no "shardings" in the manifest) get a coarse plan: every
+    array marked `redistribute`, bytes from the trainer side."""
+    dst = state_layouts(trainer)
+    src = manifest.get("shardings")
+    if not src:
+        moves = []
+        for e in dst:
+            shape = tuple(e["shape"])
+            nbytes = int(np.prod(shape)) * _dtype_itemsize(e["dtype"]) \
+                if shape else _dtype_itemsize(e["dtype"])
+            d_counts = _dim_counts(shape, e.get("spec"), e.get("mesh"))
+            d_parts = int(np.prod(d_counts)) if d_counts else 1
+            moves.append({"name": e["name"], "shape": list(shape),
+                          "bytes": nbytes, "strategy": "redistribute",
+                          "src_shard_bytes": nbytes,
+                          "dst_shard_bytes": nbytes // max(1, d_parts)})
+        return Plan(moves)
+    return plan_arrays(src, dst)
+
+
+# ---------------------------------------------------------------------------
+# execution (live arrays: elastic resize; checkpoint restores go via orbax)
+# ---------------------------------------------------------------------------
+
+def _live_counts(arr, sharding):
+    from jax.sharding import NamedSharding
+
+    from . import specs as _specs
+    from .mesh import mesh_axes
+    if not isinstance(sharding, NamedSharding):
+        return [1] * arr.ndim
+    return _dim_counts(arr.shape, _specs.spec_to_tree(sharding.spec),
+                       mesh_axes(sharding.mesh))
+
+
+def _host_gather(arr):
+    """Assemble the global array on the host from addressable shards —
+    per-shard D2H copies only (each replicated index copied once), never
+    a device-side all-gather. Peak host memory: this one array.
+
+    Requires a fully addressable array: on a multi-process gang each
+    process sees only its own shards, so a per-process host gather would
+    silently fill the other hosts' regions with uninitialized memory —
+    cross-host redistribution goes through the checkpoint path instead
+    (orbax reads every target shard from the shared filesystem)."""
+    if not getattr(arr, "is_fully_addressable", True):
+        raise ReshardError(
+            "host gather/scatter needs a fully addressable array; this "
+            "process holds only its local shards. Redistribute across "
+            "hosts via a checkpoint (save_states + load_states with "
+            "reshard='auto') instead of a live host-path move.")
+    out = np.empty(arr.shape, np.dtype(arr.dtype))
+    seen = set()
+    for sh in arr.addressable_shards:
+        key = tuple((sl.start, sl.stop, sl.step) for sl in sh.index) \
+            if sh.index else ()
+        if key in seen:
+            continue
+        seen.add(key)
+        out[sh.index] = np.asarray(sh.data)
+    return out
+
+
+def _host_scatter(host, dst_sharding):
+    """Place a host array under `dst_sharding`, each device receiving
+    exactly its slice (no device ever holds more than its shard)."""
+    import jax
+    return jax.make_array_from_callback(
+        host.shape, dst_sharding, lambda idx: host[idx])
+
+
+class Session:
+    """One redistribution session: moves arrays one at a time (bounding
+    peak memory at the largest array), tracks bytes/strategy/peak, and
+    emits the telemetry + diagnostics record at finish().
+
+    via: "auto" (device collectives, host fallback), "host" (force the
+    gather/scatter path — for degenerate topologies where the source and
+    target meshes cannot run a collective together), or None to read the
+    `reshard` knob ("off" behaves as "auto" here: gating happens at the
+    restore call site, not mid-move)."""
+
+    def __init__(self, via=None, chunk_bytes=None):
+        mode = via or _config.get("reshard")
+        self.via = mode if mode in ("host",) else "auto"
+        self.chunk_bytes = int(chunk_bytes if chunk_bytes is not None
+                               else _config.get("reshard_chunk_bytes"))
+        self.moves = []
+        self._t0 = time.perf_counter()
+
+    # ------------------------------------------------------------- move
+    def redistribute(self, arr, dst_sharding):
+        """Move one array to `dst_sharding`. Device path: a planned
+        jax.device_put (XLA's portable src→dst collective). Host path:
+        gather-once/scatter-slices. Auto prefers the device path but
+        routes `merge`/`redistribute` moves of arrays above
+        reshard_chunk_bytes through the host (their device schedule may
+        materialize a gathered intermediate; the host path's peak is one
+        host copy + one device shard)."""
+        import jax
+
+        nbytes = int(arr.size) * _dtype_itemsize(arr.dtype)
+        src_sharding = getattr(arr, "sharding", None)
+        if src_sharding == dst_sharding:
+            self._note("aligned", arr, nbytes, src_sharding, dst_sharding)
+            return arr
+        s_counts = _live_counts(arr, src_sharding)
+        d_counts = _live_counts(arr, dst_sharding)
+        strategy = classify_move(s_counts, d_counts)
+        if strategy == "aligned":
+            # shardings already compared unequal above: same split on a
+            # different device set — a shard-for-shard copy (migrate)
+            strategy = "migrate"
+        # auto prefers the host path only for arrays it can actually
+        # assemble (fully addressable); an EXPLICIT via='host' on a
+        # multi-process array raises in _host_gather rather than
+        # corrupting silently
+        use_host = self.via == "host" or (
+            strategy in ("merge", "redistribute")
+            and nbytes > self.chunk_bytes
+            and getattr(arr, "is_fully_addressable", True))
+        if not use_host:
+            try:
+                out = jax.device_put(arr, dst_sharding)
+            except Exception as e:     # noqa: BLE001 — degenerate topology
+                print(f"mx.reshard: device path failed ({type(e).__name__}:"
+                      f" {e}) — falling back to host gather/scatter",
+                      file=sys.stderr)
+                use_host = True
+        if use_host:
+            out = _host_scatter(_host_gather(arr), dst_sharding)
+        self._note(strategy, arr, nbytes, src_sharding, dst_sharding)
+        return out
+
+    def _note(self, strategy, arr, nbytes, src_sharding, dst_sharding):
+        s_parts = int(np.prod(_live_counts(arr, src_sharding)))
+        d_parts = int(np.prod(_live_counts(arr, dst_sharding)))
+        self.moves.append({
+            "name": f"array{len(self.moves)}", "shape": list(arr.shape),
+            "bytes": nbytes, "strategy": strategy,
+            "src_shard_bytes": nbytes // max(1, s_parts),
+            "dst_shard_bytes": nbytes // max(1, d_parts)})
+
+    # ----------------------------------------------------------- finish
+    def finish(self, kind, src_fp=None, dst_fp=None):
+        """Emit the session's record: telemetry counters/histogram/gauge,
+        a "reshard" event, the diagnostics ring entry, and the module's
+        last_reshard() info (merged into the resume post-mortem)."""
+        plan = Plan(self.moves)
+        note_reshard(kind, plan, time.perf_counter() - self._t0,
+                     src_fp=src_fp, dst_fp=dst_fp)
+        return plan
+
+
+def redistribute(arr, dst_sharding, via=None):
+    """One-shot module-level convenience (no session record)."""
+    return Session(via=via).redistribute(arr, dst_sharding)
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def note_reshard(kind, plan, seconds, src_fp=None, dst_fp=None):
+    """Record one completed redistribution (kind: "restore" for the
+    checkpoint path, "resize" for a live elastic resize)."""
+    global _last
+    info = {"op": kind, "arrays": len(plan.moves),
+            "bytes_total": plan.bytes_total,
+            "bytes_moved": plan.bytes_moved,
+            "peak_bytes": plan.peak_bytes,
+            "strategies": plan.strategies,
+            "seconds": round(float(seconds), 6),
+            "from": src_fp, "to": dst_fp}
+    _last = info
+    try:
+        from .. import resilience as _resilience
+        _resilience._pending_reshard = dict(info)
+    except Exception:
+        pass
+    # stderr, like every operational message here and in resilience: a
+    # worker's stdout may be machine-parsed (bench JSON, loss scraping)
+    print(f"mx.reshard: {kind} across topologies "
+          f"({_fp_brief(src_fp)} -> {_fp_brief(dst_fp)}): {plan.describe()} "
+          f"in {seconds:.3f}s", file=sys.stderr)
+    if _telemetry._enabled:
+        _M_SECONDS.observe(float(seconds))
+        for strategy, nbytes in plan.bytes_by_strategy().items():
+            _M_BYTES.labels(strategy=strategy).inc(nbytes)
+        _M_PEAK.set(plan.peak_bytes)
+        _telemetry.event("reshard", **info)
+    try:
+        from .. import diagnostics as _diagnostics
+        _diagnostics.record_event("reshard", **info)
+    except Exception:
+        pass
+    return info
+
+
+def _fp_brief(fp):
+    if not isinstance(fp, dict):
+        return "?"
+    mesh = fp.get("mesh_shape")
+    mode = fp.get("param_mode")
+    parts = []
+    if mesh:
+        parts.append("x".join(f"{k}={v}" for k, v in sorted(mesh.items())
+                              if v != 1) or "1-device")
+    if mode:
+        parts.append(str(mode))
+    return "/".join(parts) or "?"
+
+
+def last_reshard():
+    """Info dict of the most recent redistribution in this process (None
+    before any) — surfaced in the post-mortem resume section."""
+    return dict(_last) if _last else None

@@ -14,7 +14,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .mesh import current_mesh
 
 __all__ = ["param_spec", "batch_spec", "replicated", "fsdp_spec",
-           "apply_tp_rules", "constrain_batch", "constrain_seq", "DATA_AXES"]
+           "apply_tp_rules", "constrain_batch", "constrain_seq", "DATA_AXES",
+           "spec_to_tree", "spec_from_tree"]
 
 # both dp and fsdp are "data" axes from the batch's point of view
 DATA_AXES = ("dp", "fsdp")
@@ -153,6 +154,33 @@ def param_spec(param, mesh=None, mode="replicate"):
         raise ValueError(f"param_mode {mode!r}: expected 'replicate' or "
                          "'fsdp'")
     return replicated(mesh)
+
+
+def spec_to_tree(spec):
+    """PartitionSpec (or NamedSharding) → a JSON-able list: one entry per
+    dim, each None | axis-name | [axis-names]. The serialization the
+    checkpoint manifest records per array so a restore on a DIFFERENT
+    topology can plan the redistribution (parallel/reshard.py)."""
+    if isinstance(spec, NamedSharding):
+        spec = spec.spec
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def spec_from_tree(tree):
+    """Inverse of spec_to_tree."""
+    entries = []
+    for entry in tree or []:
+        if entry is None or isinstance(entry, str):
+            entries.append(entry)
+        else:
+            entries.append(tuple(entry))
+    return PartitionSpec(*entries)
 
 
 def apply_tp_rules(block, rules):

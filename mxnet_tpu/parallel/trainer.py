@@ -164,6 +164,14 @@ class ShardedTrainer:
         self._t_dev = jax.device_put(
             jnp.asarray(self.num_update, jnp.int32), rep)
         self._lr_inside = self.fopt.lr_traced() is not None
+        self._refresh_comm_estimates()
+        self._ready = True
+
+    def _refresh_comm_estimates(self):
+        """Mesh-derived accounting for the CURRENT mesh + shardings:
+        gradient-reduction payload for the collective counters and the
+        mx.inspect per-collective traffic estimate. Called from _setup
+        and again after an elastic resize changes the mesh."""
         # gradient-reduction payload per step, for the collective counters:
         # XLA psums grads over the data axes iff they span >1 device
         reduce_degree = self.mesh.shape.get("dp", 1) * \
@@ -188,7 +196,6 @@ class ShardedTrainer:
             sized = [(int(p.size * p.dtype.itemsize), s)
                      for p, s in zip(self.params, self._pshard)]
         self._coll_est = _inspect.estimate_collectives(self.mesh, sized)
-        self._ready = True
 
     # ------------------------------------------------------------------
     def _build_step(self, n_data, n_label, batch_shapes):
@@ -539,11 +546,13 @@ class ShardedTrainer:
         process writes only its local shards)."""
         _ckpt_save(self, directory)
 
-    def load_states(self, directory):
-        """Restore a save_states() checkpoint onto the current mesh —
-        resharding to the current topology happens automatically via the
-        restore shardings."""
-        state = _ckpt_restore(self, directory)
+    def load_states(self, directory, reshard=None):
+        """Restore a save_states() checkpoint onto the current mesh. A
+        checkpoint written on a DIFFERENT topology (mesh shape or param
+        mode) is redistributed bit-exactly while `reshard` allows it:
+        None reads the `reshard` knob (default 'auto'), 'auto'/'host'
+        redistribute, 'off' raises MeshMismatchError on any mismatch."""
+        state = _ckpt_restore(self, directory, reshard)
         if self._fused:
             self.params = jax.device_put(
                 self._fl.flatten(state["params"]), self._rep)
@@ -601,36 +610,54 @@ def _ckpt_save(trainer, directory):
     if not _resilience._enabled:
         _orbax_write(trainer, directory)
         return
+    from . import reshard as _reshard
     _resilience.write_checkpoint(
         directory, lambda tmp: _orbax_write(trainer, tmp),
         step=int(trainer.num_update),
-        fingerprint=_resilience.trainer_fingerprint(trainer))
+        fingerprint=_resilience.trainer_fingerprint(trainer),
+        layouts=_reshard.state_layouts(trainer))
 
 
-def _ckpt_restore(trainer, directory):
+def _ckpt_restore(trainer, directory, reshard=None):
     """Restore + re-seed the global RNG. Returns the state pytree for the
     trainer to apply its fields from. With mx.resilience enabled and a
     manifest present, checksums are verified first (raising
-    CheckpointCorruptError on a torn/corrupt checkpoint) and a mesh/
-    param-mode mismatch is rejected with MeshMismatchError instead of
-    silently resharding onto the wrong topology."""
+    CheckpointCorruptError on a torn/corrupt checkpoint) and the mesh/
+    param-mode fingerprint is compared: a topology change is REDISTRIBUTED
+    onto the current mesh while the `reshard` policy allows it (the knob,
+    or the explicit load_states(reshard=...) argument) — planned from the
+    manifest's recorded per-array shardings, executed by orbax reading
+    each target shard's byte range from disk (peak memory bounded per
+    array, no device all-gather), recorded in reshard telemetry and the
+    post-mortem resume section. With reshard='off' the mismatch raises
+    MeshMismatchError naming both fingerprints."""
     import os
 
     import orbax.checkpoint as ocp
 
     from .. import random as _random
 
+    plan = None
+    manifest = None
+    t0 = time.perf_counter()
     if _resilience._enabled and os.path.exists(
             os.path.join(str(directory), "manifest.json")):
         manifest = _resilience.verify_checkpoint(directory)
-        _resilience.check_fingerprint(
-            manifest, _resilience.trainer_fingerprint(trainer),
-            str(directory))
+        if _resilience.reshard_gate(manifest, trainer, str(directory),
+                                    reshard):
+            from . import reshard as _reshard
+            plan = _reshard.plan_restore(manifest, trainer)
     target = trainer._state_pytree()
     target["rng_key"] = jax.random.key_data(_random.get_state())
     ckptr = ocp.StandardCheckpointer()
     state = ckptr.restore(
         os.path.abspath(os.path.join(str(directory), "state")), target)
+    if plan is not None:
+        from . import reshard as _reshard
+        _reshard.note_reshard(
+            "restore", plan, time.perf_counter() - t0,
+            src_fp=manifest.get("fingerprint"),
+            dst_fp=_resilience.trainer_fingerprint(trainer))
     _random.set_state(state["rng_key"])
     return state
 
@@ -660,9 +687,9 @@ class PipelineCheckpointMixin:
     def save_states(self, directory):
         _ckpt_save(self, directory)
 
-    def load_states(self, directory):
+    def load_states(self, directory, reshard=None):
         self._ensure_setup()
-        state = _ckpt_restore(self, directory)
+        state = _ckpt_restore(self, directory, reshard)
         self.params = list(state["params"])
         self.opt_state = [tuple(st) for st in state["opt_state"]]
         self.num_update = int(state["num_update"])

@@ -12,9 +12,12 @@ first-class event; this module is the TPU-native equivalent. Five pieces:
     CRC32 checksums + the step id + a mesh/config fingerprint, fsynced,
     and atomically renamed into place. A kill mid-save leaves only a
     `*.tmp-*` directory that restore never considers. On restore the
-    checksums are verified, a mesh mismatch is rejected with a clear
-    error (`MeshMismatchError`), and a torn/corrupt latest checkpoint
-    falls back to the newest previous GOOD one.
+    checksums are verified, a mesh/param-mode change is REDISTRIBUTED
+    onto the current topology (parallel/reshard.py; bit-exact, planned
+    from the manifest's recorded per-array shardings) while the
+    `reshard` knob allows it — or rejected with `MeshMismatchError`
+    when reshard='off' — and a torn/corrupt latest checkpoint falls
+    back to the newest previous GOOD one.
   * **auto-resume** — the `resume` knob ("auto" or an explicit path) makes
     a fresh `ShardedTrainer` (and `Estimator.fit(resume=...)`) restore
     model/optimizer/RNG/device-step-counter from the newest verified
@@ -65,12 +68,18 @@ __all__ = [
     "check_fingerprint", "trainer_fingerprint", "CheckpointManager",
     "manager_for", "FaultInjector", "fault_point", "restart_count",
     "last_resume", "note_preemption", "save_estimator", "restore_estimator",
+    "EXIT_SHRINK", "EXIT_GROW", "reshard_gate",
 ]
 
 # distinct "preempted: state saved, exiting on request" process exit code —
 # chosen outside the shell (126..128+N) and common-errno ranges so a
 # supervisor (tools/launch.py, k8s) can classify it unambiguously
 EXIT_PREEMPTED = 83
+# elastic reshape requests (fault-injectable via shrink@step / grow@step;
+# honored by tools/launch.py --elastic): state saved, exiting so the
+# supervisor can relaunch the gang one worker smaller / larger
+EXIT_SHRINK = 84
+EXIT_GROW = 85
 
 _lock = threading.RLock()
 _enabled = False          # the fast-path bool: trainer hooks check ONLY this
@@ -79,6 +88,7 @@ _prev_handlers = {}
 _preempt = {"flag": False, "signum": None}
 _injector = None          # FaultInjector parsed from the fault_inject knob
 _resume_info = None       # {"path", "step", "fallbacks"} of the last restore
+_pending_reshard = None   # staged by reshard.note_reshard for _note_resume
 
 _M_SAVE_SECONDS = _telemetry.histogram(
     "checkpoint_save_seconds", "wall time of one managed checkpoint save "
@@ -110,16 +120,25 @@ class CheckpointCorruptError(RuntimeError):
 
 class MeshMismatchError(RuntimeError):
     """A verified checkpoint was written for a different mesh/param-mode
-    than the trainer restoring it. Raised (never silently resharded) so a
-    mis-configured relaunch cannot load shards onto the wrong topology."""
+    than the trainer restoring it, and the `reshard` knob is off (or the
+    mismatch is not a topology at all — e.g. a different trainer class).
+    With reshard='auto' (the default) a pure mesh/param-mode mismatch is
+    redistributed via parallel/reshard.py instead of raising. Carries
+    `.mismatch` ({key: (checkpoint, current)}) so callers can tell a
+    reshardable topology change from a structural one."""
+
+    def __init__(self, message, mismatch=None):
+        super().__init__(message)
+        self.mismatch = dict(mismatch or {})
 
 
 class PreemptedExit(SystemExit):
     """SystemExit subclass raised after the final preemption checkpoint;
-    carries EXIT_PREEMPTED so the process exit code is distinct."""
+    carries EXIT_PREEMPTED (or EXIT_SHRINK/EXIT_GROW for injected elastic
+    reshape requests) so the process exit code is distinct."""
 
-    def __init__(self, message=""):
-        super().__init__(EXIT_PREEMPTED)
+    def __init__(self, message="", code=EXIT_PREEMPTED):
+        super().__init__(code)
         self.message = message
 
 
@@ -178,12 +197,13 @@ def install(signals=(_signal.SIGTERM, _signal.SIGINT)):
 def uninstall():
     """Undo install() (tests): restore previous signal handlers, disarm
     the hooks, drop the preemption flag and per-trainer managers."""
-    global _injector, _resume_info
+    global _injector, _resume_info, _pending_reshard
     with _lock:
         if _installed:
             _restore_handlers()
         _injector = None
         _resume_info = None
+        _pending_reshard = None
         clear_preempted()
     disable()
 
@@ -231,6 +251,7 @@ def preempted():
 def clear_preempted():
     _preempt["flag"] = False
     _preempt["signum"] = None
+    _preempt.pop("resize", None)
 
 
 def restart_count():
@@ -368,7 +389,8 @@ def _jax_process_count():
         return 1
 
 
-def write_checkpoint(directory, writer, step=0, fingerprint=None):
+def write_checkpoint(directory, writer, step=0, fingerprint=None,
+                     layouts=None):
     """Atomic verified checkpoint write.
 
     `writer(tmpdir)` produces the payload (orbax state, .params files,
@@ -393,7 +415,7 @@ def write_checkpoint(directory, writer, step=0, fingerprint=None):
     if _jax_process_count() > 1:
         writer(directory)
         if _process_index() == 0:
-            _write_manifest(directory, step, fingerprint)
+            _write_manifest(directory, step, fingerprint, layouts)
         fault_point("ckpt", step=step, path=directory)
         return directory
     tmp = directory + _TMP_MARK + str(os.getpid())
@@ -402,7 +424,7 @@ def write_checkpoint(directory, writer, step=0, fingerprint=None):
     os.makedirs(tmp)
     try:
         writer(tmp)
-        _write_manifest(tmp, step, fingerprint)
+        _write_manifest(tmp, step, fingerprint, layouts)
         if os.path.exists(directory):
             # replace-in-place: move the old checkpoint aside first (rename
             # over a non-empty directory is not atomic/portable), remove it
@@ -425,14 +447,19 @@ def write_checkpoint(directory, writer, step=0, fingerprint=None):
     return directory
 
 
-def _write_manifest(directory, step, fingerprint):
+def _write_manifest(directory, step, fingerprint, layouts=None):
     manifest = {
-        "schema": 1,
+        "schema": 2,
         "step": int(step),
         "ts": time.time(),
         "fingerprint": fingerprint or {},
         "files": {},
     }
+    if layouts:
+        # per-array shard layouts (parallel/reshard.state_layouts): lets a
+        # restore on a DIFFERENT topology plan the redistribution from
+        # metadata alone, before touching any payload
+        manifest["shardings"] = list(layouts)
     for rel, full in _walk_files(directory):
         if rel == _MANIFEST:
             continue
@@ -516,10 +543,17 @@ def verify_checkpoint(directory):
     return manifest
 
 
+#: fingerprint keys a planned redistribution can bridge — anything else
+#: differing (e.g. the trainer class) is structural, not topological
+RESHARDABLE_KEYS = frozenset({"mesh_shape", "param_mode"})
+
+
 def check_fingerprint(manifest, expected, directory=""):
     """Reject a checkpoint written for a different mesh/config. Compares
     only the keys `expected` carries, so new fingerprint fields stay
-    backward-compatible."""
+    backward-compatible. The raised MeshMismatchError names BOTH
+    fingerprints and the reshard='auto' remediation; callers that may
+    redistribute go through reshard_gate() instead."""
     got = manifest.get("fingerprint") or {}
     bad = {k: (got.get(k), v) for k, v in (expected or {}).items()
            if k in got and got[k] != v}
@@ -528,8 +562,36 @@ def check_fingerprint(manifest, expected, directory=""):
                            for k, (g, c) in sorted(bad.items()))
         raise MeshMismatchError(
             f"checkpoint {directory or '<dir>'} was written for a different "
-            f"topology ({detail}). Restore on the original mesh/param-mode, "
-            "or load it explicitly with resilience disabled to reshard.")
+            f"topology ({detail}; checkpoint fingerprint {got!r}, current "
+            f"{expected!r}). Pass reshard='auto' to load_states / set the "
+            "reshard knob (MXNET_TPU_RESHARD=auto) to redistribute it onto "
+            "the current mesh, or restore on the original topology.",
+            mismatch=bad)
+
+
+def reshard_gate(manifest, trainer, directory="", reshard=None):
+    """check_fingerprint with redistribution awareness: returns False when
+    the checkpoint matches the trainer's topology, True when it differs
+    ONLY in mesh/param-mode and the reshard policy ('auto'/'host', from
+    the argument or the `reshard` knob) allows redistribution. Raises
+    MeshMismatchError when resharding is explicitly off, and for
+    structural mismatches (different trainer class) regardless of
+    policy — no redistribution can bridge those."""
+    mode = reshard if reshard not in (None, "") else _config.get("reshard")
+    if mode not in ("auto", "off", "host"):
+        # an unvalidated per-call override must not fail open: a typo like
+        # 'none' silently behaving as 'auto' would reshard exactly where
+        # the caller asked for the strict check
+        raise ValueError(
+            f"reshard={mode!r}: expected 'auto', 'off', or 'host'")
+    try:
+        check_fingerprint(manifest, trainer_fingerprint(trainer), directory)
+    except MeshMismatchError as e:
+        if mode == "off" or not e.mismatch \
+                or set(e.mismatch) - RESHARDABLE_KEYS:
+            raise
+        return True
+    return False
 
 
 def list_checkpoints(base_dir):
@@ -654,7 +716,12 @@ class CheckpointManager:
         payload file twice on exactly the relaunch path where recovery
         speed matters; this only insists a manifest is present so an
         unmanaged directory can't slip through unverified."""
+        global _pending_reshard
         t0 = time.perf_counter()
+        # drop any transition staged by an earlier, unrelated load_states
+        # call: only a reshard that happens DURING this restore may attach
+        # to the resume record _note_resume writes afterwards
+        _pending_reshard = None
         if not os.path.exists(os.path.join(str(path), _MANIFEST)):
             raise CheckpointCorruptError(
                 f"{path}: no {_MANIFEST} — torn write or not a managed "
@@ -662,9 +729,10 @@ class CheckpointManager:
         if not _enabled:
             # load_states only self-verifies while resilience is enabled;
             # a manager used standalone still gets the full check here
+            # (reshard_gate: a pure topology change passes through while
+            # the reshard knob allows redistribution)
             manifest = verify_checkpoint(path)
-            check_fingerprint(manifest, trainer_fingerprint(self.trainer),
-                              str(path))
+            reshard_gate(manifest, self.trainer, str(path))
         self.policy.call(self.trainer.load_states, path,
                          site="checkpoint-io")
         self._last_saved_step = int(self.trainer.num_update)
@@ -732,6 +800,15 @@ def _note_resume(path, step, fallbacks=0):
     global _resume_info
     _resume_info = {"path": path, "step": int(step),
                     "fallbacks": int(fallbacks)}
+    # topology transition, when this resume redistributed across meshes
+    # (_pending_reshard staged by reshard.note_reshard during the restore
+    # that just finished): the post-mortem resume section then names the
+    # reshape. Consumed here so a later same-topology resume can't
+    # inherit a stale transition.
+    global _pending_reshard
+    if _pending_reshard is not None:
+        _resume_info["reshard"] = _pending_reshard
+        _pending_reshard = None
     print(f"mx.resilience: resumed from {path} (step {step}"
           + (f", {fallbacks} corrupt checkpoint(s) skipped" if fallbacks
              else "") + ")", file=sys.stderr)
@@ -807,24 +884,28 @@ def on_step(trainer):
         _finalize_preemption(mgr, step)
 
 
-def note_preemption(step, path=None, signum=None):
+def note_preemption(step, path=None, signum=None, kind=None):
     """Record one graceful preemption in telemetry + diagnostics (shared
     by the trainer and estimator preemption paths, so preemptions_total
-    means the same thing whichever loop handled the signal)."""
+    means the same thing whichever loop handled the signal). `kind` marks
+    injected elastic reshape requests ("shrink"/"grow") apart from real
+    preemptions."""
     signum = signum if signum is not None else _preempt["signum"]
     if _telemetry._enabled:
         _M_PREEMPTIONS.inc()
-        _telemetry.event("preempt", step=step, signum=signum, path=path)
+        _telemetry.event("preempt", step=step, signum=signum, path=path,
+                         request=kind or "preempt")
     try:
         from . import diagnostics as _diagnostics
         _diagnostics.record_event("preempt", step=step, signum=signum,
-                                  path=path)
+                                  path=path, request=kind or "preempt")
     except Exception:
         pass
 
 
 def _finalize_preemption(mgr, step):
     signum = _preempt["signum"]
+    resize = _preempt.get("resize")
     path = None
     save_failed = False
     if mgr is not None:
@@ -836,7 +917,7 @@ def _finalize_preemption(mgr, step):
             save_failed = True
             print(f"mx.resilience: final preemption checkpoint failed: {e}",
                   file=sys.stderr)
-    note_preemption(step, path=path, signum=signum)
+    note_preemption(step, path=path, signum=signum, kind=resize)
     if save_failed:
         # EXIT_PREEMPTED means "state saved, safe to resume the last
         # interval" — a failed final save must NOT claim it. Exit with
@@ -846,12 +927,16 @@ def _finalize_preemption(mgr, step):
               f"checkpoint FAILED — exiting {code}, resume will use the "
               "last periodic checkpoint", file=sys.stderr)
         raise SystemExit(code)
-    msg = (f"mx.resilience: preempted (signal {signum}) — "
+    code = {"shrink": EXIT_SHRINK, "grow": EXIT_GROW}.get(resize,
+                                                          EXIT_PREEMPTED)
+    what = f"{resize} requested" if resize else f"preempted (signal {signum})"
+    msg = (f"mx.resilience: {what} — "
            + (f"checkpoint saved at step {step} ({path}); " if path
               else "no checkpoint_dir configured; ")
-           + f"exiting {EXIT_PREEMPTED}")
+           + f"exiting {code}"
+           + (" (an elastic supervisor reshapes the gang)" if resize else ""))
     print(msg, file=sys.stderr)
-    raise PreemptedExit(msg)
+    raise PreemptedExit(msg, code=code)
 
 
 # ---------------------------------------------------------------------------
@@ -868,6 +953,17 @@ class FaultInjector:
                               (AFTER its manifest: restore must detect it)
       stall_input:250       — one 250 ms stall inside the input pipeline
       exc@step:2            — raise RuntimeError after step 2 (crash path)
+      shrink@step:3         — after step 3: save a final checkpoint and exit
+                              EXIT_SHRINK (84) — an elastic supervisor
+                              relaunches the gang SMALLER by every rank
+                              that fired (append @rank:N to lose exactly
+                              one worker; untargeted, the whole gang
+                              shrinks to the --min-workers floor); the
+                              resumed workers reshard the checkpoint onto
+                              the surviving topology
+      grow@step:3           — same, exit EXIT_GROW (85): relaunch one
+                              worker LARGER (capacity returned), capped at
+                              the original -n
     Any spec may append @rank:N to fire on that rank only. Specs fire at
     most once, and only on the FIRST launch (MXNET_TPU_RESTART_COUNT=0)
     unless @every_restart is appended — a relaunched gang must not re-kill
@@ -908,11 +1004,11 @@ class FaultInjector:
                         f"fault_inject: unknown qualifier {field!r} in "
                         f"{part!r}")
             if spec["kind"] not in ("sigterm", "kill", "corrupt_ckpt",
-                                    "stall_input", "exc"):
+                                    "stall_input", "exc", "shrink", "grow"):
                 raise ValueError(
                     f"fault_inject: unknown fault {spec['kind']!r} in "
                     f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
-                    "stall_input, exc)")
+                    "stall_input, exc, shrink, grow)")
             specs.append(spec)
         return cls(specs)
 
@@ -934,6 +1030,18 @@ class FaultInjector:
                     continue
                 spec["fired"] = True
                 self._fire_process_fault(kind, step)
+            elif point == "step" and kind in ("shrink", "grow"):
+                if spec["step"] is not None and step != spec["step"]:
+                    continue
+                spec["fired"] = True
+                # elastic reshape request: piggyback on the preemption
+                # machinery — on_step's flag check (which runs AFTER this
+                # fire, in the same step boundary) saves the final
+                # checkpoint and exits EXIT_SHRINK/EXIT_GROW
+                print(f"mx.resilience: fault injection: {kind} at step "
+                      f"{step} (rank {_process_index()})", file=sys.stderr)
+                _preempt["flag"] = True
+                _preempt["resize"] = kind
             elif point == "ckpt" and kind == "corrupt_ckpt":
                 if spec["step"] is not None and step != spec["step"]:
                     continue

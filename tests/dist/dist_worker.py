@@ -55,7 +55,7 @@ def main():
 
     # raw psum sanity: 1 + 2 across ranks
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     local = np.full((1, 4), rank + 1.0, np.float32)
     g = jax.make_array_from_process_local_data(
         specs.batch_spec(2, mesh), local)

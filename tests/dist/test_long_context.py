@@ -32,7 +32,8 @@ def _ring_temp_bytes(mesh, L, chunk=128, B=1, H=2, D=64):
     q = jnp.zeros((B, H, L, D), jnp.float32)
 
     def loss(q, k, v):
-        fn = jax.shard_map(
+        from mxnet_tpu.parallel._compat import shard_map
+        fn = shard_map(
             lambda a, b, c: parallel.ring_attention(
                 a, b, c, "sp", causal=True, chunk=chunk),
             mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,

@@ -65,9 +65,10 @@ def test_ring_chunked_inner_matches_dense():
     q, k, v = _qkv(L=64)
     parallel.make_mesh(sp=4, devices=jax.devices()[:4])
     from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel._compat import shard_map
 
     def run(chunk):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda q_, k_, v_: parallel.ring_attention(
                 q_, k_, v_, "sp", causal=True, chunk=chunk),
             mesh=parallel.current_mesh(),

@@ -1,0 +1,611 @@
+"""mx.reshard + elastic tests: cross-topology checkpoint redistribution
+(the reshard matrix: 4→2, 2→4, data↔model axis-split, fused-LAMB flat
+master — each bit-exact for params/optimizer/RNG/step), live
+elastic.resize_trainer, shrink/grow fault injection, the elastic
+launcher's surviving-world relaunch, and the train-4-way → kill-to-2-way
+→ resume acceptance smoke (ci/run.sh dist)."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd, parallel, resilience, telemetry
+from mxnet_tpu.parallel import reshard
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    yield
+    resilience.uninstall()
+    config.reset()
+    telemetry.reset()
+    telemetry.disable()
+    parallel.set_mesh(None)
+
+
+def _xy(seed=0):
+    rs = np.random.RandomState(seed)
+    return (nd.array(rs.randn(8, 8).astype(np.float32)),
+            nd.array(rs.randn(8, 4).astype(np.float32)))
+
+
+def _trainer(mesh_kw, mode="replicate", seed=0, optimizer="adam",
+             dropout=True):
+    n = int(np.prod([v for v in mesh_kw.values()]))
+    parallel.make_mesh(devices=jax.devices()[:n], **mesh_kw)
+    mx.random.seed(seed)
+    if dropout:
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=8), nn.Dropout(0.5),
+                nn.Dense(4, in_units=8))
+    else:
+        net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    return parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), optimizer,
+                                   {"learning_rate": 0.1}, param_mode=mode)
+
+
+def _flat(arrs):
+    return [np.asarray(a) for a in arrs]
+
+
+def _opt_flat(trainer):
+    if trainer._fused:
+        return [np.asarray(z) for z in trainer.opt_state]
+    return [np.asarray(z) for st in trainer.opt_state for z in st]
+
+
+# -- layout serialization ----------------------------------------------------
+
+def test_spec_tree_roundtrip():
+    from jax.sharding import PartitionSpec as P
+    for spec in (P(), P("dp"), P(None, "fsdp"), P(("dp", "fsdp"), None),
+                 P(None, ("sp", "tp"), "dp")):
+        tree = parallel.specs.spec_to_tree(spec)
+        json.dumps(tree)                       # must be JSON-able
+        assert parallel.specs.spec_from_tree(tree) == spec
+
+
+def test_manifest_records_per_array_shardings(tmp_path):
+    resilience.enable()
+    config.set("fsdp_min_size", 8)             # tiny test weights DO shard
+    tr = _trainer({"dp": 2, "fsdp": 4}, mode="fsdp", dropout=False)
+    x, y = _xy()
+    tr.step(x, y)
+    d = str(tmp_path / "ck" / "step_0000000001")
+    tr.save_states(d)
+    man = json.load(open(os.path.join(d, "manifest.json")))
+    assert "shardings" in man
+    by_name = {e["name"]: e for e in man["shardings"]}
+    w = by_name["params/0"]                    # Dense weight (4, 8)
+    assert w["shape"] == [4, 8] and w["dtype"] == "float32"
+    assert w["mesh"]["fsdp"] == 4
+    assert "fsdp" in json.dumps(w["spec"])     # really sharded over fsdp
+    # optimizer state recorded alongside (arxiv 2004.13336: it reshards
+    # WITH its parameter)
+    assert any(n.startswith("opt_state/") for n in by_name)
+
+
+# -- planner classification --------------------------------------------------
+
+def test_classify_move_matrix():
+    c = reshard.classify_move
+    assert c([4, 1], [4, 1]) == "aligned"
+    assert c([2, 1], [4, 1]) == "split"       # mesh grew
+    assert c([4, 1], [2, 1]) == "merge"       # mesh shrank
+    assert c([4, 1], [1, 1]) == "replicate"   # target replicated
+    assert c([4, 1], [1, 4]) == "redistribute"  # axis flip
+
+
+def test_plan_rejects_shape_and_structure_mismatch():
+    src = [{"name": "params/0", "shape": [4, 8], "dtype": "float32",
+            "spec": None, "mesh": None}]
+    dst_shape = [{"name": "params/0", "shape": [8, 8], "dtype": "float32",
+                  "spec": None, "mesh": None}]
+    with pytest.raises(reshard.ReshardError, match="never shape"):
+        reshard.plan_arrays(src, dst_shape)
+    dst_names = [{"name": "params/1", "shape": [4, 8], "dtype": "float32",
+                  "spec": None, "mesh": None}]
+    with pytest.raises(reshard.ReshardError, match="different model"):
+        reshard.plan_arrays(src, dst_names)
+
+
+def test_plan_peak_bounded_by_largest_array():
+    """The bounded-memory contract: a multi-array plan's peak is ONE
+    array's footprint, not the model's (arrays move one at a time)."""
+    mesh = {"dp": 4}
+    mk = lambda name, shape: {"name": name, "shape": list(shape),
+                              "dtype": "float32", "spec": [["dp"]],
+                              "mesh": mesh}
+    mk2 = lambda name, shape: {"name": name, "shape": list(shape),
+                               "dtype": "float32", "spec": [["dp"]],
+                               "mesh": {"dp": 2}}
+    src = [mk("a", (64, 64)), mk("b", (64, 64)), mk("c", (128, 64))]
+    dst = [mk2("a", (64, 64)), mk2("b", (64, 64)), mk2("c", (128, 64))]
+    plan = reshard.plan_arrays(src, dst)
+    assert plan.bytes_total == (64 * 64 * 2 + 128 * 64) * 4
+    assert plan.peak_bytes < plan.bytes_total
+    # largest array: 128*64*4 bytes; its src shard (1/4) + dst shard (1/2)
+    assert plan.peak_bytes == 128 * 64 * 4 // 4 + 128 * 64 * 4 // 2
+    assert plan.strategies == {"merge": 3}
+    assert "merge" in plan.describe()
+
+
+# -- the reshard matrix: checkpoint restore across topologies ----------------
+
+def _roundtrip(save_kw, save_mode, load_kw, load_mode, optimizer="adam"):
+    """Save after 3 steps on one topology, restore on another: params,
+    optimizer state, RNG stream and step counter must be bit-exact, and
+    the next step must replay the same batch/dropout draws (bit-exact on
+    the same topology; to the last ulp of reduction order otherwise)."""
+    resilience.enable()
+    tr = _trainer(save_kw, mode=save_mode, seed=5, optimizer=optimizer)
+    x, y = _xy()
+    for _ in range(3):
+        tr.step(x, y)
+    import tempfile
+    d = os.path.join(tempfile.mkdtemp(), "step_0000000003")
+    tr.save_states(d)
+    p_ref, o_ref = _flat(tr.params if not tr._fused else [tr.params]), \
+        _opt_flat(tr)
+    cont = tr.step(x, y).asnumpy()             # uninterrupted step 4
+
+    tr2 = _trainer(load_kw, mode=load_mode, seed=77, optimizer=optimizer)
+    tr2.load_states(d)
+    assert tr2.num_update == 3
+    assert int(tr2._t_dev) == 3                # device counter restored
+    assert tr._fused == tr2._fused
+    p_new = _flat(tr2.params if not tr2._fused else [tr2.params])
+    # redistribution moves bytes, never values: restored params and
+    # optimizer state are bit-exact whatever the topology change
+    for a, b in zip(p_ref, p_new):
+        assert np.array_equal(a, b), "params not bit-exact"
+    for a, b in zip(o_ref, _opt_flat(tr2)):
+        assert np.array_equal(a, b), "optimizer state not bit-exact"
+    # same RNG stream (dropout mask) + same state → the resumed step
+    # replays the uninterrupted one. Bit-exact when the topology is
+    # unchanged; across an axis-split change the matmul/psum partitioning
+    # changes the float reduction ORDER, so compare to the last ulp.
+    resumed = tr2.step(x, y).asnumpy()
+    if (save_kw, save_mode) == (load_kw, load_mode):
+        assert np.array_equal(resumed, cont), (resumed, cont)
+    else:
+        np.testing.assert_allclose(resumed, cont, rtol=2e-6)
+    return tr, tr2
+
+
+def test_restore_4_to_2():
+    _roundtrip({"dp": 4}, "replicate", {"dp": 2}, "replicate")
+
+
+def test_restore_2_to_4():
+    _roundtrip({"dp": 2}, "replicate", {"dp": 4}, "replicate")
+
+
+def test_restore_data_to_model_axis_split():
+    config.set("fsdp_min_size", 8)
+    tr, tr2 = _roundtrip({"dp": 4}, "replicate", {"dp": 2, "fsdp": 4},
+                         "fsdp")
+    # the restored params really are sharded over the model axis (while
+    # _roundtrip asserted global bit-exactness)
+    specs = [str(p.sharding.spec) for p in tr2.params]
+    assert any("fsdp" in s for s in specs), specs
+
+
+def test_restore_model_to_data_axis_split():
+    config.set("fsdp_min_size", 8)
+    tr, tr2 = _roundtrip({"dp": 2, "fsdp": 4}, "fsdp", {"dp": 4},
+                         "replicate")
+    assert all(p.sharding.is_fully_replicated for p in tr2.params)
+
+
+def test_restore_fused_lamb_flat_master_across_meshes():
+    """The fused-LAMB flat f32 master + moments (checkpointed in the
+    canonical per-tensor layout) survive a 4→2 mesh change bit-exactly —
+    including re-flattening on the restore side (asserted by _roundtrip
+    on the flat masters directly)."""
+    assert config.get("fused_lamb")
+    tr, tr2 = _roundtrip({"dp": 4}, "replicate", {"dp": 2}, "replicate",
+                         optimizer="lamb")
+    assert tr._fused and tr2._fused
+    assert tr2.params.shape == tr.params.shape    # same flat-master layout
+
+
+def test_restore_emits_reshard_telemetry(tmp_path):
+    resilience.enable()
+    telemetry.reset()
+    telemetry.enable()
+    tr = _trainer({"dp": 4}, seed=1, dropout=False)
+    x, y = _xy()
+    tr.step(x, y)
+    d = str(tmp_path / "step_0000000001")
+    tr.save_states(d)
+    before = reshard._M_SECONDS.count
+    tr2 = _trainer({"dp": 2}, seed=2, dropout=False)
+    tr2.load_states(d)
+    assert reshard._M_SECONDS.count == before + 1
+    ev = [e for e in telemetry.events() if e.get("kind") == "reshard"]
+    assert ev, "no reshard telemetry event"
+    ev = ev[-1]
+    assert ev["op"] == "restore"
+    assert ev["from"]["mesh_shape"]["dp"] == 4
+    assert ev["to"]["mesh_shape"]["dp"] == 2
+    assert ev["arrays"] > 0
+    # replicated params on a SMALLER mesh are a shard-for-shard copy onto
+    # new devices ("migrate"), not a free "aligned" read: the headline
+    # bytes_moved must not claim 0 for the primary use case
+    assert "migrate" in ev["strategies"], ev["strategies"]
+    assert ev["bytes_moved"] > 0
+    # bounded peak: never the whole model at once
+    assert 0 < ev["peak_bytes"] <= ev["bytes_total"]
+    assert reshard.last_reshard()["op"] == "restore"
+    # the resume/post-mortem surface carries the topology transition
+    mgr = resilience.CheckpointManager(tr2, str(tmp_path))
+    assert mgr.restore_latest() == 1
+    assert resilience.last_resume()["reshard"]["to"]["mesh_shape"]["dp"] == 2
+
+
+def test_same_topology_restore_plans_no_reshard(tmp_path):
+    resilience.enable()
+    tr = _trainer({"dp": 4}, seed=1, dropout=False)
+    x, y = _xy()
+    tr.step(x, y)
+    d = str(tmp_path / "step_0000000001")
+    tr.save_states(d)
+    reshard._last = None
+    tr2 = _trainer({"dp": 4}, seed=2, dropout=False)
+    tr2.load_states(d)
+    assert reshard.last_reshard() is None      # aligned: no reshard event
+
+
+# -- live redistribution primitives ------------------------------------------
+
+def test_redistribute_host_path_matches_device_path():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh4 = parallel.make_mesh(dp=4, devices=jax.devices()[:4])
+    x = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                       NamedSharding(mesh4, P("dp")))
+    mesh2 = parallel.make_mesh(dp=2, devices=jax.devices()[:2])
+    dst = NamedSharding(mesh2, P(None, "dp"))  # axis flip too
+    via_dev = reshard.redistribute(x, dst)
+    via_host = reshard.redistribute(x, dst, via="host")
+    assert np.array_equal(np.asarray(via_dev), np.asarray(via_host))
+    assert np.array_equal(np.asarray(via_dev),
+                          np.arange(64, dtype=np.float32).reshape(8, 8))
+    assert via_host.sharding == dst
+
+
+def test_resize_trainer_bit_exact_and_continues():
+    x, y = _xy()
+    ref = _trainer({"dp": 4}, seed=3)
+    losses_ref = [float(ref.step(x, y).asscalar()) for _ in range(6)]
+
+    tr = _trainer({"dp": 4}, seed=3)
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    before = _flat(tr.params)
+    opt_before = _opt_flat(tr)
+    plan = parallel.resize_trainer(tr, dp=2, devices=jax.devices()[:2])
+    assert dict(tr.mesh.shape)["dp"] == 2
+    assert tr.num_update == 3 and int(tr._t_dev) == 3
+    for a, b in zip(before, _flat(tr.params)):
+        assert np.array_equal(a, b)
+    for a, b in zip(opt_before, _opt_flat(tr)):
+        assert np.array_equal(a, b)
+    assert plan.moves                          # a real executed plan
+    assert plan.strategies.get("migrate"), plan.strategies
+    assert plan.bytes_moved > 0                # re-placement is movement
+    losses += [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    # same global batches → same trajectory (reduction order may differ
+    # across mesh shapes: allclose, not bit-equal, after the resize)
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-6)
+
+
+def test_resize_trainer_fused_lamb_and_grow():
+    x, y = _xy()
+    tr = _trainer({"dp": 2}, seed=4, optimizer="lamb", dropout=False)
+    assert tr._fused
+    for _ in range(2):
+        tr.step(x, y)
+    master = np.asarray(tr.params)
+    parallel.resize_trainer(tr, dp=8)          # grow 2 → 8
+    assert np.array_equal(master, np.asarray(tr.params))
+    tr.step(x, y)                              # steps fine on the new mesh
+
+
+def test_resize_trainer_remaps_explicit_param_sharding():
+    """An explicit Parameter.set_sharding given as a concrete
+    NamedSharding is pinned to the OLD mesh; resize must carry its spec
+    onto the new mesh instead of no-opping and leaving one array on
+    devices the gang no longer owns."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh4 = parallel.make_mesh(dp=4, devices=jax.devices()[:4])
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    for _name, p in net.collect_params().items():
+        p.set_sharding(NamedSharding(mesh4, P()))
+    lfn = gloss.L2Loss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                                 {"learning_rate": 0.1})
+    x, y = _xy()
+    tr.step(x, y)
+    before = _flat(tr.params)
+    parallel.resize_trainer(tr, dp=2, devices=jax.devices()[:2])
+    for p in tr.params:
+        assert p.sharding.mesh == tr.mesh      # no array left behind
+    for a, b in zip(before, _flat(tr.params)):
+        assert np.array_equal(a, b)
+    tr.step(x, y)                              # jit on the new mesh works
+
+
+def test_resize_trainer_requires_ready():
+    parallel.make_mesh(dp=4, devices=jax.devices()[:4])
+    mx.random.seed(0)
+    net = nn.Dense(4)                          # deferred in_units
+    net.initialize()
+    lfn = gloss.L2Loss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                                 {"learning_rate": 0.1})
+    with pytest.raises(RuntimeError, match="deferred-shape"):
+        parallel.resize_trainer(tr, dp=2, devices=jax.devices()[:2])
+
+
+# -- shrink/grow fault injection ---------------------------------------------
+
+def test_fault_injector_parses_shrink_grow():
+    inj = resilience.FaultInjector.parse("shrink@step:3,grow@step:5@rank:1")
+    kinds = [(s["kind"], s["step"], s["rank"]) for s in inj._specs]
+    assert kinds == [("shrink", 3, None), ("grow", 5, 1)]
+    with pytest.raises(ValueError, match="unknown fault"):
+        resilience.FaultInjector.parse("explode@step:1")
+
+
+@pytest.mark.parametrize("kind,code", [
+    ("shrink", resilience.EXIT_SHRINK), ("grow", resilience.EXIT_GROW)])
+def test_shrink_grow_fault_saves_and_exits_distinct(tmp_path, kind, code):
+    config.set("checkpoint_dir", str(tmp_path / "ck"))
+    config.set("fault_inject", f"{kind}@step:2")
+    resilience.enable()
+    tr = _trainer({"dp": 4}, seed=6, dropout=False)
+    x, y = _xy()
+    with pytest.raises(SystemExit) as ei:
+        for _ in range(5):
+            tr.step(x, y)
+    assert ei.value.code == code
+    assert tr.num_update == 2                  # the step DID finish
+    # the reshape request saved a final checkpoint first — the relaunched
+    # (resized) gang resumes from it
+    assert [s for s, _ in resilience.list_checkpoints(
+        str(tmp_path / "ck"))] == [2]
+
+
+# -- elastic launcher --------------------------------------------------------
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location("mx_launch", LAUNCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_plan_world_policies():
+    launch = _load_launch()
+    # not elastic: world never changes
+    assert launch._plan_world(4, [0, 1, None, None], False, 1, 4)[0] == 4
+    # hard rank death (SIGKILL → negative poll code): shrink by the lost
+    w, surv, lost = launch._plan_world(4, [None, -9, None, 0], True, 1, 4)
+    assert (w, surv, lost) == (3, [0, 2, 3], [1])
+    # two lost at once (settle window): one two-worker shrink
+    w, _, lost = launch._plan_world(4, [None, -9, -9, None], True, 1, 4)
+    assert (w, lost) == (2, [1, 2])
+    # floor at min_workers
+    assert launch._plan_world(2, [-9, -9], True, 2, 4)[0] == 2
+    # preemption save (83) and shrink request (84) lose the slot too
+    assert launch._plan_world(3, [None, 83, None], True, 1, 4)[0] == 2
+    assert launch._plan_world(3, [None, 84, None], True, 1, 4)[0] == 2
+    # grow request: +1, capped at the original -n
+    assert launch._plan_world(2, [85, None], True, 1, 4)[0] == 3
+    assert launch._plan_world(4, [85, None, None, None], True, 1, 4)[0] == 4
+    # a plain crash must NOT reshape the job — including crash SIGNALS:
+    # a reproducible SIGSEGV/SIGABRT bug would otherwise shrink the gang
+    # one worker per restart until nothing was left
+    assert launch._plan_world(4, [None, 7, None, None], True, 1, 4)[0] == 4
+    assert launch._plan_world(4, [None, -11, None, None], True, 1, 4)[0] == 4
+    assert launch._plan_world(4, [None, -6, None, None], True, 1, 4)[0] == 4
+
+
+def test_launch_elastic_shrink_then_grow(tmp_path):
+    """End-to-end supervisor cycle with jax-free workers: gen 0 loses a
+    rank to a shrink request (world 2 → 1), gen 1 requests growth back
+    (1 → 2), gen 2 exits clean. restarts.jsonl records every generation's
+    world size + surviving set; postmortem_report renders the history."""
+    diag = str(tmp_path / "diag")
+    worker = tmp_path / "w.py"
+    worker.write_text(
+        "import os, sys\n"
+        "gen = int(os.environ['MXNET_TPU_RESTART_COUNT'])\n"
+        "rank = int(os.environ['JAX_PROCESS_ID'])\n"
+        "world = int(os.environ['JAX_NUM_PROCESSES'])\n"
+        "print(f'gen {gen} rank {rank} world {world}', flush=True)\n"
+        "if gen == 0 and rank == 1: sys.exit(84)\n"
+        "if gen == 1 and world == 1: sys.exit(85)\n"
+        "sys.exit(0)\n")
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "4", "--restart-backoff", "0.1", "--elastic",
+         "--min-workers", "1", "--diagnostics-dir", diag,
+         sys.executable, str(worker)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    events = [json.loads(line) for line in
+              open(os.path.join(diag, "restarts.jsonl"))]
+    assert [(e["world_size"], e["new_world_size"]) for e in events] == \
+        [(2, 1), (1, 2)]
+    assert events[0]["surviving_ranks"] == [0]
+    assert events[0]["lost_ranks"] == [1]
+    # the final generation really ran 2 workers again
+    assert "gen 2 rank 1 world 2" in r.stdout
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import postmortem_report
+        importlib.reload(postmortem_report)
+        hist = postmortem_report.reshape_history(events)
+    finally:
+        sys.path.pop(0)
+    assert len(hist) == 2
+    assert "RESHAPED to 1" in hist[0] and "RESHAPED to 2" in hist[1]
+
+
+def test_postmortem_report_renders_topology_transition(tmp_path):
+    """The per-rank resume section names the reshape: fingerprints,
+    arrays, bytes moved."""
+    pm = {"rank": 0, "exit": {"kind": "clean"},
+          "resume": {"path": "/ck/step_0000000003", "step": 3,
+                     "fallbacks": 0,
+                     "reshard": {"op": "restore", "arrays": 13,
+                                 "bytes_total": 4096, "bytes_moved": 4096,
+                                 "peak_bytes": 1024, "seconds": 0.01,
+                                 "from": {"mesh_shape": {"dp": 4},
+                                          "param_mode": "replicate"},
+                                 "to": {"mesh_shape": {"dp": 2},
+                                        "param_mode": "replicate"}}}}
+    d = tmp_path / "0"
+    d.mkdir()
+    (d / "postmortem.json").write_text(json.dumps(pm))
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import postmortem_report
+        importlib.reload(postmortem_report)
+        out = postmortem_report.report([str(tmp_path)])
+    finally:
+        sys.path.pop(0)
+    assert "resumed from /ck/step_0000000003" in out
+    assert "resharded dp=4/replicate -> dp=2/replicate" in out
+    assert "13 arrays" in out
+
+
+# -- acceptance smoke: train 4-way, kill to 2-way, resume --------------------
+
+_ELASTIC_WORKER = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + \
+        " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, {root!r})
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, resilience, config
+from mxnet_tpu.gluon import nn, loss as gloss
+
+rank = int(os.environ.get("JAX_PROCESS_ID", "0"))
+world = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+base, total = sys.argv[1], int(sys.argv[2])
+config.set("checkpoint_dir", os.path.join(base, "ck", str(rank)))
+config.set("checkpoint_every_n_steps", 1)
+config.set("resume", "auto")
+resilience.install()
+
+dp = 2 * world          # gen 0 (2 workers): 4-way mesh; after the kill
+#                         (1 worker): 2-way — the checkpoint reshards
+parallel.make_mesh(dp=dp, devices=jax.devices()[:dp])
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                             {{"learning_rate": 0.1}})
+rs = np.random.RandomState(42)
+batches = [(rs.randn(8, 8).astype(np.float32),
+            rs.randn(8, 4).astype(np.float32)) for _ in range(total)]
+while tr.num_update < total:
+    xb, yb = batches[tr.num_update]
+    loss = tr.step(nd.array(xb), nd.array(yb))
+    print(f"LOSS {{float(loss.asscalar())!r}} STEP {{tr.num_update}} "
+          f"DP {{dp}}", flush=True)
+print(f"rank {{rank}} done at step {{tr.num_update}} (dp={{dp}})",
+      flush=True)
+"""
+
+
+@pytest.mark.slow  # several subprocess jax sessions; ci/run.sh dist runs it
+def test_elastic_kill_shrink_resume_matches_reference(tmp_path):
+    """Acceptance (ROADMAP item 3): a 2-worker gang training on 4-way
+    meshes loses BOTH workers to SIGKILL at step 3; the elastic
+    supervisor relaunches at the surviving floor (1 worker), which
+    reshards the 4-way checkpoint onto a 2-way mesh and finishes. The
+    loss trajectory matches the uninterrupted 4-way run (modulo the
+    reduction-order change of the reshaped mesh)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ELASTIC_WORKER.format(root=ROOT))
+    total = 6
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PROCESS_ID", "MXNET_TPU_FAULT_INJECT")}
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env_ref = dict(env)
+    env_ref["JAX_NUM_PROCESSES"] = "2"         # uninterrupted 4-way run
+    r = subprocess.run(
+        [sys.executable, str(worker), str(ref_dir), str(total)],
+        capture_output=True, text=True, timeout=300, env=env_ref)
+    assert r.returncode == 0, r.stdout + r.stderr
+    ref_losses = [float(v) for v in
+                  __import__("re").findall(r"LOSS (\S+) STEP", r.stdout)]
+    assert len(ref_losses) == total
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    env = dict(env)
+    env["MXNET_TPU_FAULT_INJECT"] = "kill@step:3"   # every rank: slice dies
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "2", "--restart-backoff", "0.1", "--elastic",
+         "--min-workers", "1", "--diagnostics-dir", str(run_dir / "diag"),
+         sys.executable, str(worker), str(run_dir), str(total)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    events = [json.loads(line) for line in
+              open(run_dir / "diag" / "restarts.jsonl")]
+    assert events[0]["world_size"] == 2
+    assert events[0]["new_world_size"] == 1    # kill-to-2-way (1 worker)
+    log0 = open(run_dir / "diag" / "0" / "worker.log").read()
+    # the relaunch RESUMED (not restarted) and redistributed the 4-way
+    # checkpoint onto the 2-way mesh
+    assert "resumed from" in log0
+    assert "mx.reshard: restore across topologies" in log0
+    assert "dp=4" in log0 and "dp=2" in log0
+    import re
+    got = [(float(v), int(s), int(d)) for v, s, d in
+           re.findall(r"LOSS (\S+) STEP (\d+) DP (\d+)", log0)]
+    # generation 0 trained 4-way; every rank dies at step 3 (a killed
+    # rank's own step-3 line may not reach the log — the SIGKILL lands
+    # inside the step hook, before the print — and a rank torn down
+    # before ITS step 3 stops earlier still); the resumed generation
+    # picks up from the last checkpoint on the 2-way mesh and finishes
+    dp4 = [s for _, s, d in got if d == 4]
+    dp2 = [s for _, s, d in got if d == 2]
+    assert dp4 and max(dp4) <= 3, got          # 4-way ended at the kill
+    assert dp2 and dp2[-1] == total, got       # 2-way ran to completion
+    assert min(dp2) > min(dp4), got            # resume continued, no redo
+    # the loss trajectory matches the uninterrupted 4-way run step for
+    # step, modulo the reshaped mesh's reduction order
+    for v, s, _ in got:
+        np.testing.assert_allclose(v, ref_losses[s - 1], rtol=1e-5,
+                                   err_msg=f"step {s}")

@@ -272,8 +272,13 @@ def test_restore_falls_back_past_corrupt_latest(tmp_path):
     assert tr2.num_update == 2
 
 
-def test_mesh_mismatch_raises_not_falls_back(tmp_path):
+def test_mesh_mismatch_raises_only_when_reshard_off(tmp_path):
+    """reshard='off' restores the strict contract: a topology mismatch
+    raises MeshMismatchError naming BOTH fingerprints and the
+    reshard='auto' remediation. (The default — reshard='auto' —
+    redistributes instead; tests/unittest/test_reshard.py covers it.)"""
     resilience.enable()
+    config.set("reshard", "off")
     tr = _trainer(seed=3)
     x, y = _xy()
     tr.step(x, y)
@@ -287,8 +292,19 @@ def test_mesh_mismatch_raises_not_falls_back(tmp_path):
     mgr = resilience.CheckpointManager(tr2, str(tmp_path / "ck"))
     with pytest.raises(resilience.MeshMismatchError):
         mgr.restore_latest()
-    with pytest.raises(resilience.MeshMismatchError):
+    with pytest.raises(resilience.MeshMismatchError) as ei:
         tr2.load_states(d)
+    msg = str(ei.value)
+    assert "checkpoint fingerprint" in msg and "current" in msg
+    assert "reshard='auto'" in msg          # the remediation, by name
+    assert ei.value.mismatch                # structured mismatch detail
+    # explicit per-call override beats the knob in the other direction too
+    config.set("reshard", "auto")
+    with pytest.raises(resilience.MeshMismatchError):
+        tr2.load_states(d, reshard="off")
+    # a typo'd override must fail closed, not silently behave as 'auto'
+    with pytest.raises(ValueError, match="expected 'auto'"):
+        tr2.load_states(d, reshard="none")
 
 
 def test_displaced_checkpoint_recovered(tmp_path):

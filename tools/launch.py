@@ -25,7 +25,15 @@ XLA collectives replace the parameter server. So this launcher:
     tears down the peers, backs off exponentially, and relaunches the
     whole gang (workers running mx.resilience with resume='auto' then
     continue from the last good checkpoint); restart events append to
-    `<diagnostics-dir>/restarts.jsonl`.
+    `<diagnostics-dir>/restarts.jsonl` with the per-generation world
+    size and surviving-worker set,
+  * with `--elastic` (plus `--min-workers M`) the relaunch happens at
+    the SURVIVING world size instead of the original shape: ranks that
+    lost their slot (signal death, preemption save, injected
+    shrink@step) shrink the gang, an EXIT_GROW request grows it back
+    toward `-n`; workers resuming with mx.resilience reshard='auto'
+    redistribute the checkpoint onto the new topology
+    (`tools/postmortem_report.py` renders the reshape history).
 
 `-s` (servers) is accepted and ignored with a warning: there are no
 parameter servers on TPU (SURVEY.md §2.5).
@@ -47,10 +55,21 @@ import sys
 import threading
 import time
 
-# mirror of mxnet_tpu.resilience.EXIT_PREEMPTED (the launcher must stay
-# import-light — no jax): a worker exiting with this code saved a final
-# checkpoint on SIGTERM and is safe to relaunch
+# mirrors of mxnet_tpu.resilience exit codes (the launcher must stay
+# import-light — no jax): a worker exiting EXIT_PREEMPTED saved a final
+# checkpoint on SIGTERM and is safe to relaunch; EXIT_SHRINK/EXIT_GROW
+# are elastic reshape requests (state saved, relaunch the gang smaller /
+# larger — honored with --elastic)
 EXIT_PREEMPTED = 83
+EXIT_SHRINK = 84
+EXIT_GROW = 85
+
+# seconds an elastic supervisor keeps polling after the FIRST failure
+# before snapshotting exit codes: co-failing ranks (a slice losing several
+# workers at once) land in the same generation instead of causing one
+# single-step shrink per relaunch. The window closes early once every
+# rank has exited
+ELASTIC_SETTLE_S = 3.0
 
 
 def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
@@ -186,11 +205,18 @@ def _log_restart(diagnostics_dir, event):
     """Restart events feed the same observability surfaces as everything
     else: stderr for the operator, <diagnostics_dir>/restarts.jsonl for
     tools (the workers' own telemetry counts restarts_total from
-    MXNET_TPU_RESTART_COUNT)."""
-    kind = "preempted" if event["exit_code"] == EXIT_PREEMPTED else "failed"
+    MXNET_TPU_RESTART_COUNT; tools/postmortem_report.py renders the
+    reshape history from the per-generation world sizes recorded here)."""
+    kind = {EXIT_PREEMPTED: "preempted", EXIT_SHRINK: "requested shrink",
+            EXIT_GROW: "requested grow"}.get(event["exit_code"], "failed")
+    reshape = ""
+    if event.get("new_world_size") != event.get("world_size"):
+        reshape = (f" at world size {event['new_world_size']} "
+                   f"(was {event['world_size']})")
     print(f"launch: rank {event['failed_rank']} {kind} with code "
-          f"{event['exit_code']} — tearing down the gang and relaunching "
-          f"in {event['backoff_s']:.1f}s (restart {event['attempt']})",
+          f"{event['exit_code']} — tearing down the gang and relaunching"
+          f"{reshape} in {event['backoff_s']:.1f}s "
+          f"(restart {event['attempt']})",
           file=sys.stderr)
     if not diagnostics_dir:
         return
@@ -202,13 +228,50 @@ def _log_restart(diagnostics_dir, event):
         print(f"launch: cannot record restart event: {e}", file=sys.stderr)
 
 
+def _plan_world(world, codes, elastic, min_workers, max_world):
+    """Decide the next generation's world size from one failed
+    generation's exit-code snapshot (taken BEFORE teardown, so a rank's
+    code reflects how IT died, not the supervisor's SIGTERM).
+
+      * not elastic → same world (the pre-elastic behavior).
+      * every observed failure is EXIT_GROW → grow by one, capped at the
+        original -n (capacity came back; the gang reabsorbs it).
+      * ranks lost their SLOT — EXIT_SHRINK, a graceful preemption
+        (EXIT_PREEMPTED), or an eviction kill (SIGKILL/SIGTERM from the
+        scheduler) — → the surviving world size, floored at
+        --min-workers: preemption on a shrinking pod is a reshape, not a
+        failure.
+      * plain crashes — nonzero exit codes AND crash signals
+        (SIGSEGV/SIGABRT/...) — → same world: a reproducible code bug
+        must not shrink the gang one worker per restart until nothing is
+        left.
+
+    Returns (new_world, surviving_ranks, lost_ranks)."""
+    failed = {r: c for r, c in enumerate(codes) if c not in (None, 0)}
+    surviving = [r for r in range(world) if r not in failed]
+    if not elastic:
+        return world, surviving, sorted(failed)
+    if failed and all(c == EXIT_GROW for c in failed.values()):
+        return min(max_world, world + 1), surviving, []
+    slot_loss = (-signal.SIGKILL, -signal.SIGTERM,
+                 EXIT_SHRINK, EXIT_PREEMPTED)
+    lost = sorted(r for r, c in failed.items() if c in slot_loss)
+    if lost:
+        return max(min_workers, world - len(lost)), surviving, lost
+    return world, surviving, sorted(failed)
+
+
 def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
-                 max_restarts=0, restart_backoff=3.0):
+                 max_restarts=0, restart_backoff=3.0, elastic=False,
+                 min_workers=1):
     """Run the gang; with --max-restarts, supervise it: when any rank
     dies (crash, SIGKILL rank death, or a preemption save), tear down the
     peer ranks, back off exponentially (with jitter), and relaunch the
     whole gang — which auto-resumes from the last good checkpoint when
-    the workers run with mx.resilience + resume='auto'."""
+    the workers run with mx.resilience + resume='auto'. With --elastic
+    the relaunch happens at the SURVIVING world size (see _plan_world):
+    workers resuming with reshard='auto' redistribute the checkpoint onto
+    the new topology, so losing devices no longer loses the run."""
     killed = {}
 
     def _kill(signum, _frame):
@@ -221,14 +284,15 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
     signal.signal(signal.SIGINT, _kill)
     signal.signal(signal.SIGTERM, _kill)
     attempt = 0
+    world = num_workers
     while True:
         if killed.get("sig"):
             # signal arrived during the restart backoff: no gang running,
             # nothing to tear down — just exit with the signal code
             sys.exit(128 + killed["sig"])
         procs, pumps = [], []
-        for rank in range(num_workers):
-            env = build_env(rank, num_workers, coordinator, diagnostics_dir,
+        for rank in range(world):
+            env = build_env(rank, world, coordinator, diagnostics_dir,
                             restart_count=attempt)
             proc, pump = _spawn(command, env, rank, diagnostics_dir,
                                 restart_count=attempt)
@@ -236,12 +300,25 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             pumps.append(pump)
         code, rank = _reap(procs, pumps, early_exit=max_restarts > 0,
                            killed=killed)
+        codes = [p.poll() for p in procs]
         if code != 0 and max_restarts > 0:
+            if elastic:
+                # settle window: let co-failing ranks (several workers of
+                # one evicted slice) finish dying before the snapshot, so
+                # the shrink happens once, not one worker per relaunch
+                deadline = time.monotonic() + ELASTIC_SETTLE_S
+                while time.monotonic() < deadline \
+                        and any(p.poll() is None for p in procs) \
+                        and not killed.get("sig"):
+                    time.sleep(0.05)
+                codes = [p.poll() for p in procs]
             # early-exit reap leaves the peers running: tear the gang down
             # whether or not a relaunch follows (no orphans on giving up)
             _terminate_gang(procs, pumps)
         if code == 0 or attempt >= max_restarts:
             return code
+        new_world, surviving, lost = _plan_world(
+            world, codes, elastic, min_workers, num_workers)
         attempt += 1
         backoff = restart_backoff * (2.0 ** (attempt - 1)) \
             * random.uniform(0.8, 1.2)
@@ -249,7 +326,11 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             "ts": time.time(), "kind": "restart", "attempt": attempt,
             "failed_rank": rank, "exit_code": code,
             "preempted": code == EXIT_PREEMPTED,
+            "world_size": world, "new_world_size": new_world,
+            "surviving_ranks": surviving, "lost_ranks": lost,
+            "elastic": bool(elastic),
             "backoff_s": round(backoff, 3)})
+        world = new_world
         # sliced sleep: PEP 475 restarts a plain sleep after the flag-only
         # signal handler runs, so a Ctrl-C during a long backoff would
         # otherwise be ignored until the backoff elapsed
@@ -308,6 +389,24 @@ def main(argv=None):
     p.add_argument("--restart-backoff", type=float, default=3.0,
                    help="base seconds between relaunches; doubles per "
                         "restart, jittered +-20%%")
+    p.add_argument("--elastic", action="store_true",
+                   default=os.environ.get("MXNET_TPU_ELASTIC", "").lower()
+                   in ("1", "true", "yes", "on"),
+                   help="elastic gang (with --max-restarts): relaunch at "
+                        "the SURVIVING world size when ranks lose their "
+                        "slot (signal death, preemption save, or an "
+                        "injected shrink request), grow back one worker "
+                        "on an EXIT_GROW request (capped at -n). Workers "
+                        "resuming with mx.resilience reshard='auto' "
+                        "redistribute the checkpoint onto the new "
+                        "topology. Default from MXNET_TPU_ELASTIC.")
+    p.add_argument("--min-workers", type=int,
+                   default=int(os.environ.get("MXNET_TPU_MIN_WORKERS",
+                                              "1")),
+                   help="smallest world size an elastic gang may shrink "
+                        "to: a relaunch after slot losses is clamped to "
+                        "this floor, never below it. Default from "
+                        "MXNET_TPU_MIN_WORKERS.")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
@@ -321,9 +420,9 @@ def main(argv=None):
     if args.launcher == "ssh":
         if not args.hostfile:
             p.error("ssh launcher needs -H hostfile")
-        if args.max_restarts:
-            print("warning: --max-restarts is local-launcher only "
-                  "(supervise ssh gangs externally)", file=sys.stderr)
+        if args.max_restarts or args.elastic:
+            print("warning: --max-restarts/--elastic are local-launcher "
+                  "only (supervise ssh gangs externally)", file=sys.stderr)
         with open(args.hostfile) as f:
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
@@ -332,7 +431,9 @@ def main(argv=None):
     return launch_local(args.num_workers, args.command, args.coordinator,
                         args.diagnostics_dir,
                         max_restarts=args.max_restarts,
-                        restart_backoff=args.restart_backoff)
+                        restart_backoff=args.restart_backoff,
+                        elastic=args.elastic,
+                        min_workers=args.min_workers)
 
 
 if __name__ == "__main__":

@@ -169,6 +169,67 @@ def diverging_rank(timeline, rel_tol=0.05):
     return None
 
 
+def load_restarts(args):
+    """Restart events from <diagnostics_dir>/restarts.jsonl for any
+    directory argument (written by tools/launch.py --max-restarts)."""
+    events = []
+    for arg in args:
+        path = os.path.join(arg, "restarts.jsonl") \
+            if os.path.isdir(arg) else None
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        events.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    return events
+
+
+def reshape_history(events):
+    """Render the gang's generation/topology history: which rank died
+    with what code each generation, and the world size the supervisor
+    relaunched at (the reshape, when --elastic shrank/grew the gang)."""
+    lines = []
+    for e in events:
+        if e.get("kind") != "restart":
+            continue
+        world = e.get("world_size")
+        new = e.get("new_world_size", world)
+        gen = e.get("attempt", "?")
+        code = e.get("exit_code")
+        what = {83: "preempted (state saved)", 84: "requested shrink",
+                85: "requested grow"}.get(code, f"failed (code {code})")
+        line = (f"  gen {int(gen) - 1 if isinstance(gen, int) else gen}"
+                f" ({world} worker(s)): rank {e.get('failed_rank')} {what}")
+        if e.get("lost_ranks"):
+            line += f", lost {e['lost_ranks']}"
+        if new != world:
+            line += f" -> RESHAPED to {new} worker(s)"
+        else:
+            line += f" -> relaunched at {new} worker(s)"
+        surv = e.get("surviving_ranks")
+        if surv is not None:
+            line += f" (surviving: {surv})"
+        lines.append(line)
+    return lines
+
+
+def _fp(fp):
+    """Brief topology fingerprint: 'dp=4/replicate'."""
+    if not isinstance(fp, dict):
+        return "?"
+    mesh = fp.get("mesh_shape") or {}
+    parts = ["x".join(f"{k}={v}" for k, v in sorted(mesh.items())
+                      if v != 1) or "1-device"] if mesh else []
+    if fp.get("param_mode"):
+        parts.append(str(fp["param_mode"]))
+    return "/".join(parts) or "?"
+
+
 def report(args):
     found = find_dumps(args)
     if not found:
@@ -193,6 +254,15 @@ def report(args):
                 if res.get("fallbacks") else ""
             lines.append(f"  resumed from {res['path']} "
                          f"(step {res.get('step')}){extra}")
+            rs = res.get("reshard")
+            if isinstance(rs, dict):
+                # topology transition: this resume redistributed the
+                # checkpoint onto a different mesh/param-mode
+                lines.append(
+                    f"  resharded {_fp(rs.get('from'))} -> "
+                    f"{_fp(rs.get('to'))}: {rs.get('arrays')} arrays, "
+                    f"{(rs.get('bytes_moved') or 0) / 1e6:.1f} MB moved "
+                    f"in {rs.get('seconds', 0):.3f}s")
         if status != "clean":
             failing.append(rank)
 
@@ -231,6 +301,12 @@ def report(args):
         who = f"rank {ranks[0]}" if len(ranks) == 1 \
             else "ranks " + ", ".join(str(r) for r in ranks)
         lines.append(f"divergence: {who} at step {step}: {detail}")
+
+    restarts = reshape_history(load_restarts(args))
+    if restarts:
+        lines.append("")
+        lines.append("reshape history (restarts.jsonl):")
+        lines.extend(restarts)
 
     if failing:
         lines.append("")
