@@ -112,7 +112,7 @@ def run_bench(on_tpu):
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
-    from mxnet_tpu import diagnostics, nd, parallel, telemetry
+    from mxnet_tpu import diagnostics, memsafe, nd, parallel, telemetry
     from mxnet_tpu import inspect as mxinspect
     from mxnet_tpu.models import bert as bert_mod
 
@@ -128,6 +128,12 @@ def run_bench(on_tpu):
     # peak_device_bytes, comm_bytes_per_step), not just wall-clock
     telemetry.enable()
     mxinspect.enable()
+    # mx.memsafe rides along too: each compile's pre-flight budget check
+    # records predicted peak vs capacity, so the JSON line reports real
+    # memory headroom (null on CPU, where no bytes_limit exists) — and an
+    # actual OOM during the bench degrades per oom_recover instead of
+    # losing the artifact
+    memsafe.enable()
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
@@ -145,6 +151,12 @@ def run_bench(on_tpu):
     model = bert_mod.BERTForPretraining(cfg)
     mx.random.seed(0)
     model.initialize()
+    bench_remat = os.environ.get("MXNET_TPU_BENCH_REMAT", "")
+    if bench_remat:
+        # A/B hook: time the headline row under a graduated remat policy
+        # (the remat_policy knob would work too; the env var scopes it to
+        # this process only)
+        model.remat(bench_remat)
     trainer = parallel.ShardedTrainer(
         model, bert_mod.bert_pretrain_loss, "lamb",
         {"learning_rate": 1e-3, "wd": 0.01})
@@ -271,6 +283,37 @@ def run_bench(on_tpu):
     out["achieved_tflops"] = rnd(insp.get("achieved_tflops"), 4)
     out["peak_device_bytes"] = insp.get("peak_device_bytes")
     out["comm_bytes_per_step"] = insp.get("comm_bytes_per_step")
+    # memory-safety fields (mx.memsafe): headroom from the last pre-flight
+    # check (null when the backend reports no bytes_limit — CPU), the
+    # effective remat policy the timed model ran under, and how many OOMs
+    # the degradation ladder survived during this run (0 on a healthy fit)
+    out["memory_headroom_bytes"] = memsafe.last_headroom_bytes()
+    out["remat_policy"] = memsafe.policy_marker(model)
+    out["oom_recoveries"] = int(
+        telemetry.counter("oom_recoveries_total").value)
+    # memory/recompute tradeoff, measured not guessed: with a remat policy
+    # active (MXNET_TPU_BENCH_REMAT or the remat_policy knob), re-run the
+    # same timed loop under policy='none' and report the step-time ratio
+    out["remat_recompute_overhead"] = None
+    if out["remat_policy"] != "none":
+        try:
+            # BOTH sides measured by the same serialized-sync loop
+            # (_time_steps): comparing against the main prefetch+async
+            # timed loop would conflate remat recompute with pipeline-mode
+            # differences
+            base_dt = _time_steps(
+                mx, nd, parallel, bert_mod, cfg, batch, seq_len, masked,
+                steps, warmup, policy="none")
+            with_dt = _time_steps(
+                mx, nd, parallel, bert_mod, cfg, batch, seq_len, masked,
+                steps, warmup, policy=out["remat_policy"])
+            out["remat_recompute_overhead"] = round(with_dt / base_dt, 4)
+            print(f"# remat overhead: {out['remat_policy']} "
+                  f"{with_dt * 1e3:.1f} ms/step vs none "
+                  f"{base_dt * 1e3:.1f} ms/step = "
+                  f"{out['remat_recompute_overhead']}x", file=sys.stderr)
+        except Exception as e:  # an OOM at policy=none IS the point of remat
+            print(f"# remat overhead A/B unavailable: {e}", file=sys.stderr)
     if mfu is not None:
         # 6*N*tokens model flops, attention quadratic term EXCLUDED
         # (~9% underestimate at seq 512)
@@ -289,6 +332,33 @@ def run_bench(on_tpu):
     if not on_tpu:
         out["error"] = "tpu backend unavailable; CPU smoke-mode number"
     return out
+
+
+def _time_steps(mx, nd, parallel, bert_mod, cfg, batch, seq_len, masked,
+                steps, warmup, policy="none"):
+    """Per-step seconds for a fresh model/trainer under one remat policy —
+    the denominator of the remat_recompute_overhead ratio. Same shapes,
+    same synthetic batch, same optimizer as the main timed loop."""
+    model = bert_mod.BERTForPretraining(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    model.remat(policy)
+    trainer = parallel.ShardedTrainer(
+        model, bert_mod.bert_pretrain_loss, "lamb",
+        {"learning_rate": 1e-3, "wd": 0.01})
+    b = bert_mod.make_synthetic_batch(cfg, batch, seq_len, masked, seed=0)
+    data = [nd.array(b[k]) for k in
+            ("input_ids", "token_types", "valid_length", "masked_positions")]
+    labels = [nd.array(b[k]) for k in
+              ("mlm_labels", "mlm_weights", "nsp_labels")]
+    for _ in range(warmup):
+        loss = trainer.step(data, labels)
+    float(loss.asscalar())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(data, labels)
+    float(loss.asscalar())
+    return (time.perf_counter() - t0) / steps
 
 
 def _input_stall_fraction(telemetry):

@@ -112,14 +112,79 @@ print('inspect disabled fast path OK (no analysis calls, no records)')
 import json
 d = json.load(open('/tmp/_bench_sanity.json'))
 for k in ('mfu', 'achieved_tflops', 'peak_device_bytes',
-          'comm_bytes_per_step'):
+          'comm_bytes_per_step', 'memory_headroom_bytes',
+          'oom_recoveries'):
     assert k in d, f'bench JSON missing {k}: {sorted(d)}'
     assert d[k] is None or isinstance(d[k], (int, float)), (k, d[k])
+assert d.get('remat_policy') in ('none', 'dots_saveable', 'layers',
+                                 'full'), d.get('remat_policy')
 assert d['mfu'] is None, 'CPU run must report mfu null, not a number'
 assert d['achieved_tflops'] is None or d['achieved_tflops'] > 0
 print('bench efficiency fields OK:', {k: d[k] for k in
       ('mfu', 'achieved_tflops', 'peak_device_bytes',
        'comm_bytes_per_step')})
+"
+    # memsafe must be disabled by default (oom_recover=off): the trainer
+    # and block hot paths make zero preflight/capacity/recovery calls (one
+    # module-bool check each), no budget state accumulates, and no
+    # degradation handler runs — the zero-overhead fast path
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, memsafe
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not memsafe.enabled(), 'memsafe must default to off'
+calls = {'pre_step': 0, 'pre_jit': 0, 'cap': 0, 'recover': 0}
+real = (memsafe.preflight_step, memsafe.preflight_jit,
+        memsafe.capacity_bytes, memsafe.recover_trainer)
+memsafe.preflight_step = lambda *a, **k: (calls.__setitem__('pre_step', calls['pre_step'] + 1), real[0](*a, **k))[1]
+memsafe.preflight_jit = lambda *a, **k: (calls.__setitem__('pre_jit', calls['pre_jit'] + 1), real[1](*a, **k))[1]
+memsafe.capacity_bytes = lambda *a, **k: (calls.__setitem__('cap', calls['cap'] + 1), real[2](*a, **k))[1]
+memsafe.recover_trainer = lambda *a, **k: (calls.__setitem__('recover', calls['recover'] + 1), real[3](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+net2 = nn.Dense(4, in_units=8); net2.initialize(); net2.hybridize()
+net2(x)
+memsafe.preflight_step, memsafe.preflight_jit, memsafe.capacity_bytes, \\
+    memsafe.recover_trainer = real
+assert calls == {'pre_step': 0, 'pre_jit': 0, 'cap': 0, 'recover': 0}, calls
+assert memsafe.transitions() == [], 'disabled fast path recorded transitions'
+assert memsafe.last_check() is None, 'disabled fast path ran a budget check'
+print('memsafe disabled fast path OK (no preflight, no capacity probes)')
+"
+    # memsafe acceptance (slow-marked out of the tier-1 sweep): a config
+    # exceeding a simulated device_bytes_limit is rejected pre-dispatch
+    # and — under oom_recover=auto — degrades and trains to completion
+    # with loss parity; remat policies are loss-bit-exact; autofit bucket
+    # boundaries feed BucketPad
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_memsafe.py::test_budget_driven_recovery_trains_to_completion \
+        tests/unittest/test_memsafe.py::test_remat_policy_equivalence_bit_exact \
+        tests/unittest/test_memsafe.py::test_autofit_bucket_boundaries_feed_bucket_pad \
+        -q -p no:cacheprovider
+    # autofit smoke under a simulated capacity: the chosen batch's
+    # predicted peak fits, the next-larger candidate's does not, and no
+    # device step executed (pure AOT analysis)
+    JAX_PLATFORMS=cpu python -c "
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, 'tools/autofit.py', '--model', 'dense',
+     '--max-batch', '1024', '--device-bytes-limit', '700000'],
+    capture_output=True, text=True, timeout=240)
+assert r.returncode == 0, r.stderr[-2000:]
+d = json.loads([l for l in r.stdout.splitlines() if l.startswith('{')][0])
+assert d['predicted_bytes'] <= d['capacity_bytes'], d
+assert d['next_larger'] and \\
+    d['next_larger']['predicted_bytes'] > d['capacity_bytes'], d
+print('autofit smoke OK: batch', d['batch_size'], 'predicted',
+      d['predicted_bytes'], 'of', d['capacity_bytes'])
 "
     # resilience must be disabled by default: no signal handlers installed,
     # the trainer step hook reduces to one module-bool check (zero on_step

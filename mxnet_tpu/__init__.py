@@ -55,6 +55,7 @@ _LAZY = {
     "telemetry": ".telemetry",
     "diagnostics": ".diagnostics",
     "resilience": ".resilience",
+    "memsafe": ".memsafe",
     "inspect": ".inspect",
     "dataflow": ".dataflow",
     "parallel": ".parallel",

@@ -274,7 +274,10 @@ register_option(
     "'sigterm@step:5' (graceful-preemption path), 'kill@step:3' (rank "
     "death via SIGKILL), 'corrupt_ckpt@step:4' (flip bytes in that "
     "step's checkpoint after its manifest is written), 'stall_input:250' "
-    "(one 250ms input-pipeline stall), 'exc@step:2' (crash), "
+    "(one 250ms input-pipeline stall), 'exc@step:2' (crash), 'oom@step:3' "
+    "(synthetic RESOURCE_EXHAUSTED at the dispatch of step 3, before any "
+    "transfer/donation — drives the mx.memsafe oom_recover degradation "
+    "ladder; repeat the spec to OOM the retry too), "
     "'shrink@step:3' / 'grow@step:3' (elastic reshape request: save a "
     "final checkpoint, exit EXIT_SHRINK=84 / EXIT_GROW=85 so a "
     "tools/launch.py --elastic supervisor relaunches the gang smaller by "
@@ -329,6 +332,44 @@ register_option(
     "retry_max_backoff_s", 30.0,
     "Upper bound on a single RetryPolicy backoff sleep, whatever the "
     "attempt count.")
+register_option(
+    "device_bytes_limit", 0,
+    "Device memory capacity (bytes) the mx.memsafe pre-flight budget check "
+    "and dataflow.autofit compare predicted peaks against. 0 (default) "
+    "auto-detects from device.memory_stats()['bytes_limit'] (absent on "
+    "CPU); a positive value overrides — CPU CI and tests simulate any "
+    "capacity this way. Setting it arms memsafe at trainer construction.")
+register_option(
+    "memory_headroom_warn", 0.1,
+    "Fraction of device capacity below which the mx.memsafe pre-flight "
+    "check emits a memory-headroom warning (event + stderr, once per "
+    "executable) alongside the memory_headroom_bytes gauge. 0 disables "
+    "the warning (the hard budget check still raises on a predicted "
+    "overrun).")
+register_option(
+    "remat_policy", "", choices=("", "none", "dots_saveable", "layers",
+                                 "full"),
+    doc="Default rematerialization policy applied to every block "
+        "(mx.memsafe graduated remat; HybridBlock.remat(policy=...) "
+        "overrides per block). In increasing memory savings / recompute "
+        "cost: 'none' saves every intermediate; 'dots_saveable' "
+        "jax.checkpoint keeping matmul outputs; 'layers' per-layer "
+        "checkpointing (activation memory O(1) in depth — what the legacy "
+        "per-model remat=True flag meant); 'full' additionally "
+        "checkpoints the whole stack so only model inputs survive the "
+        "forward pass. Empty (default) defers to per-block/per-model "
+        "settings.")
+register_option(
+    "oom_recover", "off", choices=("off", "auto"),
+    doc="Out-of-memory recovery at the trainer step boundary. 'off' "
+        "(default) keeps fail-fast behavior and the zero-overhead hot "
+        "path (one module bool, no handlers — asserted by ci/run.sh "
+        "sanity). 'auto' catches RESOURCE_EXHAUSTED and pre-flight "
+        "MemoryBudgetError and walks the degradation ladder: escalate the "
+        "remat policy one rung, then halve the batch via gradient-"
+        "accumulation microbatching (loss/grad parity up to reduction "
+        "order), re-plan, retry — each transition logged to telemetry, "
+        "the flight ring, and the post-mortem 'memsafe' section.")
 register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "

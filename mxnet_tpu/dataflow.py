@@ -35,6 +35,7 @@ from __future__ import annotations
 import math
 import os
 import queue
+import sys
 import threading
 import time
 
@@ -45,7 +46,7 @@ from . import resilience as _resilience
 from . import telemetry as _telemetry
 
 __all__ = ["prefetch_to_mesh", "MeshPrefetcher", "BucketPad",
-           "ensure_compile_cache"]
+           "ensure_compile_cache", "autofit", "AutofitResult"]
 
 _M_DEPTH = _telemetry.gauge(
     "dataloader_prefetch_depth", "batches buffered ahead of the consumer "
@@ -446,6 +447,200 @@ class BucketPad:
         """Generator applying the pad to every batch of `iterator`."""
         for batch in iterator:
             yield self(batch)
+
+
+# ---------------------------------------------------------------------------
+# auto-fit: the largest batch/bucket configuration that fits the device
+# ---------------------------------------------------------------------------
+
+
+class AutofitResult:
+    """What `autofit` chose and how it got there.
+
+    Fields: `batch_size` (largest fitting global batch), `predicted_bytes`
+    / `exec_peak_bytes` / `resident_bytes` (the chosen config's plan),
+    `capacity_bytes`, `headroom_bytes`, `buckets` (the BucketPad
+    boundaries that fit at the chosen batch, when bucket lengths were
+    probed), `next_larger` ({"batch_size", "predicted_bytes"} of the
+    smallest probed config that did NOT fit — None when the search was
+    capped by max_batch), and `probes` (every AOT plan, in probe order).
+    `bucket_pad(**kwargs)` builds the matching BucketPad; feed
+    `batch_size` straight into the data pipeline and train."""
+
+    def __init__(self, batch_size, plan, capacity_bytes, probes,
+                 buckets=None, next_larger=None):
+        self.batch_size = batch_size
+        self.predicted_bytes = plan["predicted_bytes"]
+        self.exec_peak_bytes = plan["exec_peak_bytes"]
+        self.resident_bytes = plan["resident_bytes"]
+        self.capacity_bytes = capacity_bytes
+        self.headroom_bytes = capacity_bytes - plan["predicted_bytes"]
+        self.buckets = list(buckets) if buckets is not None else None
+        self.next_larger = next_larger
+        self.probes = list(probes)
+
+    def bucket_pad(self, axis=1, **kwargs):
+        """A BucketPad over the bucket boundaries that fit (only when
+        autofit probed buckets)."""
+        if not self.buckets:
+            raise ValueError("autofit ran without bucket candidates — "
+                             "pass buckets=[...] to probe them")
+        return BucketPad(axis_buckets={axis: list(self.buckets)}, **kwargs)
+
+    def as_dict(self):
+        return {
+            "batch_size": self.batch_size,
+            "predicted_bytes": self.predicted_bytes,
+            "exec_peak_bytes": self.exec_peak_bytes,
+            "resident_bytes": self.resident_bytes,
+            "capacity_bytes": self.capacity_bytes,
+            "headroom_bytes": self.headroom_bytes,
+            "buckets": self.buckets,
+            "next_larger": self.next_larger,
+            "probes": self.probes,
+        }
+
+    def __repr__(self):
+        extra = f", buckets={self.buckets}" if self.buckets else ""
+        return (f"AutofitResult(batch_size={self.batch_size}, "
+                f"predicted={self.predicted_bytes}, "
+                f"capacity={self.capacity_bytes}{extra})")
+
+
+def autofit(trainer, make_batch, max_batch=1024, capacity=None,
+            buckets=None, multiple_of=None, verbose=True):
+    """Binary-search the largest batch size (and optionally the BucketPad
+    bucket boundaries) whose PREDICTED train-step peak fits the device —
+    AOT lowering + XLA memory_analysis only, no device step executes and
+    no batch transfers (mx.memsafe, "Memory Safe Computations with XLA").
+
+    `make_batch(batch_size)` (or `make_batch(batch_size, seq_len)` when
+    `buckets` is given) returns one `(data, labels)` host batch — numpy /
+    NDArray; only shapes and dtypes are read. Candidates are multiples of
+    `multiple_of` (default: the mesh's data-axis extent, so every probe
+    shards evenly). `capacity` defaults to mx.memsafe.capacity_bytes()
+    (the `device_bytes_limit` knob, else device memory_stats). When
+    `buckets` (sequence lengths) is given, the batch search runs at the
+    LARGEST bucket and each bucket is then verified at the chosen batch —
+    the result's `.bucket_pad()` keeps exactly the fitting boundaries.
+
+    Returns an AutofitResult; raises MemoryBudgetError when even the
+    smallest candidate does not fit (carrying that candidate's plan)."""
+    from . import memsafe as _memsafe
+
+    cap = capacity if capacity is not None else _memsafe.capacity_bytes()
+    if not cap:
+        raise ValueError(
+            "autofit needs a device capacity: set the device_bytes_limit "
+            "knob (simulated capacity), pass capacity=, or run on a "
+            "backend whose device.memory_stats() reports bytes_limit")
+    cap = int(cap)
+    m = int(multiple_of) if multiple_of else _data_axis_extent(trainer)
+    k_max = max(1, int(max_batch) // m)
+    probes = []
+
+    def plan(batch_size, seq_len=None):
+        batch = make_batch(batch_size) if seq_len is None \
+            else make_batch(batch_size, seq_len)
+        data, labels = batch
+        info = trainer.predict_step_bytes(data, labels)
+        # capacity/headroom/fits re-derived against THE SEARCH capacity
+        # (the caller's capacity= may differ from the memsafe-global one
+        # predict_step_bytes consulted) so every probe record is
+        # internally consistent
+        info = dict(info, batch_size=batch_size, seq_len=seq_len,
+                    capacity_bytes=cap,
+                    headroom_bytes=cap - info["predicted_bytes"],
+                    fits=info["predicted_bytes"] <= cap)
+        probes.append(info)
+        if verbose:
+            print(f"mx.dataflow.autofit: batch {batch_size}"
+                  + (f" seq {seq_len}" if seq_len is not None else "")
+                  + f" -> predicted {info['predicted_bytes']} bytes "
+                  f"({'fits' if info['fits'] else 'over'} capacity {cap})",
+                  file=sys.stderr)
+        return info
+
+    # anchor the batch search at the LARGEST bucket that fits at the
+    # minimum batch; buckets too big for even that are dropped (logged),
+    # not fatal — only when NOTHING fits does autofit raise
+    dropped = []
+    top_seq = None
+    lo_info = None
+    for cand in (sorted((int(b) for b in buckets), reverse=True)
+                 if buckets else [None]):
+        lo_info = plan(m, cand)
+        if lo_info["fits"]:
+            top_seq = cand
+            break
+        dropped.append(cand)
+    if lo_info is None or not lo_info["fits"]:
+        raise _memsafe.MemoryBudgetError(
+            f"autofit(batch={m})", lo_info["predicted_bytes"], cap,
+            exec_peak_bytes=lo_info["exec_peak_bytes"],
+            resident_bytes=lo_info["resident_bytes"])
+    if dropped and verbose:
+        print(f"mx.dataflow.autofit: bucket(s) {sorted(dropped)} exceed "
+              f"capacity even at batch {m} — dropped", file=sys.stderr)
+    # largest fitting k in [1, k_max]: invariant fits(lo), not fits(hi)
+    lo, hi = 1, None
+    best = lo_info
+    next_larger = None
+    if k_max > 1:
+        hi_info = plan(k_max * m, top_seq)
+        if hi_info["fits"]:
+            lo, best = k_max, hi_info
+        else:
+            hi = k_max
+            next_larger = hi_info
+            while hi - lo > 1:
+                mid = (lo + hi) // 2
+                info = plan(mid * m, top_seq)
+                if info["fits"]:
+                    lo, best = mid, info
+                else:
+                    hi, next_larger = mid, info
+    chosen = lo * m
+    fitting_buckets = None
+    if buckets:
+        fitting_buckets = []
+        for L in sorted(int(b) for b in buckets):
+            if L in dropped:
+                continue
+            if L == top_seq:
+                # already planned: the batch search ran at this bucket
+                fitting_buckets.append(L)
+                continue
+            if plan(chosen, L)["fits"]:
+                fitting_buckets.append(L)
+    nl = None
+    if next_larger is not None:
+        nl = {"batch_size": next_larger["batch_size"],
+              "predicted_bytes": next_larger["predicted_bytes"]}
+    result = AutofitResult(chosen, best, cap, probes,
+                           buckets=fitting_buckets, next_larger=nl)
+    if verbose:
+        print(f"mx.dataflow.autofit: chose batch {chosen} "
+              f"(predicted {result.predicted_bytes} of {cap} bytes, "
+              f"headroom {result.headroom_bytes})"
+              + (f", buckets {fitting_buckets}" if buckets else "")
+              + (f"; batch {nl['batch_size']} would NOT fit "
+                 f"({nl['predicted_bytes']} bytes)" if nl else
+                 "; search capped at max_batch"),
+              file=sys.stderr)
+    return result
+
+
+def _data_axis_extent(trainer):
+    """Devices the batch axis shards over (dp*fsdp), so autofit probes
+    only evenly-sharding batch sizes; 1 when the trainer has no mesh."""
+    mesh = getattr(trainer, "mesh", None)
+    if mesh is None:
+        return 1
+    try:
+        return int(mesh.shape.get("dp", 1)) * int(mesh.shape.get("fsdp", 1))
+    except Exception:
+        return 1
 
 
 # ---------------------------------------------------------------------------

@@ -594,6 +594,18 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
     except Exception as e:
         pm["resume"] = {"error": str(e)}
     try:
+        # memory-safety story (mx.memsafe — via sys.modules so a run that
+        # never touched it pays no import): the last pre-flight budget
+        # check, every degradation-ladder transition, and the OOM count —
+        # an OOM post-mortem then shows what was predicted and what the
+        # ladder already traded away
+        _ms = sys.modules.get(__package__ + ".memsafe")
+        if _ms is not None and (_ms._transitions or _ms._last_check
+                                or _ms._oom_events):
+            pm["memsafe"] = _ms.snapshot()
+    except Exception as e:
+        pm["memsafe"] = {"error": str(e)}
+    try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:
         pm["profiler_tail"] = []

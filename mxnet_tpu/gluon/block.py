@@ -26,6 +26,7 @@ import numpy as np
 from .. import _engine
 from .. import diagnostics as _diagnostics
 from .. import inspect as _inspect
+from .. import memsafe as _memsafe
 from .. import ndarray as nd_mod
 from .. import random as _random
 from .. import telemetry as _telemetry
@@ -225,11 +226,47 @@ class Block:
 class HybridBlock(Block):
     """Block that can be compiled to one XLA computation per input signature."""
 
+    #: blocks that consume remat policies STRUCTURALLY (per-layer / scan-body
+    #: jax.checkpoint — models.BERTModel / models.GPTModel) set this True;
+    #: remat() then routes the policy to them instead of wrapping the whole
+    #: pure function
+    _remat_handles_policy = False
+
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix, params)
         self._active = False
         self._cache = {}
         self._tele_sig = None     # last compiled input signature (telemetry)
+
+    def remat(self, policy="layers"):
+        """Set this block tree's rematerialization policy (mx.memsafe
+        graduated remat): "none" | "dots_saveable" | "layers" | "full",
+        in increasing memory savings / recompute cost, mapped onto
+        jax.checkpoint. Blocks with structural layer handling (BERTModel,
+        GPTModel) checkpoint per layer / per scan body; any other block
+        gets the policy applied around its whole compiled function.
+        Replaces the ad-hoc per-model `remat=` boolean (which keeps
+        working as the "layers" alias). Clears compiled caches so the
+        next call re-traces under the new policy. Returns self."""
+        _memsafe.validate_policy(policy)
+        self._propagate_remat(policy)
+        self._remat_policy = policy
+        # bumped on every policy change: a ShardedTrainer keys its step
+        # cache on this, so remat() mid-run re-jits there too (clearing
+        # our own _cache cannot reach the trainer's executables)
+        self._remat_epoch = getattr(self, "_remat_epoch", 0) + 1
+        self._clear_cache()
+        return self
+
+    def _propagate_remat(self, policy):
+        handled = False
+        if type(self)._remat_handles_policy:
+            self._remat_policy = policy
+            handled = True
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                handled = child._propagate_remat(policy) or handled
+        return handled
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False, **kwargs):
         self._active = active
@@ -312,6 +349,26 @@ class HybridBlock(Block):
         in_data = [a._data for a in args]
         rng = _random.next_key()
 
+        prefl = None
+        if is_miss and _memsafe._enabled and not any(
+                isinstance(d, jax.core.Tracer) for d in in_data):
+            # pre-flight budget check BEFORE the first dispatch: AOT
+            # lower+compile (warm via compile_cache_dir for the real call
+            # below) and compare predicted peak + resident params/inputs
+            # against device capacity — a predicted overrun raises
+            # MemoryBudgetError here, with nothing dispatched. Child
+            # blocks compiling inside a parent trace (tracer inputs) are
+            # the parent executable's problem, not a budget of their own
+            try:
+                prefl = _memsafe.preflight_jit(
+                    type(self).__name__, key, jitted,
+                    (gp_data, aux_data, rng) + tuple(in_data))
+            except _memsafe.MemoryBudgetError:
+                # a rejected executable must not stay cached: a retried
+                # call would hit the cache and dispatch past the check
+                self._cache.pop(key, None)
+                raise
+
         # the first call of a fresh entry triggers XLA's lazy compile, so
         # the compile-time measurement must bracket it
         out_flat, new_aux = jitted(gp_data, aux_data, rng, *in_data)
@@ -330,7 +387,9 @@ class HybridBlock(Block):
                     shapes=[list(a.shape) for a in args])
         elif _telemetry._enabled and not is_miss:
             _M_CACHE_HITS.inc()
-        if is_miss and _inspect._enabled and not any(
+        if is_miss and _inspect._enabled \
+                and not (prefl and prefl.get("inspect_recorded")) \
+                and not any(
                 isinstance(d, jax.core.Tracer) for d in in_data):
             # cost attribution for the freshly built executable: one extra
             # lower+compile at the same signature. Runs AFTER the measured
@@ -406,7 +465,7 @@ def _make_pure_fn(block, grad_params, aux_params, train):
     CachedOp also feeds pjit over a device mesh."""
     treedef_box = {}
 
-    def pure(gp_data, aux_data, rng, *in_data):
+    def run(gp_data, aux_data, rng, *in_data):
         saved = []
         for (_, p), d in list(zip(grad_params, gp_data)) + list(zip(aux_params, aux_data)):
             saved.append((p, p._data._data))
@@ -428,6 +487,18 @@ def _make_pure_fn(block, grad_params, aux_params, train):
         out_data = [o._data if isinstance(o, NDArray) else jnp.asarray(o)
                     for o in out_flat]
         return out_data, new_aux
+
+    def pure(gp_data, aux_data, rng, *in_data):
+        # graduated remat for blocks WITHOUT structural layer handling:
+        # the whole functionalized forward under jax.checkpoint — the
+        # backward (ShardedTrainer grad, autograd record_fn) recomputes
+        # per the policy. Resolved at trace time so remat()/knob changes
+        # take effect on the next (cache-cleared) compile.
+        policy = _memsafe.block_wrap_policy(block)
+        if policy is None:
+            return run(gp_data, aux_data, rng, *in_data)
+        wrapped = jax.checkpoint(run, policy=_memsafe.jax_policy(policy))
+        return wrapped(gp_data, aux_data, rng, *in_data)
 
     return pure, treedef_box
 

@@ -14,6 +14,7 @@ import time
 
 from .. import config as _config
 from .. import diagnostics as _diagnostics
+from .. import memsafe as _memsafe
 from .. import optimizer as opt_mod
 from .. import telemetry as _telemetry
 from ..ndarray import NDArray
@@ -50,6 +51,8 @@ class Trainer:
         self._states_created = False
         self._kvstore_type = kvstore
         self._num_update = 0
+        # arm mx.memsafe iff its knobs ask — construction-time reads only
+        _memsafe.maybe_enable()
 
     @property
     def optimizer(self):
@@ -74,11 +77,23 @@ class Trainer:
         if _telemetry._enabled:
             t0 = time.perf_counter()
             try:
-                self._step_impl(batch_size, ignore_stale_grad)
+                self._step_guarded(batch_size, ignore_stale_grad)
             finally:
                 _M_STEP_SECONDS.observe(time.perf_counter() - t0)
             return
-        self._step_impl(batch_size, ignore_stale_grad)
+        self._step_guarded(batch_size, ignore_stale_grad)
+
+    def _step_guarded(self, batch_size, ignore_stale_grad):
+        try:
+            self._step_impl(batch_size, ignore_stale_grad)
+        except Exception as e:  # noqa: BLE001 — classified below
+            # mx.memsafe: the eager path cannot degrade a step whose tape
+            # already ran, but an OOM here still counts oom_events_total
+            # and the error gains the remediation story. Disabled
+            # (default): one module-bool read on an already-failing path
+            if _memsafe._enabled and _memsafe.is_oom(e):
+                _memsafe.note_eager_oom(e, step=self._num_update)
+            raise
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         self._num_update += 1

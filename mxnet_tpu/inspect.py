@@ -58,7 +58,7 @@ from . import telemetry as _telemetry
 __all__ = [
     "enable", "disable", "enabled", "reset",
     "CostRecord", "analyze_jit", "record_compiled", "note_step",
-    "records", "get", "snapshot", "summary", "dump",
+    "records", "get", "snapshot", "summary", "dump", "memory_breakdown",
     "peak_flops_per_chip", "peak_bandwidth_per_chip",
     "estimate_collectives", "key_repr",
 ]
@@ -324,6 +324,24 @@ def _get_record(name, key):
         return rec
 
 
+def memory_breakdown(mem):
+    """(argument, output, temp, alias, peak) bytes from one
+    CompiledMemoryStats — peak is the derived execution-time resident
+    estimate (args + outputs + temps - donated), None when any component
+    is missing. Shared with mx.memsafe so the pre-flight budget check and
+    this registry can never account differently."""
+    if mem is None:
+        return None, None, None, None, None
+    arg = getattr(mem, "argument_size_in_bytes", None)
+    out = getattr(mem, "output_size_in_bytes", None)
+    tmp = getattr(mem, "temp_size_in_bytes", None)
+    alias = getattr(mem, "alias_size_in_bytes", None)
+    peak = None
+    if None not in (arg, out, tmp):
+        peak = arg + out + tmp - (alias or 0)
+    return arg, out, tmp, alias, peak
+
+
 def _first_dict(analysis):
     """cost_analysis() returns a dict on newer jax, a list of per-module
     dicts on older; normalize to the entry computation's dict ({} when
@@ -361,18 +379,15 @@ def record_compiled(name, key, compiled, collectives=None):
         if "bytes accessed" in cost:
             rec.bytes_accessed = float(cost["bytes accessed"])
         if mem is not None:
-            arg = getattr(mem, "argument_size_in_bytes", None)
-            out = getattr(mem, "output_size_in_bytes", None)
-            tmp = getattr(mem, "temp_size_in_bytes", None)
-            alias = getattr(mem, "alias_size_in_bytes", None)
+            arg, out, tmp, alias, peak = memory_breakdown(mem)
             rec.argument_bytes = arg
             rec.output_bytes = out
             rec.temp_bytes = tmp
             rec.donated_bytes = alias
             rec.generated_code_bytes = getattr(
                 mem, "generated_code_size_in_bytes", None)
-            if None not in (arg, out, tmp):
-                rec.peak_bytes = arg + out + tmp - (alias or 0)
+            if peak is not None:
+                rec.peak_bytes = peak
         if collectives:
             rec.collectives = dict(collectives)
         if errors:

@@ -124,22 +124,59 @@ class BERTEncoderLayer(HybridBlock):
         return self.ffn_ln(x + h)
 
 
-def _remat_call(layer, x, mask):
+def _remat_call(layer, x, mask, policy="layers"):
     """Apply one encoder layer under jax.checkpoint: the backward pass
     recomputes the layer's internals from its (x, mask) boundary instead of
     stashing every intermediate. Layer parameters ride in as closure
-    constants (under functional_call they are the substituted tracers)."""
+    constants (under functional_call they are the substituted tracers).
+    `policy` picks WHAT survives inside the layer (mx.memsafe graduated
+    remat): "layers"/"full" save nothing, "dots_saveable" keeps matmul
+    outputs so only the cheap elementwise work recomputes."""
     import jax
+
+    from .. import memsafe as _memsafe
 
     def f(xd, *md):
         out = layer(NDArray(xd), NDArray(md[0]) if md else None)
         return out._data
 
     args = (x._data,) + (() if mask is None else (mask._data,))
+    return NDArray(
+        jax.checkpoint(f, policy=_memsafe.jax_policy(policy))(*args))
+
+
+def _full_remat_stack(layers, x, mask):
+    """policy='full', unrolled path: per-layer checkpoints INSIDE one
+    checkpoint around the whole stack — only the stack's (x, mask) inputs
+    survive the forward pass; backward re-runs the stack (itself
+    re-checkpointed per layer, so the recompute stays O(1) in depth)."""
+    import jax
+
+    def f(xd, *md):
+        out = NDArray(xd)
+        m = NDArray(md[0]) if md else None
+        for layer in layers:
+            out = _remat_call(layer, out, m, "full")
+        return out._data
+
+    args = (x._data,) + (() if mask is None else (mask._data,))
     return NDArray(jax.checkpoint(f)(*args))
 
 
-def _scan_layers_call(layers, x, mask, use_remat):
+def _stack_call(layers, x, mask, policy):
+    """Apply an encoder stack unrolled, under one remat policy (mx.memsafe:
+    "none" | "dots_saveable" | "layers" | "full")."""
+    if policy == "full":
+        return _full_remat_stack(layers, x, mask)
+    for layer in layers:
+        if policy != "none":
+            x = _remat_call(layer, x, mask, policy)
+        else:
+            x = layer(x, mask)
+    return x
+
+
+def _scan_layers_call(layers, x, mask, policy):
     """Apply an identical-structure encoder stack as ONE `lax.scan` over
     stacked per-layer parameters: the layer body is traced and compiled
     once instead of `num_layers` times.  This is what makes BERT-large
@@ -159,13 +196,21 @@ def _scan_layers_call(layers, x, mask, use_remat):
     dropout masks.  Each iteration therefore enters a fresh `key_scope`
     folding the layer index into one base key.
 
-    With `use_remat` the body is wrapped in `jax.checkpoint`: activation
-    memory stays O(1) in depth and the backward recomputes per layer —
-    the canonical scan-over-remat pairing."""
+    `policy` (mx.memsafe graduated remat) wraps the body in
+    `jax.checkpoint`: "layers" saves only the carry between iterations
+    (activation memory O(1) in depth — the canonical scan-over-remat
+    pairing), "dots_saveable" additionally keeps matmul outputs inside
+    the body, and "full" puts one more checkpoint around the whole scan
+    so only the stack inputs survive the forward pass."""
     import jax
     import jax.numpy as jnp
 
+    from .. import memsafe as _memsafe
     from .. import random as _random
+
+    if not isinstance(policy, str):
+        # legacy use_remat boolean callers
+        policy = "layers" if policy else "none"
 
     layer0 = layers[0]
     gp0, aux0 = layer0._param_lists()
@@ -197,11 +242,17 @@ def _scan_layers_call(layers, x, mask, use_remat):
                 p._data._data = d
         return out._data, None
 
-    if use_remat:
-        body = jax.checkpoint(body)
-    xs = (jnp.arange(len(layers)),) + tuple(stacked)
-    y, _ = jax.lax.scan(body, x._data, xs)
-    return NDArray(y)
+    if policy != "none":
+        body = jax.checkpoint(body, policy=_memsafe.jax_policy(policy))
+
+    def run_scan(x_d, *stk):
+        xs = (jnp.arange(len(layers)),) + tuple(stk)
+        y, _ = jax.lax.scan(body, x_d, xs)
+        return y
+
+    if policy == "full":
+        run_scan = jax.checkpoint(run_scan)
+    return NDArray(run_scan(x._data, *stacked))
 
 
 def _positions(position_embed, L, sp_manual):
@@ -228,6 +279,12 @@ def _positions(position_embed, L, sp_manual):
 
 class BERTModel(HybridBlock):
     """Embeddings + encoder stack + pooler (reference: gluonnlp BERTModel)."""
+
+    # remat policies route here (HybridBlock.remat / the remat_policy
+    # knob): the encoder stack checkpoints per layer / per scan body
+    # instead of wrapping the whole block (mx.memsafe graduated remat).
+    # The legacy `remat=True` config flag stays the "layers" alias.
+    _remat_handles_policy = True
 
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
                  max_length=512, type_vocab_size=2, dropout=0.1,
@@ -289,18 +346,18 @@ class BERTModel(HybridBlock):
             from ..parallel import specs as _sp
             x = apply_op(_sp.constrain_seq, x)
         from .. import _engine
+        from .. import memsafe as _memsafe
         # remat only where it means something: inside a jit trace (the
         # eager tape stores activations per-op; jax.checkpoint there would
         # just break recording)
-        use_remat = self._remat and not _engine.is_recording()
+        policy = _memsafe.effective_policy(
+            getattr(self, "_remat_policy", None), self._remat)
+        if _engine.is_recording():
+            policy = "none"
         if self._scan_layers and not _engine.is_recording():
-            x = _scan_layers_call(list(self.layers), x, mask, use_remat)
+            x = _scan_layers_call(list(self.layers), x, mask, policy)
         else:
-            for layer in self.layers:
-                if use_remat:
-                    x = _remat_call(layer, x, mask)
-                else:
-                    x = layer(x, mask)
+            x = _stack_call(list(self.layers), x, mask, policy)
         # pin the encoder output (and via transpose its cotangent) to batch
         # sharding: the MLM gather and pooler-slice backward paths otherwise
         # propagate conflicting feature shardings from fsdp-sharded head

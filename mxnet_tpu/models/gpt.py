@@ -154,6 +154,11 @@ class GPTModel(HybridBlock):
     """Token+position embeddings -> pre-LN block stack -> final LN.
     Returns hidden states (B, L, E)."""
 
+    # remat policies route here (see BERTModel): per-layer / scan-body
+    # checkpointing per the mx.memsafe graduated policy; the legacy
+    # `remat=True` config flag stays the "layers" alias
+    _remat_handles_policy = True
+
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
                  max_length=1024, dropout=0.1, attn_dropout=0.0,
                  seq_parallel=False, dtype="float32", remat=False,
@@ -200,16 +205,16 @@ class GPTModel(HybridBlock):
             from ..parallel import specs as _sp
             x = apply_op(_sp.constrain_seq, x)
         from .. import _engine
-        use_remat = self._remat and not _engine.is_recording()
+        from .. import memsafe as _memsafe
+        policy = _memsafe.effective_policy(
+            getattr(self, "_remat_policy", None), self._remat)
+        if _engine.is_recording():
+            policy = "none"
         if self._scan_layers and not _engine.is_recording():
-            x = _scan_layers_call(list(self.layers), x, mask, use_remat)
+            x = _scan_layers_call(list(self.layers), x, mask, policy)
         else:
-            from .bert import _remat_call
-            for layer in self.layers:
-                if use_remat:
-                    x = _remat_call(layer, x, mask)
-                else:
-                    x = layer(x, mask)
+            from .bert import _stack_call
+            x = _stack_call(list(self.layers), x, mask, policy)
         # pin to batch sharding before the tied-embedding head: same
         # rationale as BERTModel — the head matmul against fsdp-sharded
         # word_embed weights otherwise propagates conflicting feature

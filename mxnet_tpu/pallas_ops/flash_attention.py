@@ -61,6 +61,12 @@ try:  # pallas import is deferred so CPU-only environments still import us
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
+    # jax 0.4.x spells it TPUCompilerParams; newer jax renamed it to
+    # CompilerParams. A module-LOCAL alias keeps the kernels on the new
+    # name without mutating jax's namespace (other libraries in the same
+    # process may feature-detect the rename via hasattr).
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
@@ -205,7 +211,7 @@ def _flash_fwd_pallas(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
             jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, 8, Lq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(qr, kr, vr, bias8, seed)
@@ -344,7 +350,7 @@ def _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g, causal, sm_scale,
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(qr, kr, vr, bias8, gr, lse, delta8, seed)
@@ -372,7 +378,7 @@ def _flash_bwd_pallas(q, k, v, bias, seed, out, lse, g, causal, sm_scale,
             jax.ShapeDtypeStruct((B * H, Lk, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, Lk, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(qr, kr, vr, bias8, gr, lse, delta8, seed)

@@ -13,6 +13,7 @@ Gluon blocks plug in unchanged via `gluon.functional_call`.
 """
 from __future__ import annotations
 
+import math
 import time
 
 import jax
@@ -23,6 +24,7 @@ from .. import _engine
 from .. import config as _config
 from .. import diagnostics as _diagnostics
 from .. import inspect as _inspect
+from .. import memsafe as _memsafe
 from .. import resilience as _resilience
 from .. import telemetry as _telemetry
 from ..gluon.block import functional_call
@@ -88,6 +90,15 @@ class ShardedTrainer:
         self._tele_sig = None
         self._tele_reduce_bytes = 0
         self._coll_est = {}
+        # gradient-accumulation factor (mx.memsafe degradation ladder /
+        # set_grad_accum): the jitted step splits the global batch into
+        # this many microbatches, accumulating grads — loss/grad parity
+        # with the full batch up to reduction order
+        self._accum = 1
+        # arm memsafe iff its knobs ask (oom_recover=auto /
+        # device_bytes_limit): construction-time config reads only — the
+        # step hot path keeps its single module-bool check
+        _memsafe.maybe_enable()
         # persistent XLA compilation cache (compile_cache_dir knob): wired
         # once, at first trainer construction, before anything compiles
         from .. import dataflow as _dataflow
@@ -204,6 +215,14 @@ class ShardedTrainer:
         fopt = self.fopt
         fused = self._fused
         fl = self._fl if fused else None
+        accum = int(self._accum)
+        if accum > 1:
+            for shape in batch_shapes:
+                if not shape or shape[0] % accum:
+                    raise ValueError(
+                        f"grad accumulation x{accum}: every batch/label "
+                        f"array needs a leading dim divisible by {accum}, "
+                        f"got shape {shape}")
         # re-snapshotted per build: a constant-lr schedule bakes the
         # CURRENT o.lr into the executable (the step-cache key carries the
         # value, so set_learning_rate costs one warm re-jit, not a
@@ -223,17 +242,48 @@ class ShardedTrainer:
                 lr = lr_fn(tf)
             data, labels = batch[:n_data], batch[n_data:]
 
-            def loss_of(ps):
+            def loss_of(ps, aux_in, data, labels, rng):
                 if fused:
                     # per-tensor model-dtype views of the flat f32 master;
                     # the vjp of this unflatten returns the gradient FLAT
                     ps = fl.unflatten(ps)
-                outs, new_aux = fn(ps, aux, rng, *data)
+                outs, new_aux = fn(ps, aux_in, rng, *data)
                 loss = call_loss(loss_fn, rng, outs, labels)
-                return loss, (outs, new_aux)
+                return loss, new_aux
 
-            (loss, (outs, new_aux)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            if accum <= 1:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, aux, data, labels, rng)
+            else:
+                # gradient-accumulation microbatching (mx.memsafe
+                # degradation ladder): lax.scan over `accum` equal slices
+                # of the batch, summing grads — activation memory is one
+                # microbatch's, and mean-of-means == full-batch mean for
+                # equal chunks, so loss/grad match the unsplit step up to
+                # reduction order. Each microbatch folds its index into
+                # the step rng so dropout draws stay distinct, and aux
+                # state (BatchNorm running stats) CHAINS through the scan
+                # carry so every microbatch's update lands, not just the
+                # last one's.
+                split = [b.reshape((accum, b.shape[0] // accum)
+                                   + b.shape[1:]) for b in batch]
+
+                def micro(carry, xs):
+                    g_acc, l_acc, aux_c = carry
+                    i, mb = xs[0], list(xs[1:])
+                    (l, na), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(
+                            params, aux_c, mb[:n_data], mb[n_data:],
+                            jax.random.fold_in(rng, i))
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, l_acc + l, na), None
+
+                g0 = jax.tree.map(jnp.zeros_like, params)
+                (g_sum, l_sum, new_aux), _ = jax.lax.scan(
+                    micro, (g0, jnp.zeros((), jnp.float32), list(aux)),
+                    (jnp.arange(accum),) + tuple(split))
+                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                loss = l_sum / accum
             if fused:
                 new_params, new_m, new_v = fl.apply_flat(
                     params, grads, opt_state[0], opt_state[1], tf, lr)
@@ -293,6 +343,22 @@ class ShardedTrainer:
         bookkeeping sits between consecutive device steps."""
         return self._step_impl(data, labels, 0)
 
+    def set_grad_accum(self, accum):
+        """Set the gradient-accumulation factor: the jitted step splits
+        the global batch into `accum` equal microbatches (lax.scan),
+        accumulating gradients, so activation memory scales with the
+        MICRObatch while loss/grads match the unsplit step up to
+        reduction order. Every batch/label leading dim must divide by
+        `accum` (validated at the next build). The mx.memsafe
+        oom_recover=auto ladder drives this automatically."""
+        accum = int(accum)
+        if accum < 1:
+            raise ValueError(f"grad accumulation factor must be >= 1, "
+                             f"got {accum}")
+        self._accum = accum
+        self._step_cache.clear()
+        return self
+
     def _lr_cache_key(self):
         """The step-cache component for everything the in-jit lr bakes
         into the executable: None when lr is a traced argument (host
@@ -313,6 +379,20 @@ class ShardedTrainer:
             if isinstance(v, (int, float, str, list, tuple)))
 
     def _step_impl(self, data, labels, fence_every):
+        try:
+            return self._step_once(data, labels, fence_every)
+        except Exception as e:  # noqa: BLE001 — classified below
+            # mx.memsafe graceful OOM degradation: RESOURCE_EXHAUSTED and
+            # pre-flight MemoryBudgetError walk the ladder under
+            # oom_recover=auto. Disabled (default): one module-bool read
+            # on an already-failing path, then re-raise — nothing on the
+            # success hot path at all (zero-cost try in py3.11+)
+            if not _memsafe._enabled or not _memsafe.is_oom(e):
+                raise
+            return _memsafe.recover_trainer(self, e, data, labels,
+                                            fence_every)
+
+    def _step_once(self, data, labels, fence_every):
         data = data if isinstance(data, (list, tuple)) else [data]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         if not self._ready:
@@ -326,7 +406,16 @@ class ShardedTrainer:
         batch = [b._data if isinstance(b, NDArray) else jnp.asarray(b)
                  for b in list(data) + list(labels)]
         shapes = tuple(b.shape for b in batch)
-        key = (len(data), len(labels), shapes, self._lr_cache_key())
+        # memsafe extras in the key: the grad-accum factor, the block's
+        # remat epoch (bumped by every remat() call — one int attr read,
+        # so a mid-run policy change re-jits with memsafe off too), and
+        # (enabled only — the disabled path adds no block walk) the
+        # effective policy string, so a ladder escalation or a knob-driven
+        # default change can never reuse the pre-escalation executable
+        pol = _memsafe.policy_marker(self.block) if _memsafe._enabled \
+            else None
+        key = (len(data), len(labels), shapes, self._lr_cache_key(),
+               self._accum, getattr(self.block, "_remat_epoch", 0), pol)
         is_miss = key not in self._step_cache
         # per-step config read (sub-µs vs a ms-scale step) so
         # mx.config.set("nan_sentinel", ...) takes effect mid-run
@@ -336,17 +425,31 @@ class ShardedTrainer:
         t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
+        if is_miss:
+            # entries from a previous remat epoch are dead for EVERY shape
+            # (remat() bumped the epoch exactly so they never run again):
+            # evict them or each mid-run policy change leaks one compiled
+            # executable per cached shape
+            for k in [k for k in self._step_cache if k[5] != key[5]]:
+                del self._step_cache[k]
         if is_miss and key[3] is not None:
             # in-jit-lr executables are keyed on the schedule's values:
             # evict the stale entry so set_learning_rate / scheduler-edit
             # loops don't accumulate one dead executable per value
             for k in [k for k in self._step_cache
-                      if k[:3] == key[:3] and k[3] != key[3]]:
+                      if k[:3] == key[:3] and k[4:] == key[4:]
+                      and k[3] != key[3]]:
                 del self._step_cache[k]
         # committed only AFTER the jitted call returns, so a trace-time
         # error or failed dispatch can't desync the host counter from the
         # device-resident _t_dev (which only advances on a completed call)
         step_no = self.num_update + 1
+        if _resilience._enabled:
+            # the `oom@step:N` injection fires here — BEFORE any transfer
+            # or dispatch, like a pre-flight rejection, so the donated
+            # train state is intact and every degradation-ladder rung is
+            # drivable in tests
+            _resilience.fault_point("dispatch", step=step_no)
         scalars = ()
         lr_host = None
         if not self._lr_inside:
@@ -375,8 +478,28 @@ class ShardedTrainer:
             _diagnostics._scope_begin(
                 "sharded_step(psum)" if self._tele_reduce_bytes
                 else "sharded_step(dispatch)", step_no)
+        prefl = None
         try:
             rngk = _random.next_key()
+            if is_miss and _memsafe._enabled:
+                # pre-flight budget check for the fresh executable, BEFORE
+                # its first dispatch: AOT lower+compile (warm via
+                # compile_cache_dir for the lazy first call below) and
+                # compare execution peak + resident state/batch against
+                # device capacity. A predicted overrun raises
+                # MemoryBudgetError with everything intact — the
+                # oom_recover=auto ladder (or the caller) re-plans
+                try:
+                    prefl = _memsafe.preflight_step(
+                        self, key, self._step_cache[key],
+                        (self.params, self.aux, self.opt_state,
+                         self._t_dev) + scalars + (rngk,) + tuple(batch))
+                except _memsafe.MemoryBudgetError:
+                    # a rejected executable must not stay cached: a
+                    # retried same-shape call would hit the cache and
+                    # dispatch past the check
+                    del self._step_cache[key]
+                    raise
             with jax.profiler.StepTraceAnnotation("train_step",
                                                   step_num=step_no):
                 loss, self.params, self.aux, self.opt_state, self._t_dev = \
@@ -410,9 +533,14 @@ class ShardedTrainer:
                 if _inspect._enabled:
                     # LAST observer: the miss-path analysis lower+compile
                     # takes real wall time that must not leak into the
-                    # compile_seconds / ring compile records above
-                    self._inspect_record_step(key, scalars, rngk, batch,
-                                              t_build, t_step, t_done)
+                    # compile_seconds / ring compile records above. When
+                    # the memsafe preflight already analyzed this
+                    # executable and handed it to inspect, skip the
+                    # duplicate compile
+                    self._inspect_record_step(
+                        key, scalars, rngk, batch, t_build, t_step, t_done,
+                        prerecorded=bool(prefl
+                                         and prefl.get("inspect_recorded")))
             if not fenced and fence_every \
                     and self.num_update % int(fence_every) == 0:
                 # bound async run-ahead: without an observer fencing for
@@ -451,7 +579,7 @@ class ShardedTrainer:
             _diagnostics.sentinel_check(loss_val, "loss", self.num_update)
 
     def _inspect_record_step(self, key, scalars, rngk, batch, t_build,
-                             t_step, t_done):
+                             t_step, t_done, prerecorded=False):
         """Cost attribution for one sharded step. On a step-cache miss the
         freshly built executable is lowered+compiled once more for XLA
         cost/memory analysis (warm via the persistent cache when
@@ -464,10 +592,11 @@ class ShardedTrainer:
         name = f"ShardedTrainer({type(self.block).__name__})"
         ikey = _inspect.key_repr(key)
         if t_build is not None:
-            _inspect.analyze_jit(
-                name, ikey, self._step_cache[key], self.params, self.aux,
-                self.opt_state, self._t_dev, *scalars, rngk, *batch,
-                collectives=self._coll_est)
+            if not prerecorded:
+                _inspect.analyze_jit(
+                    name, ikey, self._step_cache[key], self.params,
+                    self.aux, self.opt_state, self._t_dev, *scalars, rngk,
+                    *batch, collectives=self._coll_est)
         elif t_step is not None:
             _inspect.note_step(name, ikey, t_done - t_step)
 
@@ -570,6 +699,58 @@ class ShardedTrainer:
         # re-seed the device-resident step counter from the restored count
         self._t_dev = jax.device_put(
             jnp.asarray(self.num_update, jnp.int32), self._rep)
+
+    def predict_step_bytes(self, data, labels):
+        """AOT memory plan for one train step at these batch SHAPES — no
+        device step executes, no batch transfers: the step is built and
+        lowered against ShapeDtypeStruct avals for the batch (host numpy /
+        NDArray / jax arrays all work, only shape+dtype are read), compiled
+        analytically, and XLA's memory_analysis is combined with the
+        resident train-state bytes. Returns {"exec_peak_bytes",
+        "resident_bytes", "predicted_bytes", "capacity_bytes",
+        "headroom_bytes", "fits"} (exec_peak None when the backend
+        withholds it; capacity/headroom/fits None when no capacity is
+        known). This is what dataflow.autofit binary-searches over."""
+        data = data if isinstance(data, (list, tuple)) else [data]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        if not self._ready:
+            raise RuntimeError(
+                "predict_step_bytes needs materialized parameters — run "
+                "one step (or use explicit shapes) before planning")
+
+        def aval(b):
+            raw = b._data if isinstance(b, NDArray) else b
+            return jax.ShapeDtypeStruct(tuple(raw.shape), raw.dtype)
+
+        batch = [aval(b) for b in list(data) + list(labels)]
+        shapes = tuple(b.shape for b in batch)
+        jitted = self._build_step(len(data), len(labels), shapes)
+        scalars = () if self._lr_inside else (
+            jax.ShapeDtypeStruct((), jnp.float32),)
+        # the global key is a concrete array already on device — passing
+        # it to lower() reads its aval only, and unlike next_key() it does
+        # not advance the training RNG stream
+        rng = _random.get_state()
+        args = (self.params, self.aux, self.opt_state, self._t_dev) \
+            + scalars + (rng,) + tuple(batch)
+        exec_peak, _compiled, err = _memsafe._analyze(jitted, args)
+        resident = _memsafe.resident_bytes(
+            (self.params, self.aux, self.opt_state)) \
+            + sum(int(math.prod(s.shape)) * s.dtype.itemsize for s in batch)
+        capacity = _memsafe.capacity_bytes()
+        predicted = int(resident) + int(exec_peak or 0)
+        out = {
+            "exec_peak_bytes": exec_peak,
+            "resident_bytes": int(resident),
+            "predicted_bytes": predicted,
+            "capacity_bytes": capacity,
+            "headroom_bytes": None if capacity is None
+            else int(capacity) - predicted,
+            "fits": None if capacity is None else predicted <= capacity,
+        }
+        if err is not None:
+            out["analysis_error"] = err
+        return out
 
     @property
     def param_count(self):

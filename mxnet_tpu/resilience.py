@@ -953,6 +953,13 @@ class FaultInjector:
                               (AFTER its manifest: restore must detect it)
       stall_input:250       — one 250 ms stall inside the input pipeline
       exc@step:2            — raise RuntimeError after step 2 (crash path)
+      oom@step:3            — raise a synthetic RESOURCE_EXHAUSTED at the
+                              DISPATCH of step 3 (before any transfer or
+                              donation, like a pre-flight rejection), so
+                              every rung of the mx.memsafe oom_recover
+                              degradation ladder is drivable in tests;
+                              repeat the spec to OOM the retry too and
+                              walk further rungs
       shrink@step:3         — after step 3: save a final checkpoint and exit
                               EXIT_SHRINK (84) — an elastic supervisor
                               relaunches the gang SMALLER by every rank
@@ -1004,18 +1011,20 @@ class FaultInjector:
                         f"fault_inject: unknown qualifier {field!r} in "
                         f"{part!r}")
             if spec["kind"] not in ("sigterm", "kill", "corrupt_ckpt",
-                                    "stall_input", "exc", "shrink", "grow"):
+                                    "stall_input", "exc", "shrink", "grow",
+                                    "oom"):
                 raise ValueError(
                     f"fault_inject: unknown fault {spec['kind']!r} in "
                     f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
-                    "stall_input, exc, shrink, grow)")
+                    "stall_input, exc, shrink, grow, oom)")
             specs.append(spec)
         return cls(specs)
 
     def fire(self, point, step=None, path=None):
         """Run every armed spec matching this fault point. `point` is
-        "step" (trainer step boundary), "ckpt" (checkpoint just written),
-        or "input" (input pipeline worker)."""
+        "step" (trainer step boundary), "dispatch" (about to dispatch a
+        step; nothing transferred or donated yet), "ckpt" (checkpoint
+        just written), or "input" (input pipeline worker)."""
         rank = _process_index()
         for spec in self._specs:
             if spec["fired"]:
@@ -1042,6 +1051,15 @@ class FaultInjector:
                       f"{step} (rank {_process_index()})", file=sys.stderr)
                 _preempt["flag"] = True
                 _preempt["resize"] = kind
+            elif point == "dispatch" and kind == "oom":
+                if spec["step"] is not None and step != spec["step"]:
+                    continue
+                spec["fired"] = True
+                print(f"mx.resilience: fault injection: synthetic "
+                      f"RESOURCE_EXHAUSTED at dispatch of step {step} "
+                      f"(rank {rank})", file=sys.stderr)
+                from . import memsafe as _memsafe
+                raise _memsafe.SimulatedResourceExhausted(step=step)
             elif point == "ckpt" and kind == "corrupt_ckpt":
                 if spec["step"] is not None and step != spec["step"]:
                     continue

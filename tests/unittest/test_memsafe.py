@@ -1,0 +1,440 @@
+"""mx.memsafe tests: pre-flight budget math + MemoryBudgetError contents,
+headroom gauge/warning, graduated remat policy equivalence (bit-exact loss
+across policies, scan and unrolled), microbatch grad parity, the full
+oom_recover=auto degradation ladder under `oom@step` injection (with the
+post-mortem memsafe section), autofit monotonicity + chosen-config-fits,
+and the eager-trainer OOM accounting."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, dataflow, diagnostics, memsafe, nd, parallel
+from mxnet_tpu import resilience, telemetry
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _clean_memsafe():
+    yield
+    memsafe.disable()
+    memsafe.reset()
+    resilience.uninstall()
+    diagnostics.uninstall()
+    diagnostics.reset()   # drop ring records (they outlive uninstall)
+    telemetry.reset()
+    telemetry.disable()
+    config.reset()
+
+
+def _xy(batch=16, in_units=8, out_units=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(batch, in_units).astype(np.float32)),
+            nd.array(np.zeros((batch, out_units), np.float32)))
+
+
+def _dense_trainer(seed=0, in_units=8, out_units=4, optimizer="sgd"):
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(seed)
+    net = nn.Dense(out_units, in_units=in_units)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: lfn(o, l), optimizer,
+        {"learning_rate": 0.1}), net
+
+
+def _tiny_gpt_cfg(**overrides):
+    from mxnet_tpu.models import gpt as gpt_mod
+    base = dict(vocab_size=64, units=32, hidden_size=64, num_heads=2,
+                max_length=16)
+    base.update(overrides)
+    return gpt_mod.gpt_tiny_config(**base)
+
+
+def _gpt_trainer(cfg, seed=0):
+    from mxnet_tpu.models import gpt as gpt_mod
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(seed)
+    net = gpt_mod.GPTForCausalLM(cfg)
+    net.initialize()
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    V = cfg["vocab_size"]
+
+    def loss_fn(logits, labels):
+        return lfn(logits.reshape(shape=(-1, V)),
+                   labels.reshape(shape=(-1,)))
+
+    return parallel.ShardedTrainer(net, loss_fn, "sgd",
+                                   {"learning_rate": 0.1}), net
+
+
+def _gpt_batch(batch=8, L=16, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, vocab, (batch, L)).astype(np.int32)
+    return nd.array(toks), nd.array(toks.astype(np.float32))
+
+
+# -- capacity + budget math --------------------------------------------------
+
+def test_capacity_knob_overrides_and_cpu_has_none():
+    assert memsafe.capacity_bytes() is None   # CPU: no bytes_limit
+    config.set("device_bytes_limit", 12345)
+    assert memsafe.capacity_bytes() == 12345
+
+
+def test_budget_error_pre_dispatch_and_message():
+    # below even the resident state (params+opt+batch ~= 1 KiB), so the
+    # check rejects whatever the backend reports for execution temps
+    config.set("device_bytes_limit", 500)
+    tr, _net = _dense_trainer()
+    assert memsafe.enabled()   # armed by the knob at construction
+    x, y = _xy()
+    with pytest.raises(memsafe.MemoryBudgetError) as ei:
+        tr.step(x, y)
+    e = ei.value
+    # names the executable and carries the full accounting
+    assert "ShardedTrainer" in e.executable
+    assert e.capacity_bytes == 500
+    assert e.predicted_bytes > e.capacity_bytes
+    assert e.headroom_bytes == e.capacity_bytes - e.predicted_bytes < 0
+    assert e.predicted_bytes == (e.exec_peak_bytes or 0) + e.resident_bytes
+    msg = str(e)
+    for needle in ("ShardedTrainer", "remat", "autofit", "mx.zero",
+                   "oom_recover=auto"):
+        assert needle in msg, f"message missing {needle!r}: {msg}"
+    # rejected BEFORE dispatch: nothing committed, nothing donated
+    assert tr.num_update == 0
+    # raising the capacity lets the same trainer proceed (the rejected
+    # executable was evicted, not cached past the check)
+    config.set("device_bytes_limit", 10**9)
+    tr.step(x, y)
+    assert tr.num_update == 1
+
+
+def test_budget_accounting_matches_state_bytes():
+    config.set("device_bytes_limit", 10**9)
+    tr, _net = _dense_trainer()
+    x, y = _xy()
+    info = tr.predict_step_bytes([x], [y])
+    assert info["predicted_bytes"] == \
+        (info["exec_peak_bytes"] or 0) + info["resident_bytes"]
+    # resident covers at least params + optimizer state + the batch
+    param_bytes = sum(int(p.nbytes) for p in tr.params)
+    opt_bytes = sum(int(z.nbytes) for st in tr.opt_state for z in st)
+    batch_bytes = x._data.nbytes + y._data.nbytes
+    assert info["resident_bytes"] >= param_bytes + opt_bytes + batch_bytes
+    assert info["fits"] is True and info["headroom_bytes"] > 0
+
+
+def test_headroom_gauge_and_warning_event():
+    telemetry.enable()
+    config.set("device_bytes_limit", 10**9)
+    tr, _net = _dense_trainer()
+    x, y = _xy()
+    tr.step(x, y)
+    g = telemetry.gauge("memory_headroom_bytes")
+    assert g.value > 0
+    chk = memsafe.last_check()
+    assert chk["capacity_bytes"] == 10**9
+    assert g.value == chk["headroom_bytes"]
+    assert not [e for e in telemetry.events("memsafe_warning")]
+    # shrink capacity to just above predicted: fits, but under the warn
+    # fraction -> warning event
+    config.set("device_bytes_limit", int(chk["predicted_bytes"] * 1.05))
+    config.set("memory_headroom_warn", 0.5)
+    tr2, _ = _dense_trainer(seed=1)
+    tr2.step(x, y)
+    warns = telemetry.events("memsafe_warning")
+    assert warns and warns[-1]["headroom_bytes"] >= 0
+
+
+def test_preflight_covers_hybrid_block_path():
+    config.set("device_bytes_limit", 100)
+    memsafe.maybe_enable()
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(memsafe.MemoryBudgetError) as ei:
+        net(_xy()[0])
+    assert "Dense" in ei.value.executable
+
+
+# -- graduated remat policies ------------------------------------------------
+
+@pytest.mark.slow
+def test_remat_policy_equivalence_bit_exact():
+    # slow-marked (7 small-transformer compiles); ci/run.sh sanity runs it
+    x, y = _gpt_batch()
+
+    def run(policy, scan_layers=False):
+        cfg = _tiny_gpt_cfg(scan_layers=scan_layers)
+        tr, net = _gpt_trainer(cfg)
+        if policy is not None:
+            net.remat(policy)
+        return [float(tr.step(x, y).asscalar()) for _ in range(2)]
+
+    ref = run("none")
+    for policy in ("dots_saveable", "layers", "full"):
+        assert run(policy) == ref, f"policy {policy} diverged"
+    # scan path: layer body under jax.checkpoint — same losses bit-exact
+    scan_ref = run("none", scan_layers=True)
+    assert run("layers", scan_layers=True) == scan_ref
+    assert run("full", scan_layers=True) == scan_ref
+
+
+def test_remat_legacy_alias_and_knob_default():
+    cfg = _tiny_gpt_cfg(remat=True)
+    _tr, net = _gpt_trainer(cfg)
+    # legacy remat=True config flag == the "layers" alias
+    assert memsafe.policy_marker(net) == "layers"
+    # explicit .remat() beats the legacy flag
+    net.remat("dots_saveable")
+    assert memsafe.policy_marker(net) == "dots_saveable"
+    # the remat_policy knob is the default for blocks with no explicit set
+    config.set("remat_policy", "full")
+    _tr2, net2 = _gpt_trainer(_tiny_gpt_cfg(), seed=1)
+    assert memsafe.policy_marker(net2) == "full"
+    net2.remat("none")
+    assert memsafe.policy_marker(net2) == "none"
+    with pytest.raises(ValueError):
+        net2.remat("everything")
+
+
+def test_generic_block_remat_wrap_bit_exact():
+    x, y = _xy()
+
+    def run(policy):
+        tr, net = _dense_trainer()
+        if policy:
+            net.remat(policy)
+        return [float(tr.step(x, y).asscalar()) for _ in range(3)]
+
+    ref = run(None)
+    assert run("dots_saveable") == ref
+    assert run("full") == ref
+
+
+# -- microbatching -----------------------------------------------------------
+
+def test_microbatch_grad_parity():
+    x, y = _xy()
+
+    def run(accum, optimizer="sgd"):
+        tr, _net = _dense_trainer(optimizer=optimizer)
+        if accum > 1:
+            tr.set_grad_accum(accum)
+        losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+        params = [np.asarray(p) for p in tr.params] if not tr._fused \
+            else [np.asarray(tr.params)]
+        return losses, params
+
+    ref_losses, ref_params = run(1)
+    for accum in (2, 4):
+        losses, params = run(accum)
+        assert np.allclose(ref_losses, losses, rtol=1e-5), (accum, losses)
+        for a, b in zip(ref_params, params):
+            assert np.allclose(a, b, rtol=1e-5, atol=1e-7)
+    # the fused-LAMB flat-master path microbatches too
+    lamb_ref = run(1, optimizer="lamb")
+    lamb_acc = run(2, optimizer="lamb")
+    assert np.allclose(lamb_ref[0], lamb_acc[0], rtol=1e-5)
+
+
+def test_set_grad_accum_validation():
+    tr, _net = _dense_trainer()
+    with pytest.raises(ValueError):
+        tr.set_grad_accum(0)
+    tr.set_grad_accum(3)   # 16 % 3 != 0 -> rejected at build with the dims
+    x, y = _xy()
+    with pytest.raises(ValueError, match="divisible"):
+        tr.step(x, y)
+
+
+# -- the degradation ladder --------------------------------------------------
+
+def test_full_ladder_walk_under_oom_injection(tmp_path):
+    x, y = _xy()
+    tr0, _ = _dense_trainer()
+    ref = [float(tr0.step(x, y).asscalar()) for _ in range(3)]
+
+    telemetry.enable()
+    diagnostics.install(diagnostics_dir=str(tmp_path))
+    config.set("oom_recover", "auto")
+    # five synthetic OOMs at the dispatch of step 1: each retry re-fires
+    # the next spec, walking remat escalation then batch halving
+    config.set("fault_inject", ",".join(["oom@step:1"] * 5))
+    resilience.enable()
+    tr, net = _dense_trainer()
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    assert np.allclose(ref, losses, rtol=1e-5), (ref, losses)
+    walked = [(t["kind"], t["value"]) for t in memsafe.transitions()]
+    assert walked == [("remat", "dots_saveable"), ("remat", "layers"),
+                      ("remat", "full"), ("accum", 2), ("accum", 4)], walked
+    assert memsafe.policy_marker(net) == "full" and tr._accum == 4
+    assert telemetry.counter("oom_events_total").value == 5
+    assert telemetry.counter("oom_recoveries_total").value == 1
+    # the post-mortem carries the memsafe section with the same story
+    pm_path = diagnostics.dump(reason="test")
+    with open(pm_path) as f:
+        pm = json.load(f)
+    sec = pm["memsafe"]
+    assert sec["oom_events"] == 5
+    assert [(t["kind"], t["value"]) for t in sec["transitions"]] == walked
+
+
+def test_oom_recover_off_keeps_fail_fast():
+    config.set("fault_inject", "oom@step:1")
+    config.set("device_bytes_limit", 10**9)   # arms memsafe; recover off
+    resilience.enable()
+    tr, _net = _dense_trainer()
+    x, y = _xy()
+    with pytest.raises(memsafe.SimulatedResourceExhausted,
+                       match="RESOURCE_EXHAUSTED"):
+        tr.step(x, y)
+    assert memsafe.transitions() == []
+    assert tr.num_update == 0
+
+
+@pytest.mark.slow
+def test_budget_driven_recovery_trains_to_completion():
+    """A config whose PREDICTED peak exceeds a simulated capacity is
+    rejected pre-dispatch, then — under oom_recover=auto — degrades until
+    it fits and trains to completion with loss parity (the acceptance
+    gate). At 4 transformer layers the saved per-layer activations
+    dominate, so remat escalation monotonically shrinks the prediction."""
+    cfg = _tiny_gpt_cfg(hidden_size=256, num_layers=4, max_length=64)
+    x, y = _gpt_batch(batch=32, L=64, vocab=cfg["vocab_size"])
+
+    tr0, net0 = _gpt_trainer(cfg)
+    ref = [float(tr0.step(x, y).asscalar()) for _ in range(3)]
+    p_none = tr0.predict_step_bytes([x], [y])["predicted_bytes"]
+    tr_probe, net_probe = _gpt_trainer(cfg, seed=1)
+    net_probe.remat("layers")
+    p_layers = tr_probe.predict_step_bytes([x], [y])["predicted_bytes"]
+    assert p_layers < p_none, (p_layers, p_none)
+
+    # capacity admits per-layer remat but not the undegraded step: the
+    # pre-flight check rejects, the ladder escalates until it fits
+    config.set("device_bytes_limit", (p_none + p_layers) // 2)
+    config.set("oom_recover", "auto")
+    tr, net = _gpt_trainer(cfg, seed=0)
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    assert np.allclose(ref, losses, rtol=1e-5), (ref, losses)
+    walked = [(t["kind"], t["value"]) for t in memsafe.transitions()]
+    assert walked, "expected at least one ladder transition"
+    assert walked[0] == ("remat", "dots_saveable")
+    # and the landed configuration's prediction actually fits
+    assert tr.predict_step_bytes([x], [y])["fits"] is True
+    assert tr.num_update == 3
+
+
+# -- autofit -----------------------------------------------------------------
+
+def test_autofit_monotonic_and_chosen_config_fits():
+    tr, _net = _dense_trainer(in_units=64, out_units=256)
+
+    def make_batch(b):
+        return ([nd.array(np.zeros((b, 64), np.float32))],
+                [nd.array(np.zeros((b, 256), np.float32))])
+
+    p_small = tr.predict_step_bytes(*make_batch(64))["predicted_bytes"]
+    p_big = tr.predict_step_bytes(*make_batch(512))["predicted_bytes"]
+    cap = (p_small + p_big) // 2
+    config.set("device_bytes_limit", cap)
+    r = dataflow.autofit(tr, make_batch, max_batch=1024, verbose=False)
+    assert r.predicted_bytes <= cap
+    assert r.headroom_bytes == cap - r.predicted_bytes >= 0
+    # the next-larger candidate does NOT fit
+    assert r.next_larger is not None
+    assert r.next_larger["batch_size"] > r.batch_size
+    assert r.next_larger["predicted_bytes"] > cap
+    # predicted peak is monotone in batch size across the probe trail
+    by_batch = {p["batch_size"]: p["predicted_bytes"] for p in r.probes}
+    sizes = sorted(by_batch)
+    assert all(by_batch[a] <= by_batch[b]
+               for a, b in zip(sizes, sizes[1:])), by_batch
+    # no step executed during the search
+    assert tr.num_update == 0
+
+
+@pytest.mark.slow
+def test_autofit_bucket_boundaries_feed_bucket_pad():
+    # slow-marked (transformer AOT probes); ci/run.sh sanity runs it
+    from mxnet_tpu.models import gpt as gpt_mod
+    cfg = _tiny_gpt_cfg(max_length=32)
+    tr, _net = _gpt_trainer(cfg)
+
+    def make_batch(b, L=None):
+        L = L or 32
+        return _gpt_batch(b, L, cfg["vocab_size"])
+
+    p16 = tr.predict_step_bytes(*make_batch(16, 16))["predicted_bytes"]
+    p32 = tr.predict_step_bytes(*make_batch(16, 32))["predicted_bytes"]
+    assert p32 > p16
+    # capacity admits the 16-bucket but not the 32-bucket at batch 16;
+    # multiple_of pins the probes to batch 16 so the oversized bucket is
+    # DROPPED (not traded for a smaller batch)
+    config.set("device_bytes_limit", (p16 + p32) // 2)
+    r = dataflow.autofit(tr, make_batch, max_batch=16, buckets=[16, 32],
+                         multiple_of=16, verbose=False)
+    assert r.batch_size == 16
+    assert r.buckets == [16]
+    pad = r.bucket_pad()
+    assert pad.axis_buckets == {1: [16]}
+    assert tr.num_update == 0
+
+
+def test_autofit_nothing_fits_raises_budget_error():
+    tr, _net = _dense_trainer()
+
+    def make_batch(b):
+        return ([nd.array(np.zeros((b, 8), np.float32))],
+                [nd.array(np.zeros((b, 4), np.float32))])
+
+    with pytest.raises(memsafe.MemoryBudgetError):
+        dataflow.autofit(tr, make_batch, max_batch=64, capacity=10,
+                         verbose=False)
+
+
+# -- fault injector + eager path ---------------------------------------------
+
+def test_fault_injector_oom_spec_parsing_and_rank_targeting(monkeypatch):
+    inj = resilience.FaultInjector.parse("oom@step:3@rank:1")
+    spec = inj._specs[0]
+    assert spec["kind"] == "oom" and spec["step"] == 3 and spec["rank"] == 1
+    # wrong rank: no fire
+    monkeypatch.setattr(resilience, "_process_index", lambda: 0)
+    inj.fire("dispatch", step=3)
+    assert not spec["fired"]
+    # right rank, right step, right point
+    monkeypatch.setattr(resilience, "_process_index", lambda: 1)
+    inj.fire("step", step=3)          # wrong point: no fire
+    assert not spec["fired"]
+    with pytest.raises(memsafe.SimulatedResourceExhausted):
+        inj.fire("dispatch", step=3)
+    assert spec["fired"]
+    with pytest.raises(ValueError, match="unknown fault"):
+        resilience.FaultInjector.parse("oops@step:1")
+
+
+def test_eager_trainer_oom_counts_and_annotates():
+    from mxnet_tpu.gluon.trainer import Trainer
+    telemetry.enable()
+    memsafe.enable()
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+
+    trainer._update = boom
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        trainer.step(8)
+    assert telemetry.counter("oom_events_total").value == 1

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""autofit — find the largest batch/bucket configuration that fits the
+device, WITHOUT executing a single train step (mx.memsafe + mx.dataflow).
+
+Builds the named model + a ShardedTrainer, then binary-searches batch size
+(and optionally BucketPad sequence buckets) using AOT lowering + XLA
+memory_analysis against the measured device capacity (or a simulated
+`--device-bytes-limit`, which is how CPU CI exercises this end to end).
+Prints the probe trail to stderr and ONE JSON line to stdout — the chosen
+config feeds straight into `dataflow.BucketPad` and the trainer.
+
+Examples:
+  python tools/autofit.py --model bert_tiny --seq-len 64 --max-batch 512 \
+      --device-bytes-limit 2000000000
+  python tools/autofit.py --model gpt_tiny --buckets 32,64 --optimizer sgd
+  python tools/autofit.py --model dense --max-batch 4096 \
+      --device-bytes-limit 500000
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(model, optimizer, seq_len):
+    """(trainer, make_batch) for one named model. make_batch(b[, L]) returns
+    a (data, labels) host batch — shapes/dtypes only are read by autofit."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon import nn
+
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(0)
+    opt_params = {"learning_rate": 1e-3}
+    if model == "dense":
+        net = nn.Dense(256, in_units=64)
+        net.initialize()
+        lfn = gloss.L2Loss()
+        trainer = parallel.ShardedTrainer(
+            net, lambda o, l: lfn(o, l), optimizer, opt_params)
+
+        def make_batch(b, L=None):
+            return ([nd.array(np.zeros((b, 64), np.float32))],
+                    [nd.array(np.zeros((b, 256), np.float32))])
+
+        return trainer, make_batch
+    if model.startswith("bert"):
+        from mxnet_tpu.models import bert as bert_mod
+        cfg = getattr(bert_mod, f"{model}_config")()
+        net = bert_mod.BERTForPretraining(cfg)
+        net.initialize()
+        trainer = parallel.ShardedTrainer(
+            net, bert_mod.bert_pretrain_loss, optimizer, opt_params)
+
+        def make_batch(b, L=None):
+            L = L or seq_len or min(128, cfg["max_length"])
+            masked = max(1, L // 8)
+            raw = bert_mod.make_synthetic_batch(cfg, b, L, masked, seed=0)
+            data = [nd.array(raw[k]) for k in
+                    ("input_ids", "token_types", "valid_length",
+                     "masked_positions")]
+            labels = [nd.array(raw[k]) for k in
+                      ("mlm_labels", "mlm_weights", "nsp_labels")]
+            return data, labels
+
+        return trainer, make_batch
+    if model.startswith("gpt"):
+        from mxnet_tpu.models import gpt as gpt_mod
+        cfg = getattr(gpt_mod, f"{model}_config")() \
+            if hasattr(gpt_mod, f"{model}_config") \
+            else getattr(gpt_mod, f"{model}")()
+        net = gpt_mod.GPTForCausalLM(cfg)
+        net.initialize()
+        lfn = gloss.SoftmaxCrossEntropyLoss()
+
+        def loss_fn(logits, labels):
+            return lfn(logits.reshape(shape=(-1, cfg["vocab_size"])),
+                       labels.reshape(shape=(-1,)))
+
+        trainer = parallel.ShardedTrainer(net, loss_fn, optimizer,
+                                          opt_params)
+
+        def make_batch(b, L=None):
+            L = L or seq_len or min(128, cfg["max_length"])
+            rng = np.random.RandomState(0)
+            toks = rng.randint(0, cfg["vocab_size"], (b, L)).astype(np.int32)
+            return ([nd.array(toks)],
+                    [nd.array(toks.astype(np.float32))])
+
+        return trainer, make_batch
+    raise SystemExit(f"unknown --model {model!r} (know: dense, bert_tiny, "
+                     "bert_base, bert_large, gpt_tiny, gpt2_117m, "
+                     "gpt2_345m)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="binary-search the largest batch/bucket config that "
+        "fits device memory — AOT analysis only, no execution")
+    ap.add_argument("--model", default="dense",
+                    help="dense | bert_tiny | bert_base | bert_large | "
+                    "gpt_tiny | gpt2_117m | gpt2_345m")
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--seq-len", type=int, default=0,
+                    help="sequence length for the probes (transformer "
+                    "models); ignored when --buckets is given")
+    ap.add_argument("--max-batch", type=int, default=1024)
+    ap.add_argument("--buckets", default="",
+                    help="comma-separated candidate sequence buckets, e.g. "
+                    "'64,128,256' — verified at the chosen batch, fed to "
+                    "BucketPad")
+    ap.add_argument("--device-bytes-limit", type=int, default=0,
+                    help="simulated device capacity in bytes (sets the "
+                    "device_bytes_limit knob); 0 = use the real device's "
+                    "memory_stats")
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import dataflow
+
+    if args.device_bytes_limit:
+        mx.config.set("device_bytes_limit", args.device_bytes_limit)
+    buckets = [int(b) for b in args.buckets.split(",") if b.strip()] or None
+    trainer, make_batch = build(args.model, args.optimizer,
+                                args.seq_len or None)
+    result = dataflow.autofit(trainer, make_batch,
+                              max_batch=args.max_batch, buckets=buckets)
+    out = result.as_dict()
+    out["model"] = args.model
+    print(json.dumps(out), flush=True)
+    print(f"# autofit: model={args.model} batch={result.batch_size} "
+          f"predicted={result.predicted_bytes} capacity="
+          f"{result.capacity_bytes} headroom={result.headroom_bytes}"
+          + (f" buckets={result.buckets}" if result.buckets else ""),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -263,6 +263,25 @@ def report(args):
                     f"{_fp(rs.get('to'))}: {rs.get('arrays')} arrays, "
                     f"{(rs.get('bytes_moved') or 0) / 1e6:.1f} MB moved "
                     f"in {rs.get('seconds', 0):.3f}s")
+        ms = pm.get("memsafe")
+        if isinstance(ms, dict) and "error" not in ms:
+            # memory-safety story: OOMs seen, what the degradation ladder
+            # traded away, and the last pre-flight prediction vs capacity
+            if ms.get("oom_events"):
+                lines.append(f"  memsafe: {ms['oom_events']} OOM event(s)")
+            for t in ms.get("transitions", []):
+                what = (f"remat -> {t.get('value')!r}"
+                        if t.get("kind") == "remat"
+                        else f"grad accumulation x{t.get('value')}")
+                lines.append(f"  memsafe: step {t.get('step')}: {what}")
+            chk = ms.get("last_check")
+            if isinstance(chk, dict) and chk.get("capacity_bytes"):
+                lines.append(
+                    f"  memsafe: last pre-flight '{chk.get('executable')}' "
+                    f"predicted {(chk.get('predicted_bytes') or 0) / 1e6:.1f}"
+                    f" MB of {chk['capacity_bytes'] / 1e6:.1f} MB capacity "
+                    f"(headroom {(chk.get('headroom_bytes') or 0) / 1e6:.1f}"
+                    " MB)")
         if status != "clean":
             failing.append(rank)
 
