@@ -112,6 +112,7 @@ def run_bench(on_tpu):
         jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
+    from mxnet_tpu import check as mxcheck
     from mxnet_tpu import diagnostics, memsafe, nd, parallel, telemetry
     from mxnet_tpu import inspect as mxinspect
     from mxnet_tpu.models import bert as bert_mod
@@ -134,6 +135,11 @@ def run_bench(on_tpu):
     # actual OOM during the bench degrades per oom_recover instead of
     # losing the artifact
     memsafe.enable()
+    # mx.check rides along in warn mode (one trace-only lint per compile):
+    # the JSON line's check_findings field records whether the headline
+    # configuration's graph is CLEAN — a perf trajectory whose findings
+    # count creeps up caught a hazard before it cost a recompile or an OOM
+    mxcheck.enable("warn")
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
@@ -291,6 +297,10 @@ def run_bench(on_tpu):
     out["remat_policy"] = memsafe.policy_marker(model)
     out["oom_recoveries"] = int(
         telemetry.counter("oom_recoveries_total").value)
+    # mx.check: graph + concurrency findings for the benched
+    # configuration (0 = lint-clean; the trajectory should stay 0)
+    out["check_findings"] = len(mxcheck.findings()) \
+        + len(mxcheck.thread_findings())
     # memory/recompute tradeoff, measured not guessed: with a remat policy
     # active (MXNET_TPU_BENCH_REMAT or the remat_policy knob), re-run the
     # same timed loop under policy='none' and report the step-time ratio

@@ -4,6 +4,8 @@
 # framework actually has; each is independently invokable:
 #
 #   ci/run.sh sanity      — import + compile-surface checks, fast
+#   ci/run.sh static      — mx.check static analysis: AST rules, graph
+#                           lint over the model zoo, tsan-lite lock sweep
 #   ci/run.sh unittest    — tests/unittest on the 8-device virtual CPU mesh
 #   ci/run.sh dist        — tests/dist (sharding/collectives/pipeline/mp)
 #   ci/run.sh train       — tests/train (convergence-tier, slower)
@@ -113,16 +115,48 @@ import json
 d = json.load(open('/tmp/_bench_sanity.json'))
 for k in ('mfu', 'achieved_tflops', 'peak_device_bytes',
           'comm_bytes_per_step', 'memory_headroom_bytes',
-          'oom_recoveries'):
+          'oom_recoveries', 'check_findings'):
     assert k in d, f'bench JSON missing {k}: {sorted(d)}'
     assert d[k] is None or isinstance(d[k], (int, float)), (k, d[k])
 assert d.get('remat_policy') in ('none', 'dots_saveable', 'layers',
                                  'full'), d.get('remat_policy')
 assert d['mfu'] is None, 'CPU run must report mfu null, not a number'
 assert d['achieved_tflops'] is None or d['achieved_tflops'] > 0
+assert d['check_findings'] == 0, \
+    f'bench graph must lint clean, got {d[\"check_findings\"]} findings'
 print('bench efficiency fields OK:', {k: d[k] for k in
       ('mfu', 'achieved_tflops', 'peak_device_bytes',
-       'comm_bytes_per_step')})
+       'comm_bytes_per_step', 'check_findings')})
+"
+    # mx.check must be disabled by default: the trainer and block hot
+    # paths make zero analyzer calls (one module-bool check each), no
+    # jaxpr is traced, and no findings registry accumulates
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, check
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not check.enabled(), 'check must default to off'
+calls = {'jit': 0, 'step': 0, 'lint': 0}
+real = (check.check_jit, check.check_step, check.lint_jaxpr)
+check.check_jit = lambda *a, **k: (calls.__setitem__('jit', calls['jit'] + 1), real[0](*a, **k))[1]
+check.check_step = lambda *a, **k: (calls.__setitem__('step', calls['step'] + 1), real[1](*a, **k))[1]
+check.lint_jaxpr = lambda *a, **k: (calls.__setitem__('lint', calls['lint'] + 1), real[2](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+net2 = nn.Dense(4, in_units=8); net2.initialize(); net2.hybridize()
+net2(x)
+check.check_jit, check.check_step, check.lint_jaxpr = real
+assert calls == {'jit': 0, 'step': 0, 'lint': 0}, calls
+assert check.findings() == [], 'disabled fast path recorded findings'
+print('check disabled fast path OK (no lint calls, no findings)')
 "
     # memsafe must be disabled by default (oom_recover=off): the trainer
     # and block hot paths make zero preflight/capacity/recovery calls (one
@@ -247,6 +281,27 @@ print('diagnostics disabled fast path OK')
 "
 }
 
+static_stage() {
+    echo "== static =="
+    # AST rules over the whole tree: shard-map-import (bit PR 5 and 6),
+    # signal-handler-blocking (PR 5's launch.py deadlock), raw-lock,
+    # wallclock-in-jit. Exits nonzero on any unsuppressed finding.
+    python tools/lint_rules.py
+    # graph lint over the standard model zoo: the repo's own models must
+    # compile with ZERO findings (large constants, donation misses,
+    # dtype promotions, degenerate sharding, retrace hazards)
+    JAX_PLATFORMS=cpu python tools/check_graph.py \
+        --model dense --model bert_tiny --model gpt_tiny --steps 2
+    # tsan-lite sweep: re-run the threaded unit tests with the
+    # instrumented-lock layer armed — any lock-order cycle or unguarded
+    # shared-structure mutation raises LockOrderError and fails the test
+    # that exposed it
+    MXNET_TPU_CHECK_THREADS=1 JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_telemetry.py tests/unittest/test_check.py \
+        tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
+        -q -m 'not slow' -p no:cacheprovider
+}
+
 unittest_stage() {
     echo "== unittest =="
     # covers tests/unittest/test_telemetry.py (registry semantics,
@@ -282,12 +337,14 @@ native_stage() {
 
 case "$stage" in
     sanity) sanity ;;
+    static) static_stage ;;
     unittest) unittest_stage ;;
     dist) dist_stage ;;
     train) train_stage ;;
     native) native_stage ;;
     all)
         sanity
+        static_stage
         native_stage
         unittest_stage
         dist_stage

@@ -56,6 +56,7 @@ _LAZY = {
     "diagnostics": ".diagnostics",
     "resilience": ".resilience",
     "memsafe": ".memsafe",
+    "check": ".check",
     "inspect": ".inspect",
     "dataflow": ".dataflow",
     "parallel": ".parallel",

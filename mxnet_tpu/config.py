@@ -16,11 +16,12 @@ bug class this replaces).
 from __future__ import annotations
 
 import os
-import threading
+
+from . import _locklint
 
 __all__ = ["register_option", "get", "set", "reset", "describe", "option"]
 
-_lock = threading.Lock()
+_lock = _locklint.make_lock("config.registry")
 _options = {}
 _overrides = {}
 
@@ -370,6 +371,75 @@ register_option(
         "accumulation microbatching (loss/grad parity up to reduction "
         "order), re-plan, retry — each transition logged to telemetry, "
         "the flight ring, and the post-mortem 'memsafe' section.")
+register_option(
+    "check", "off", choices=("off", "warn", "error"),
+    doc="mx.check static analysis mode. 'off' (default) is the "
+        "zero-overhead fast path: the jit-cache-miss hook sites reduce to "
+        "one module-bool check, no jaxpr walk, no findings registry "
+        "(asserted by ci/run.sh sanity). 'warn' lints every freshly traced "
+        "computation (large baked constants, donation misses, silent "
+        "bf16->f32/f64 promotions, predictable retrace hazards, degenerate "
+        "sharding) and reports findings to stderr + the "
+        "check_findings_total{rule=...} telemetry counter + "
+        "check_dir/<rank>/check.json. 'error' additionally raises "
+        "CheckError on the first finding, naming the rule, location, and "
+        "remediation — the CI 'static' stage runs the model zoo this way.")
+register_option(
+    "check_dir", "",
+    "When set, mx.check writes its findings to <dir>/<rank>/check.json at "
+    "process exit (and refreshes after each new finding) so "
+    "tools/check_graph.py can merge and render a multi-rank report. Empty "
+    "keeps findings in-memory only; mx.check.dump(path) still works.")
+register_option(
+    "check_large_const_bytes", 1 << 20,
+    "mx.check graph-lint threshold: a constant baked into a traced "
+    "computation (closure-captured numpy/jax array, not a parameter) at "
+    "or above this many bytes fires the 'large-constant' rule — baked "
+    "constants are re-staged per executable and defeat donation. "
+    "<=0 disables the rule.")
+register_option(
+    "check_promotion_min_bytes", 1 << 20,
+    "mx.check graph-lint threshold: a bf16/f16 -> f32/f64 "
+    "convert_element_type whose OUTPUT is at or above this many bytes "
+    "fires the 'dtype-promotion' rule (a non-weak f32 scalar — e.g. "
+    "np.float32 — silently promotes whole activation tensors; python "
+    "scalars stay weak and do not). Small deliberate upcasts like the "
+    "per-sample loss stay under the threshold. <=0 disables the rule.")
+register_option(
+    "check_replicated_min_bytes", 64 << 20,
+    "mx.check graph-lint threshold for the 'degenerate-sharding' rule: on "
+    "a mesh whose data axes span >1 device, fully-replicated trained "
+    "parameters (param_mode='replicate') or replicated batch inputs at or "
+    "above this many bytes are flagged (every device holds the full "
+    "array; remediation: fsdp param mode / mx.zero, or a sharded batch "
+    "spec). <=0 disables the rule.")
+register_option(
+    "check_donation_min_bytes", 1 << 20,
+    "mx.check graph-lint threshold for the 'donation-miss' rule: an input "
+    "buffer at or above this many bytes whose shape+dtype exactly matches "
+    "an output of the same executable (state threading — KV caches, "
+    "optimizer moments) and is NOT donated double-buffers that state "
+    "every call. <=0 disables the aval-matching detector (the "
+    "trainer-level donate=False detector still fires).")
+register_option(
+    "check_retrace_limit", 4,
+    "mx.check graph-lint: distinct values of ONE signature component "
+    "(an input-shape axis, or a baked python scalar like a mutated "
+    "learning rate) observed for the same block/trainer before the "
+    "'retrace-hazard' rule fires — each distinct value is a full "
+    "recompile, and the component is predicted to keep varying. "
+    "<=0 disables the rule.")
+register_option(
+    "check_threads", False, env="MXNET_TPU_CHECK_THREADS",
+    doc="tsan-lite mode (read by mxnet_tpu/_locklint.py at import, also "
+        "directly from the env var so the jax-free tools/launch.py sees "
+        "it): instrumented-module locks become order-recording "
+        "CheckedLocks — an acquisition that closes a cycle in the "
+        "lock-order graph raises LockOrderError naming both acquisition "
+        "stacks, and guarded shared structures assert their lock is held "
+        "on mutation. Off (default): the factories return plain "
+        "threading primitives, zero overhead. The CI 'static' stage runs "
+        "the threaded unit tests under this mode.")
 register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "

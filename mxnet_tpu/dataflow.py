@@ -41,6 +41,7 @@ import time
 
 import numpy as np
 
+from . import _locklint
 from . import config as _config
 from . import resilience as _resilience
 from . import telemetry as _telemetry
@@ -117,7 +118,7 @@ class MeshPrefetcher:
         # close() is idempotent and may be called concurrently — including
         # from a SIGTERM/preemption path re-entering while the first close
         # is mid-join — so its bookkeeping sits behind an RLock
-        self._close_lock = threading.RLock()
+        self._close_lock = _locklint.make_rlock("dataflow.prefetcher.close")
         self._close_done = False
         # the worker closes over locals (not self) so a consumer dropping
         # its last reference lets __del__ run while the thread is alive
@@ -650,7 +651,7 @@ def _data_axis_extent(trainer):
 # None = not attempted yet (knob may still be set later); "" = attempted
 # and failed (don't retry, don't claim success); path = wired
 _cache_state = None
-_cache_lock = threading.Lock()
+_cache_lock = _locklint.make_lock("dataflow.compile_cache")
 
 
 def ensure_compile_cache():

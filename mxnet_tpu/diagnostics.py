@@ -45,6 +45,7 @@ import threading
 import time
 import traceback
 
+from . import _locklint
 from . import config
 from . import telemetry as _telemetry
 
@@ -56,7 +57,7 @@ __all__ = [
     "memory_watermarks", "dump", "postmortem_path",
 ]
 
-_lock = threading.RLock()
+_lock = _locklint.make_rlock("diagnostics.ring")
 _enabled = False                  # the fast-path bool; see enable()/disable()
 _ring = None                      # deque(maxlen=ring_size); None while disabled
 _installed = False

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import _engine
+from .. import check as _check
 from .. import diagnostics as _diagnostics
 from .. import inspect as _inspect
 from .. import memsafe as _memsafe
@@ -350,24 +351,47 @@ class HybridBlock(Block):
         rng = _random.next_key()
 
         prefl = None
-        if is_miss and _memsafe._enabled and not any(
+        if is_miss and (_memsafe._enabled or _check._enabled) and not any(
                 isinstance(d, jax.core.Tracer) for d in in_data):
-            # pre-flight budget check BEFORE the first dispatch: AOT
-            # lower+compile (warm via compile_cache_dir for the real call
-            # below) and compare predicted peak + resident params/inputs
-            # against device capacity — a predicted overrun raises
-            # MemoryBudgetError here, with nothing dispatched. Child
+            # pre-dispatch analyses for the fresh executable. Child
             # blocks compiling inside a parent trace (tracer inputs) are
-            # the parent executable's problem, not a budget of their own
-            try:
-                prefl = _memsafe.preflight_jit(
-                    type(self).__name__, key, jitted,
-                    (gp_data, aux_data, rng) + tuple(in_data))
-            except _memsafe.MemoryBudgetError:
-                # a rejected executable must not stay cached: a retried
-                # call would hit the cache and dispatch past the check
-                self._cache.pop(key, None)
-                raise
+            # the parent executable's problem, not their own. When BOTH
+            # subsystems are on, the computation is traced ONCE and
+            # shared: check lints the jaxpr, memsafe lowers the same
+            # trace for its analysis compile
+            hook_args = (gp_data, aux_data, rng) + tuple(in_data)
+            traced = _check.trace_jit(jitted, hook_args) \
+                if (_check._enabled and _memsafe._enabled) else None
+            if _memsafe._enabled:
+                # pre-flight budget check BEFORE the first dispatch: AOT
+                # lower+compile (warm via compile_cache_dir for the real
+                # call below) and compare predicted peak + resident
+                # params/inputs against device capacity — a predicted
+                # overrun raises MemoryBudgetError with nothing dispatched
+                try:
+                    prefl = _memsafe.preflight_jit(
+                        type(self).__name__, key, jitted, hook_args,
+                        traced=traced)
+                except _memsafe.MemoryBudgetError:
+                    # a rejected executable must not stay cached: a
+                    # retried call would hit the cache and dispatch past
+                    # the check
+                    self._cache.pop(key, None)
+                    raise
+            if _check._enabled:
+                # mx.check graph lint (trace-only — no compile): large
+                # baked constants, silent dtype promotions, retrace
+                # hazards
+                try:
+                    _check.check_jit(type(self).__name__, key, jitted,
+                                     hook_args,
+                                     owner=_check.owner_token(self),
+                                     traced=traced)
+                except _check.CheckError:
+                    # check=error: a rejected executable must not stay
+                    # cached (a retry would hit the cache, skip the lint)
+                    self._cache.pop(key, None)
+                    raise
 
         # the first call of a fresh entry triggers XLA's lazy compile, so
         # the compile-time measurement must bracket it

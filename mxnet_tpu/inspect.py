@@ -48,9 +48,9 @@ from __future__ import annotations
 import atexit
 import json
 import os
-import threading
 import time
 
+from . import _locklint
 from . import config
 from . import diagnostics as _diagnostics
 from . import telemetry as _telemetry
@@ -63,9 +63,12 @@ __all__ = [
     "estimate_collectives", "key_repr",
 ]
 
-_lock = threading.RLock()
+_lock = _locklint.make_rlock("inspect.registry")
 _enabled = False                  # the fast-path bool; see enable()/disable()
-_registry = {}                    # (name, key) -> CostRecord
+# plain dict when tsan-lite is off; armed, every mutation asserts _lock
+# is held (the shared-structure half of the mx.check concurrency sweep)
+_registry = _locklint.guarded_dict(_lock, "inspect.registry")
+# (name, key) -> CostRecord
 _last_live_dump = 0.0
 _LIVE_DUMP_INTERVAL = 30.0        # seconds between inspect_dir refreshes
 

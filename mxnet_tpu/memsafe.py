@@ -49,8 +49,9 @@ is set) — the same trade `mx.inspect` makes.
 from __future__ import annotations
 
 import sys
-import threading
 import time
+
+from . import _locklint
 
 from . import config as _config
 from . import diagnostics as _diagnostics
@@ -67,7 +68,7 @@ __all__ = [
     "last_headroom_bytes", "snapshot",
 ]
 
-_lock = threading.RLock()
+_lock = _locklint.make_rlock("memsafe.state")
 _enabled = False              # the fast-path bool; hook sites read it directly
 _last_check = None            # dict of the most recent pre-flight check
 _transitions = []             # degradation-ladder transitions this process
@@ -139,11 +140,8 @@ class SimulatedResourceExhausted(RuntimeError):
 
 def _fmt(n):
     """Human bytes for error messages: '1.50 GiB (1610612736 bytes)'."""
-    n = int(n)
-    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
-        if abs(n) >= div:
-            return f"{n / div:.2f} {unit} ({n} bytes)"
-    return f"{n} bytes"
+    from .util import fmt_bytes
+    return fmt_bytes(n, show_raw=True)
 
 
 def is_oom(exc):
@@ -315,32 +313,40 @@ def check_budget(executable, exec_peak, resident, capacity=None):
     return _last_check
 
 
-def _analyze(jitted, args):
+def _analyze(jitted, args, traced=None):
     """AOT lower+compile purely for memory analysis;
     (exec_peak, compiled, error). With compile_cache_dir set the real
     first call deserializes this same executable warm. Never raises — a
     backend that cannot lower out of line degrades the check to
-    resident-state accounting."""
+    resident-state accounting. `traced`: a pre-computed jax Traced (from
+    mx.check's lint of the same miss) lowered directly, so check+memsafe
+    together cost one trace per miss, not two."""
     try:
+        if traced is not None:
+            try:
+                compiled = traced.lower().compile()
+                return compiled_exec_peak(compiled), compiled, None
+            except Exception:   # stale/unlowerable trace: re-derive
+                pass
         compiled = jitted.lower(*args).compile()
     except Exception as e:  # noqa: BLE001 — degrade, never block dispatch
         return None, None, f"{type(e).__name__}: {e}"
     return compiled_exec_peak(compiled), compiled, None
 
 
-def _preflight(name, key, jitted, args, collectives=None):
+def _preflight(name, key, jitted, args, collectives=None, traced=None):
     """Shared preflight body: with no known capacity there is nothing to
     check, so the (expensive) analysis compile is skipped entirely and
     only the resident accounting is recorded. When the analysis does run
     and mx.inspect is enabled, the compiled object is handed to inspect's
     registry too — the pair then costs ONE extra compile per miss, not
     two (the hook sites skip their own analyze_jit via the returned
-    'inspect_recorded' flag)."""
+    'inspect_recorded' flag). `traced` likewise shares mx.check's trace."""
     capacity = capacity_bytes()
     resident = resident_bytes(args)
     if capacity is None:
         return check_budget(name, None, resident, capacity=None)
-    exec_peak, compiled, err = _analyze(jitted, args)
+    exec_peak, compiled, err = _analyze(jitted, args, traced=traced)
     check = check_budget(name, exec_peak, resident, capacity=capacity)
     if err is not None:
         check["analysis_error"] = err
@@ -353,7 +359,7 @@ def _preflight(name, key, jitted, args, collectives=None):
     return check
 
 
-def preflight_step(trainer, key, jitted, args):
+def preflight_step(trainer, key, jitted, args, traced=None):
     """Pre-flight budget check for one freshly built ShardedTrainer step
     executable, BEFORE its first dispatch: AOT-analyze the execution
     footprint, add the resident train state + staged batch (== the call
@@ -361,14 +367,15 @@ def preflight_step(trainer, key, jitted, args):
     overrun (nothing was dispatched; donated buffers are intact)."""
     name = f"ShardedTrainer({type(trainer.block).__name__})"
     return _preflight(name, key, jitted, args,
-                      collectives=getattr(trainer, "_coll_est", None))
+                      collectives=getattr(trainer, "_coll_est", None),
+                      traced=traced)
 
 
-def preflight_jit(name, key, jitted, args):
+def preflight_jit(name, key, jitted, args, traced=None):
     """Pre-flight check for one freshly built HybridBlock executable
     (forward path): resident state is the parameters + inputs the call
     will hold live."""
-    return _preflight(name, key, jitted, args)
+    return _preflight(name, key, jitted, args, traced=traced)
 
 
 def last_check():

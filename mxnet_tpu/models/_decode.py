@@ -106,7 +106,7 @@ def beam_search_loop(logits0, step, reorder, B, beam, eos, max_steps,
     return seqs[idx, best], norm[idx, best]
 
 
-def jit_flat_step(model, step_fn, n_state):
+def jit_flat_step(model, step_fn, n_state, donate_state=0):
     """step_fn(*leading, flat_state: list) -> (primary, new_state: list).
 
     `model` MUST be the block whose parameters step_fn uses: registering
@@ -115,11 +115,23 @@ def jit_flat_step(model, step_fn, n_state):
     decoding silently freezes at the weights of the first compile
     (pinned by tests/train/test_decode.py::test_decode_sees_updated_weights).
 
+    `donate_state`: how many LEADING entries of the flat state are
+    threaded through the call (passed in, returned as new state) and
+    therefore DONATED to the executable. Without donation every decode
+    step double-buffers the whole KV cache — the old buffers stay live
+    while XLA allocates the new ones (the mx.check `donation-miss`
+    finding that motivated this parameter). Callers must not touch a
+    donated buffer after the call: thread the RETURNED state, as both
+    decode loops already do. Read-only state entries (e.g. the NMT
+    encoder K/V, re-passed every step) go AFTER the donated prefix and
+    keep their buffers.
+
     Returns run(*leading_arrays, state_list) -> (primary, new_state) with
     everything jitted; `leading` are the per-call scalars/arrays before the
-    flat state (token ids, step index, masks, constant caches...)."""
+    flat state (token ids, step index, masks...)."""
     import jax
 
+    from .. import check as _check
     from ..gluon.block import functional_call
 
     class _Step(HybridBlock):
@@ -133,14 +145,35 @@ def jit_flat_step(model, step_fn, n_state):
             return tuple([primary] + list(new_state))
 
     pure, gp, aux = functional_call(_Step(), train=False)
-    jitted = jax.jit(pure)
     rng = jax.random.key(0)
+    # donate_argnums are positional, so the jit is built per leading
+    # arity (fixed per call site in practice) on the first call
+    cache = {}
 
     def run(*args):
         leading, state = args[:-1], list(args[-1])
         gp_data = [p.data()._data for _, p in gp]
         aux_data = [p.data()._data for _, p in aux]
-        outs, _ = jitted(gp_data, aux_data, rng, *leading, *state)
+        base = 3 + len(leading)     # gp_data, aux_data, rng come first
+        donate = tuple(range(base, base + int(donate_state)))
+        entry = cache.get(len(leading))
+        is_miss = entry is None
+        if is_miss:
+            entry = cache[len(leading)] = jax.jit(
+                pure, donate_argnums=donate)
+        if is_miss and _check._enabled:
+            try:
+                _check.check_jit(
+                    f"decode_step({type(model).__name__})",
+                    (len(leading), n_state,
+                     tuple(tuple(getattr(s, "shape", ())) for s in state)),
+                    entry, (gp_data, aux_data, rng) + leading
+                    + tuple(state), donate_argnums=donate,
+                    can_donate=True)
+            except _check.CheckError:
+                cache.pop(len(leading), None)
+                raise
+        outs, _ = entry(gp_data, aux_data, rng, *leading, *state)
         return outs[0], list(outs[1:])
 
     return run

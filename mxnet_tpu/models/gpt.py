@@ -290,7 +290,12 @@ class GPTForCausalLM(HybridBlock):
                     tok, t, flat[:n_l], flat[n_l:])
                 return logits, nk + nv
 
-            run_flat = jit_flat_step(self, step, 2 * n_l)
+            # the K/V caches are threaded through every step: donate them
+            # (old cache buffers die into the new ones instead of
+            # double-buffering 2*n_l full-length caches per token —
+            # mx.check `donation-miss`)
+            run_flat = jit_flat_step(self, step, 2 * n_l,
+                                     donate_state=2 * n_l)
 
             def run(tok, t, sk, sv):
                 logits, state = run_flat(tok, t, sk + sv)
@@ -340,7 +345,11 @@ class GPTForCausalLM(HybridBlock):
                     prompt_nd._data, lp_nd._data, [f._data for f in flat])
                 return logits, ks + vs
 
-            self._gen_cache[key] = jit_flat_step(self, pre, 2 * n_l)
+            # the zeroed caches passed in alias straight into the filled
+            # ones coming out (donated: no transient double allocation of
+            # the full-length K/V at prefill)
+            self._gen_cache[key] = jit_flat_step(self, pre, 2 * n_l,
+                                                 donate_state=2 * n_l)
         return self._gen_cache[key]
 
     def _alloc_caches(self, B, max_len):

@@ -259,7 +259,13 @@ class TransformerNMT(HybridBlock):
                     flat[2 * n_l:3 * n_l], flat[3 * n_l:])
                 return logits, nk + nv   # enc caches are read-only inputs
 
-            run_flat = jit_flat_step(self, step, 4 * n_l)
+            # self-attention caches (the leading 2*n_l state entries) are
+            # threaded through every step: donate them so the old cache
+            # buffers die into the new ones (mx.check `donation-miss`).
+            # The encoder K/V (trailing 2*n_l) are READ-ONLY re-passed
+            # inputs — never donated
+            run_flat = jit_flat_step(self, step, 4 * n_l,
+                                     donate_state=2 * n_l)
 
             def run(tok, t, enc_mask_d, sk, sv, ek, ev):
                 logits, state = run_flat(tok, t, enc_mask_d,

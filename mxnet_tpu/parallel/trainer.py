@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from .. import random as _random
 from .. import _engine
+from .. import check as _check
 from .. import config as _config
 from .. import diagnostics as _diagnostics
 from .. import inspect as _inspect
@@ -95,10 +96,12 @@ class ShardedTrainer:
         # this many microbatches, accumulating grads — loss/grad parity
         # with the full batch up to reduction order
         self._accum = 1
-        # arm memsafe iff its knobs ask (oom_recover=auto /
-        # device_bytes_limit): construction-time config reads only — the
-        # step hot path keeps its single module-bool check
+        # arm memsafe/check iff their knobs ask (oom_recover=auto /
+        # device_bytes_limit / check!=off): construction-time config
+        # reads only — the step hot path keeps its single module-bool
+        # check per subsystem
         _memsafe.maybe_enable()
+        _check.maybe_enable()
         # persistent XLA compilation cache (compile_cache_dir knob): wired
         # once, at first trainer construction, before anything compiles
         from .. import dataflow as _dataflow
@@ -466,6 +469,30 @@ class ShardedTrainer:
         batch = [b if getattr(b, "sharding", None) == s
                  else jax.device_put(b, s)
                  for b, s in zip(batch, shardings)]
+        lint_traced = None
+        if is_miss and _check._enabled:
+            # mx.check graph lint for the fresh step executable, BEFORE
+            # its first dispatch (trace-only — no compile, no transfer;
+            # the global RNG key is read without advancing the stream):
+            # donation misses, baked constants, dtype promotions,
+            # degenerate sharding, retrace hazards. The trace is handed
+            # to memsafe's preflight below so check+memsafe together
+            # cost ONE trace per miss, not two
+            lint_args = (self.params, self.aux, self.opt_state,
+                         self._t_dev) + scalars \
+                + (_random.get_state(),) + tuple(batch)
+            if _memsafe._enabled:
+                lint_traced = _check.trace_jit(self._step_cache[key],
+                                               lint_args)
+            try:
+                _check.check_step(self, key, self._step_cache[key],
+                                  lint_args, batch=batch,
+                                  traced=lint_traced)
+            except _check.CheckError:
+                # check=error: the rejected executable must not stay
+                # cached — a retried same-shape call would skip the lint
+                del self._step_cache[key]
+                raise
         # StepTraceAnnotation: jax.profiler device traces group work by
         # train step (the reference profiler's per-iteration ranges —
         # SURVEY §5.1); free when no trace is active
@@ -493,7 +520,8 @@ class ShardedTrainer:
                     prefl = _memsafe.preflight_step(
                         self, key, self._step_cache[key],
                         (self.params, self.aux, self.opt_state,
-                         self._t_dev) + scalars + (rngk,) + tuple(batch))
+                         self._t_dev) + scalars + (rngk,) + tuple(batch),
+                        traced=lint_traced)
                 except _memsafe.MemoryBudgetError:
                     # a rejected executable must not stay cached: a
                     # retried same-shape call would hit the cache and

@@ -17,6 +17,8 @@ import os
 import threading
 import time
 
+from . import _locklint
+
 __all__ = [
     "set_config", "set_state", "start", "stop", "pause", "resume",
     "dump", "dumps", "get_summary", "Domain", "Scope", "scope", "Task",
@@ -24,7 +26,7 @@ __all__ = [
     "Event", "Counter", "Marker", "start_jax_trace", "stop_jax_trace",
 ]
 
-_lock = threading.Lock()
+_lock = _locklint.make_lock("profiler.records")
 _config = {
     "filename": "profile.json",
     "aggregate_stats": False,

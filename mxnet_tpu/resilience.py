@@ -57,6 +57,7 @@ import threading
 import time
 import zlib
 
+from . import _locklint
 from . import config as _config
 from . import telemetry as _telemetry
 
@@ -81,7 +82,7 @@ EXIT_PREEMPTED = 83
 EXIT_SHRINK = 84
 EXIT_GROW = 85
 
-_lock = threading.RLock()
+_lock = _locklint.make_rlock("resilience.state")
 _enabled = False          # the fast-path bool: trainer hooks check ONLY this
 _installed = False        # signal handlers chained
 _prev_handlers = {}

@@ -43,9 +43,9 @@ import bisect
 import collections
 import json
 import os
-import threading
 import time
 
+from . import _locklint
 from . import config
 
 __all__ = [
@@ -58,9 +58,14 @@ __all__ = [
 
 # RLock: exporters render whole metric trees (children, percentiles) under
 # the lock, and percentile() itself locks — hot-path updates still take it
-# exactly once
-_lock = threading.RLock()
-_metrics = {}                     # name -> metric object
+# exactly once. Created through the mx.check instrumented-lock factory:
+# the plain RLock when MXNET_TPU_CHECK_THREADS is off (zero overhead),
+# the order-recording CheckedLock under the tsan-lite CI sweep
+_lock = _locklint.make_rlock("telemetry.registry")
+# plain dict when tsan-lite is off; armed, every mutation asserts _lock
+# is held (the shared-structure half of the mx.check concurrency sweep)
+_metrics = _locklint.guarded_dict(_lock, "telemetry.metrics")
+# name -> metric object
 _MAX_EVENTS = 100_000             # drop-oldest bound on the buffer
 _events = collections.deque(maxlen=_MAX_EVENTS)   # cleared on flush
 _dropped_events = 0

@@ -7,7 +7,20 @@ from __future__ import annotations
 import functools
 import os
 
-__all__ = ["makedirs", "is_np_array", "use_np", "getenv", "setenv"]
+__all__ = ["makedirs", "is_np_array", "use_np", "getenv", "setenv",
+           "fmt_bytes"]
+
+
+def fmt_bytes(n, show_raw=False):
+    """Human-readable byte count: '1.50 GiB', or with show_raw
+    '1.50 GiB (1610612736 bytes)' — shared by mx.memsafe error messages
+    and mx.check findings so the two subsystems format identically."""
+    n = int(n)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            human = f"{n / div:.2f} {unit}"
+            return f"{human} ({n} bytes)" if show_raw else human
+    return f"{n} bytes" if show_raw else f"{n} B"
 
 
 def makedirs(d):

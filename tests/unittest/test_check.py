@@ -1,0 +1,717 @@
+"""mx.check tests: the seeded-hazard matrix (one deliberately-bad
+model/trainer per graph-lint rule asserting the finding fires) next to
+the clean dense/BERT/GPT paths asserting ZERO false positives; the
+lock-order cycle detector on the PR 5 launch.py deadlock shape (both
+acquisition stacks reported); the AST rules with positive fixtures the
+rule must flag and negative fixtures that must pass; check=off
+zero-overhead; and check=error raising CheckError naming rule, location,
+and remediation."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _locklint, check, config, dataflow, nd, parallel
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.ndarray import NDArray
+
+
+@pytest.fixture(autouse=True)
+def _clean_check():
+    yield
+    check.disable()
+    check.reset()
+    _locklint.disarm()
+    _locklint.reset()
+    telemetry.reset()
+    telemetry.disable()
+    config.reset()
+
+
+def _xy(batch=16, in_units=8, out_units=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(batch, in_units).astype(np.float32)),
+            nd.array(np.zeros((batch, out_units), np.float32)))
+
+
+def _dense_trainer(seed=0, **kwargs):
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: lfn(o, l), "sgd",
+        {"learning_rate": 0.1}, **kwargs), net
+
+
+def _rules_of(findings):
+    return [f["rule"] for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead off path
+# ---------------------------------------------------------------------------
+
+def test_check_off_is_zero_overhead(monkeypatch):
+    """check=off (default): zero analyzer calls on the trainer and block
+    hot paths — the hook sites reduce to one module-bool check (the same
+    contract ci/run.sh sanity asserts)."""
+    assert not check.enabled()
+    calls = {"jit": 0, "step": 0, "lint": 0}
+    real_jit, real_step, real_lint = (check.check_jit, check.check_step,
+                                      check.lint_jaxpr)
+    monkeypatch.setattr(check, "check_jit", lambda *a, **k: (
+        calls.__setitem__("jit", calls["jit"] + 1), real_jit(*a, **k))[1])
+    monkeypatch.setattr(check, "check_step", lambda *a, **k: (
+        calls.__setitem__("step", calls["step"] + 1),
+        real_step(*a, **k))[1])
+    monkeypatch.setattr(check, "lint_jaxpr", lambda *a, **k: (
+        calls.__setitem__("lint", calls["lint"] + 1),
+        real_lint(*a, **k))[1])
+    tr, _ = _dense_trainer()
+    x, y = _xy()
+    for _ in range(3):
+        tr.step(x, y)
+    net2 = nn.Dense(4, in_units=8)
+    net2.initialize()
+    net2.hybridize()
+    net2(x)
+    assert calls == {"jit": 0, "step": 0, "lint": 0}
+    assert check.findings() == []
+
+
+def test_maybe_enable_from_knob():
+    config.set("check", "warn")
+    assert not check.enabled()
+    _dense_trainer()
+    assert check.enabled()
+
+
+# ---------------------------------------------------------------------------
+# seeded-hazard matrix: graph-lint rules fire
+# ---------------------------------------------------------------------------
+
+def test_donation_miss_fires_on_donate_false_and_not_on_default():
+    check.enable("warn")
+    tr, _ = _dense_trainer(donate=False)
+    x, y = _xy()
+    tr.step(x, y)
+    found = check.findings("donation-miss")
+    assert len(found) == 1
+    f = found[0]
+    assert "donate=False" in f["message"]
+    assert "ShardedTrainer(Dense)" == f["location"]
+    assert f["details"]["nbytes"] > 0
+    # the clean default (donate=True) trainer records nothing
+    check.reset()
+    tr2, _ = _dense_trainer(seed=1)
+    tr2.step(x, y)
+    assert check.findings("donation-miss") == []
+
+
+class _CacheStep(HybridBlock):
+    """Decode-style state threading: a cache rides through the call."""
+
+    def __init__(self):
+        super().__init__()
+        self.proj = nn.Dense(64, in_units=64, flatten=False)
+
+    def forward(self, x, cache):
+        import jax.numpy as jnp
+        h = self.proj(x)
+        new_cache = NDArray(cache._data + jnp.mean(h._data))
+        return h, new_cache
+
+
+def test_donation_miss_fires_on_undonated_state_threading():
+    """jit_flat_step-shaped hazard: a big cache goes in and comes out
+    un-donated -> double-buffered; donating it clears the finding."""
+    from mxnet_tpu.models._decode import jit_flat_step
+    check.enable("warn")
+    config.set("check_donation_min_bytes", 1 << 16)
+    mx.random.seed(0)
+    net = _CacheStep()
+    net.initialize()
+
+    def step(tok, flat):
+        h, new_cache = net(tok, flat[0])
+        return h, [new_cache]
+
+    cache = nd.array(np.zeros((8, 64, 64), np.float32))   # 128 KiB
+    tok = nd.array(np.ones((8, 4, 64), np.float32))
+    run = jit_flat_step(net, step, 1)        # donate_state=0: the hazard
+    run(tok._data, [cache._data])
+    found = check.findings("donation-miss")
+    assert len(found) == 1
+    assert "decode_step(_CacheStep)" in found[0]["location"]
+    assert found[0]["details"]["n_buffers"] == 1
+    # the fixed spelling (donate_state=1) lints clean
+    check.reset()
+    net2 = _CacheStep()
+    net2.initialize()
+
+    def step2(tok, flat):
+        h, new_cache = net2(tok, flat[0])
+        return h, [new_cache]
+
+    run2 = jit_flat_step(net2, step2, 1, donate_state=1)
+    cache2 = nd.array(np.zeros((8, 64, 64), np.float32))
+    out, state = run2(tok._data, [cache2._data])
+    assert check.findings("donation-miss") == []
+    # and the donated state is really threaded: next call works off the
+    # RETURNED buffer
+    out, state = run2(tok._data, state)
+    assert state[0].shape == (8, 64, 64)
+
+
+class _BakedConst(HybridBlock):
+    def __init__(self, big):
+        super().__init__()
+        self._big = big          # plain attribute: traces as a CONSTANT
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        return NDArray(x._data @ jnp.asarray(self._big))
+
+
+def test_large_constant_fires_and_names_block():
+    check.enable("warn")
+    config.set("check_large_const_bytes", 1024)
+    big = np.ones((64, 64), np.float32)      # 16 KiB >= 1 KiB threshold
+    net = _BakedConst(big)
+    net.hybridize()
+    net(nd.array(np.ones((8, 64), np.float32)))
+    found = check.findings("large-constant")
+    assert len(found) == 1
+    assert found[0]["location"] == "_BakedConst"
+    assert "(64, 64)" in found[0]["message"]
+    assert found[0]["details"]["nbytes"] == big.nbytes
+    assert "Parameter" in found[0]["remediation"]
+
+
+class _SilentPromo(HybridBlock):
+    def forward(self, x):
+        import jax.numpy as jnp
+        h = x._data.astype(jnp.bfloat16)
+        # np.float32 is NOT weakly typed: the whole tensor promotes
+        return NDArray(np.float32(2.0) * h)
+
+
+class _WeakScalar(HybridBlock):
+    def forward(self, x):
+        import jax.numpy as jnp
+        h = x._data.astype(jnp.bfloat16)
+        return NDArray(2.0 * h)    # python scalar: stays bf16
+
+
+def test_dtype_promotion_fires_on_nonweak_scalar_only():
+    check.enable("warn")
+    config.set("check_promotion_min_bytes", 1024)
+    x = nd.array(np.ones((32, 64), np.float32))
+    bad = _SilentPromo()
+    bad.hybridize()
+    bad(x)
+    found = check.findings("dtype-promotion")
+    assert len(found) == 1
+    assert found[0]["details"]["src"] == "bfloat16"
+    assert found[0]["details"]["dst"] == "float32"
+    # weakly-typed python scalar: no promotion, no finding
+    check.reset()
+    good = _WeakScalar()
+    good.hybridize()
+    good(x)
+    assert check.findings("dtype-promotion") == []
+
+
+def test_retrace_hazard_fires_on_varlen_axis_and_not_when_bucketed():
+    check.enable("warn")
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    for L in (8, 12, 16, 20):     # 4 distinct sizes = the default limit
+        net(nd.array(np.ones((L, 8), np.float32)))
+    found = check.findings("retrace-hazard")
+    assert len(found) == 1
+    assert found[0]["details"] == {"input": 0, "axis": 0,
+                                   "sizes": [8, 12, 16, 20]}
+    assert "BucketPad" in found[0]["remediation"]
+    # the bucketed stream is the rule's own remediation: even when the
+    # bucket COUNT reaches the limit, a pow2 bucket set (BucketPad's
+    # default policy output) is bounded, not a hazard
+    check.reset()
+    net2 = nn.Dense(4, in_units=8)
+    net2.initialize()
+    net2.hybridize()
+    bp = dataflow.BucketPad(axis_buckets={0: [32, 64, 128, 256]},
+                            append_valid_length=False)
+    for L in (20, 50, 100, 200):     # 4 distinct buckets = the limit
+        net2(nd.array(bp(np.ones((L, 8), np.float32))))
+    assert check.findings("retrace-hazard") == []
+
+
+class _Residual(HybridBlock):
+    """Shape-preserving forward: output aval == input aval, as in every
+    residual/layernorm block — NOT state threading."""
+
+    def __init__(self):
+        super().__init__()
+        self.proj = nn.Dense(64, in_units=64, flatten=False)
+
+    def forward(self, x):
+        return x + self.proj(x)
+
+
+def test_donation_miss_does_not_fire_on_shape_preserving_forward():
+    """The block forward surface (`net(x)`) cannot express donation, so
+    y = f(x) merely SHARING x's shape+dtype must not fire — only call
+    sites that can donate (trainer step, jit_flat_step) run the
+    state-threading detector."""
+    check.enable("warn")
+    config.set("check_donation_min_bytes", 1024)
+    net = _Residual()
+    mx.random.seed(0)
+    net.initialize()
+    net.hybridize()
+    net(nd.array(np.ones((4096, 64), np.float32)))    # 1 MiB in == out
+    assert check.findings("donation-miss") == []
+
+
+def test_retrace_history_is_per_instance_not_per_class():
+    """Four independent blocks of the SAME class, each compiled exactly
+    once at a different batch size: nothing retraced, so nothing fires —
+    the signature history keys on the instance, not the class name."""
+    check.enable("warn")
+    for L in (8, 16, 32, 64):
+        net = nn.Dense(4, in_units=8)
+        net.initialize()
+        net.hybridize()
+        net(nd.array(np.ones((L, 8), np.float32)))
+    assert check.findings("retrace-hazard") == []
+
+
+def test_retrace_hazard_fires_on_baked_lr_scalar():
+    """The in-jit constant-lr executable keys on the lr VALUE: a
+    set_learning_rate loop re-jits per value — predicted after
+    check_retrace_limit distinct values, before the telemetry
+    recompile-cause diff would have to explain each one after the fact."""
+    check.enable("warn")
+    tr, _ = _dense_trainer()
+    x, y = _xy()
+    for i in range(4):
+        tr._opt.set_learning_rate(0.1 / (i + 1))
+        tr.step(x, y)
+    found = check.findings("retrace-hazard")
+    assert len(found) == 1
+    assert found[0]["details"]["slot"] == "learning-rate"
+    assert "lr_traced" in found[0]["remediation"]
+
+
+def test_degenerate_sharding_fires_on_replicated_params():
+    check.enable("warn")
+    config.set("check_replicated_min_bytes", 64)   # everything is "large"
+    tr, _ = _dense_trainer()                       # replicate over dp=8
+    x, y = _xy()
+    tr.step(x, y)
+    found = check.findings("degenerate-sharding")
+    assert len(found) == 1
+    assert "replicated" in found[0]["message"]
+    assert "mx.zero" in found[0]["remediation"]
+    assert found[0]["details"]["devices"] > 1
+    # fsdp mode shards the state: no replicated-params finding
+    check.reset()
+    config.set("fsdp_min_size", 1)
+    parallel.make_mesh(fsdp=-1)
+    mx.random.seed(1)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    tr2 = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                                  {"learning_rate": 0.1},
+                                  param_mode="fsdp")
+    tr2.step(x, y)
+    assert not any("params" in f["message"]
+                   for f in check.findings("degenerate-sharding"))
+
+
+# ---------------------------------------------------------------------------
+# clean paths: zero false positives at default thresholds
+# ---------------------------------------------------------------------------
+
+def test_owner_tokens_are_unique_across_reconstruction():
+    """Retrace history keys on a per-instance token, not id(): a freed
+    instance's recycled address must not hand its history to a new one."""
+    a = nn.Dense(4, in_units=8)
+    ta = check.owner_token(a)
+    del a
+    b = nn.Dense(4, in_units=8)
+    tb = check.owner_token(b)
+    assert ta != tb
+    assert check.owner_token(b) == tb      # stable per instance
+
+
+def test_check_graph_zoo_error_mode_reports_per_model(tmp_path):
+    """--check error: a finding aborts that model's drive but the CLI
+    still prints the per-model report for every --model and exits via
+    the findings-based contract, not an unhandled traceback."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_TPU_CHECK_REPLICATED_MIN_BYTES="64")
+    r = subprocess.run(
+        [sys.executable, "tools/check_graph.py", "--model", "dense",
+         "--model", "dense", "--check", "error", "--steps", "1"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    assert r.returncode == 1, (r.returncode, r.stderr[-1500:])
+    assert "Traceback" not in r.stderr, r.stderr[-1500:]
+    assert r.stdout.count("check_graph: dense:") == 2, r.stdout
+    assert "degenerate-sharding" in r.stdout
+
+
+def test_clean_dense_bert_gpt_paths_have_zero_findings():
+    check.enable("warn")
+    from tools.autofit import build
+    for model in ("dense", "bert_tiny", "gpt_tiny"):
+        before = len(check.findings())
+        trainer, make_batch = build(model, "sgd", None)
+        data, labels = make_batch(8)
+        for _ in range(2):
+            trainer.step(data, labels)
+        trainer.block.hybridize()
+        try:
+            trainer.block(*data)
+        except Exception:
+            pass
+        assert check.findings()[before:] == [], \
+            f"{model}: {check.findings()[before:]}"
+
+
+# ---------------------------------------------------------------------------
+# check=error semantics
+# ---------------------------------------------------------------------------
+
+def test_check_error_raises_and_evicts():
+    check.enable("error")
+    config.set("check_large_const_bytes", 1024)
+    big = np.ones((64, 64), np.float32)
+    net = _BakedConst(big)
+    net.hybridize()
+    x = nd.array(np.ones((8, 64), np.float32))
+    with pytest.raises(check.CheckError) as ei:
+        net(x)
+    msg = str(ei.value)
+    assert "large-constant" in msg          # the rule
+    assert "_BakedConst" in msg             # the location
+    assert "Parameter" in msg               # the remediation
+    assert ei.value.finding["rule"] == "large-constant"
+    # the rejected executable was evicted AND the dedupe does not swallow
+    # the error-mode raise: the unfixed hazard keeps blocking on retry
+    # (a deduped-silent retry would dispatch the hazardous executable)
+    with pytest.raises(check.CheckError):
+        net(x)
+    with pytest.raises(check.CheckError):
+        net(x)
+    assert len(check.findings()) == 1     # recorded once, raised thrice
+    # back to warn: the same call goes through and records instead
+    check.reset()
+    config.set("check", "warn")
+    out = net(x)
+    assert out.shape == (8, 64)
+    assert _rules_of(check.findings()) == ["large-constant"]
+
+
+def test_suppress_context_manager():
+    check.enable("error")
+    config.set("check_large_const_bytes", 1024)
+    net = _BakedConst(np.ones((64, 64), np.float32))
+    net.hybridize()
+    x = nd.array(np.ones((8, 64), np.float32))
+    with check.suppress("large-constant"):
+        out = net(x)                        # no raise, no record
+    assert out.shape == (8, 64)
+    assert check.findings() == []
+
+
+def test_findings_surface_in_telemetry():
+    telemetry.enable()
+    check.enable("warn")
+    config.set("check_large_const_bytes", 1024)
+    net = _BakedConst(np.ones((64, 64), np.float32))
+    net.hybridize()
+    net(nd.array(np.ones((8, 64), np.float32)))
+    c = telemetry.counter("check_findings_total")
+    assert c.labels(rule="large-constant").value == 1
+    evs = telemetry.events("check")
+    assert evs and evs[-1]["rule"] == "large-constant"
+
+
+def test_dump_and_check_graph_report(tmp_path):
+    check.enable("warn")
+    config.set("check_dir", str(tmp_path / "check"))
+    config.set("check_large_const_bytes", 1024)
+    net = _BakedConst(np.ones((64, 64), np.float32))
+    net.hybridize()
+    net(nd.array(np.ones((8, 64), np.float32)))
+    path = check.dump()
+    assert path and os.path.exists(path)
+    snap = json.load(open(path))
+    assert snap["counts"] == {"large-constant": 1}
+    from tools.check_graph import load_dumps, render_report
+    dumps = load_dumps(str(tmp_path / "check"))
+    assert len(dumps) == 1
+    assert render_report(dumps) == 1        # findings -> exit 1
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the lock-order race detector (tsan-lite)
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_reports_both_stacks():
+    """The PR 5 launch.py deadlock pattern on a synthetic fixture: one
+    context takes A then B, another takes B then A. The detector flags
+    the cycle at the SECOND acquisition — from an interleaving that did
+    not deadlock — and reports both acquisition stacks."""
+    _locklint.arm()
+    _locklint.reset()
+    A = _locklint.make_lock("fixture.reaper")
+    B = _locklint.make_lock("fixture.waitpid")
+
+    def main_loop():         # holds reaper, then takes waitpid
+        with A:
+            with B:
+                pass
+
+    t = threading.Thread(target=main_loop)
+    t.start()
+    t.join()
+
+    err = []
+
+    def signal_handler():    # holds waitpid, then takes reaper: cycle
+        try:
+            with B:
+                with A:
+                    pass
+        except _locklint.LockOrderError as e:
+            err.append(e)
+
+    t = threading.Thread(target=signal_handler)
+    t.start()
+    t.join()
+    assert err, "cycle not detected"
+    f = err[0].finding
+    assert f["rule"] == "lock-order-cycle"
+    assert set(f["locks"]) == {"fixture.reaper", "fixture.waitpid"}
+    fwd = f["stacks"]["forward"]["acquiring"]
+    rev = f["stacks"]["reverse"]["acquiring"]
+    assert fwd and "signal_handler" in fwd[-1]
+    assert rev and "main_loop" in rev[-1]
+    # surfaced through mx.check alongside the graph findings
+    tf = check.thread_findings()
+    assert any(t["rule"] == "lock-order-cycle" for t in tf)
+
+
+def test_self_deadlock_and_reentrant_ok():
+    _locklint.arm()
+    _locklint.reset()
+    L = _locklint.make_lock("fixture.plain")
+    L.acquire()
+    with pytest.raises(_locklint.LockOrderError, match="re-acquire") as ei:
+        L.acquire()
+    L.release()
+    # BOTH sides reported: the original acquire (this test body) and the
+    # re-acquire — not two copies of the same stack
+    stacks = ei.value.finding["stacks"]
+    assert any("test_self_deadlock" in fr for fr in stacks["holding"])
+    assert stacks["holding"] != stacks["acquiring"]
+    R = _locklint.make_rlock("fixture.reentrant")
+    with R:
+        with R:        # legal: reentrant
+            pass
+    assert _locklint.cycles() == [c for c in _locklint.cycles()
+                                  if c["kind"] == "self-deadlock"]
+
+
+def test_unguarded_mutation_detected():
+    _locklint.arm()
+    _locklint.reset()
+    G = _locklint.make_lock("fixture.guard")
+    d = _locklint.guarded_dict(G, "fixture.shared")
+    with G:
+        d["ok"] = 1
+    with pytest.raises(_locklint.LockOrderError, match="without holding"):
+        d["bad"] = 2
+    assert _locklint.unguarded_mutations()
+    tf = [t for t in check.thread_findings()
+          if t["rule"] == "unguarded-mutation"]
+    assert tf
+    # rendered with the STRUCTURE as location and a mutation-specific
+    # remediation (not the lock-cycle boilerplate)
+    assert tf[0]["location"] == "fixture.shared"
+    assert "fixture.guard" in tf[0]["remediation"]
+    assert "acquisition order" not in tf[0]["remediation"]
+
+
+def test_disarmed_factories_return_plain_primitives():
+    assert not _locklint.armed()
+    lk = _locklint.make_lock("x")
+    rlk = _locklint.make_rlock("y")
+    assert type(lk) is type(threading.Lock())
+    assert type(rlk) is type(threading.RLock())
+    assert type(_locklint.guarded_dict(lk, "z")) is dict
+
+
+def test_instrumented_modules_survive_tsan_mode():
+    """telemetry's registry (lock + guarded hot paths) works under the
+    armed wrapper: the tsan-lite sweep runs the real test suite this
+    way, so the wrapper must be a faithful lock."""
+    _locklint.arm()
+    lk = _locklint.make_rlock("fixture.registry")
+    results = []
+
+    def writer(i):
+        for _ in range(200):
+            with lk:
+                results.append(i)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 800
+    assert _locklint.cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# AST rules (tools/lint_rules.py): positive + negative fixtures
+# ---------------------------------------------------------------------------
+
+from tools.lint_rules import lint_source  # noqa: E402
+
+
+def _rules_in(findings_list):
+    return sorted({f.rule for f in findings_list})
+
+
+def test_ast_shard_map_import_positive_fixtures():
+    """The two shipped spellings (bit PR 5 and PR 6) both flag."""
+    for src in (
+        "from jax.experimental.shard_map import shard_map\n",
+        "from jax import shard_map\n",
+        "import jax\nf = jax.shard_map(lambda x: x)\n",
+        "import jax.experimental.shard_map as sm\n",
+    ):
+        found = lint_source("mxnet_tpu/parallel/ring_attention.py", src)
+        assert _rules_in(found) == ["shard-map-import"], (src, found)
+
+
+def test_ast_shard_map_import_negative_fixtures():
+    # the shim itself is the one allowed home
+    src = "from jax import shard_map\n"
+    assert lint_source("mxnet_tpu/parallel/_compat.py", src) == []
+    # routing through the shim passes anywhere
+    src = "from mxnet_tpu.parallel._compat import shard_map\n"
+    assert lint_source("mxnet_tpu/parallel/pipeline.py", src) == []
+
+
+SIG_BAD = """
+import signal, subprocess
+proc = subprocess.Popen(['sleep', '1'])
+def _kill(signum, frame):
+    proc.wait()          # PR 5's exact deadlock: blocks in the handler
+signal.signal(signal.SIGTERM, _kill)
+"""
+
+SIG_BAD_LOCK = """
+import signal, threading
+_lock = threading.Lock()
+def handler(signum, frame):
+    with _lock:
+        pass
+signal.signal(signal.SIGINT, handler)
+"""
+
+SIG_GOOD = """
+import signal
+killed = {}
+def _kill(signum, frame):
+    killed['sig'] = signum    # flag only: the reap loop does the waiting
+signal.signal(signal.SIGTERM, _kill)
+signal.signal(signal.SIGINT, _kill)
+"""
+
+
+def test_ast_signal_handler_blocking():
+    found = lint_source("tools/somelauncher.py", SIG_BAD)
+    assert _rules_in(found) == ["signal-handler-blocking"]
+    assert "wait" in found[0].message
+    found = lint_source("tools/somelauncher.py", SIG_BAD_LOCK)
+    assert _rules_in(found) == ["signal-handler-blocking"]
+    assert lint_source("tools/somelauncher.py", SIG_GOOD) == []
+
+
+def test_ast_raw_lock_rule_scoped_to_instrumented_modules():
+    src = "import threading\n_lock = threading.Lock()\n"
+    found = lint_source("mxnet_tpu/telemetry.py", src)
+    assert _rules_in(found) == ["raw-lock"]
+    assert "make_lock" in found[0].message
+    # non-instrumented modules keep their raw locks
+    assert lint_source("mxnet_tpu/gluon/data/dataloader.py", src) == []
+    # the factory spelling passes in instrumented modules
+    good = ("from . import _locklint\n"
+            "_lock = _locklint.make_rlock('telemetry.registry')\n")
+    assert lint_source("mxnet_tpu/telemetry.py", good) == []
+
+
+WALLCLOCK_BAD = """
+import time, jax
+def step(x):
+    t0 = time.time()       # trace-time constant, not a runtime clock
+    return x + t0
+f = jax.jit(step)
+"""
+
+WALLCLOCK_GOOD = """
+import time, jax
+def step(x, t0):
+    return x + t0
+f = jax.jit(step)
+t = time.time()            # measured OUTSIDE the jit, passed in
+"""
+
+
+def test_ast_wallclock_in_jit():
+    found = lint_source("mxnet_tpu/somemod.py", WALLCLOCK_BAD)
+    assert _rules_in(found) == ["wallclock-in-jit"]
+    assert "trace time" in found[0].message
+    assert lint_source("mxnet_tpu/somemod.py", WALLCLOCK_GOOD) == []
+
+
+def test_ast_inline_suppression():
+    src = ("import threading\n"
+           "_lock = threading.Lock()  # mx.check: disable=raw-lock\n")
+    assert lint_source("mxnet_tpu/telemetry.py", src) == []
+    src = ("import threading\n"
+           "_lock = threading.Lock()  # mx.check: disable=all\n")
+    assert lint_source("mxnet_tpu/telemetry.py", src) == []
+
+
+def test_ast_rules_pass_on_the_repo_itself():
+    """The static CI stage's contract: the tree lints clean (the
+    satellite fixes — comm_bench shard_map routing, the instrumented-lock
+    adoption — are what made it so)."""
+    from tools.lint_rules import ALL_RULES, iter_py, lint_file
+    bad = []
+    for path in iter_py(["mxnet_tpu", "tools"]):
+        bad.extend(lint_file(path, ALL_RULES))
+    assert bad == [], [str(f) for f in bad]

@@ -19,6 +19,11 @@ tier="${1:-full}"
 if [ "$tier" = "fast" ]; then shift; else tier="full"; fi
 
 if [ "$tier" = "fast" ]; then
+    # the AST half of ci/run.sh static is seconds-cheap and catches the
+    # twice-shipped bug classes (shard_map import, handler blocking)
+    # before they reach a commit; the zoo graph lint + tsan sweep stay
+    # in the full static stage
+    python tools/lint_rules.py
     sh ci/run.sh sanity
     if [ "$#" -gt 0 ]; then
         echo "== pytest (changed area: $*) =="

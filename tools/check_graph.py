@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""check_graph — render mx.check findings, or graph-lint the model zoo.
+
+Two modes:
+
+  * **report** (default): read one `check.json` (or a `check_dir`
+    containing `<rank>/check.json` dumps from a multi-rank run), merge,
+    and print the findings grouped by rule — the mx.check analog of
+    inspect_report / postmortem_report.
+
+        python tools/check_graph.py diagnostics/check
+        python tools/check_graph.py run1/check.json
+
+  * **zoo** (`--model`, repeatable): build the named model + a
+    ShardedTrainer on the host mesh, run a couple of train steps and a
+    hybridized forward with `check=warn` armed, and print every graph-
+    lint finding. The CI `static` stage runs the standard zoo this way
+    and fails on ANY finding — the repo's own models must lint clean.
+
+        python tools/check_graph.py --model dense --model bert_tiny \\
+            --model gpt_tiny --steps 2
+
+Exit code: 0 when no findings, 1 otherwise (both modes).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# report mode
+# ---------------------------------------------------------------------------
+
+def load_dumps(target):
+    """[(rank_label, snapshot_dict)] from a file or a check_dir tree."""
+    out = []
+    if os.path.isfile(target):
+        with open(target) as f:
+            out.append((os.path.basename(os.path.dirname(target)) or "0",
+                        json.load(f)))
+        return out
+    if os.path.isdir(target):
+        for entry in sorted(os.listdir(target)):
+            p = os.path.join(target, entry, "check.json")
+            if os.path.isfile(p):
+                with open(p) as f:
+                    out.append((entry, json.load(f)))
+        direct = os.path.join(target, "check.json")
+        if not out and os.path.isfile(direct):
+            with open(direct) as f:
+                out.append(("0", json.load(f)))
+    return out
+
+
+def render_report(dumps):
+    findings = []
+    for rank, snap in dumps:
+        for f in snap.get("findings", []):
+            findings.append((rank, f))
+    print(f"mx.check report — {len(dumps)} rank dump(s), "
+          f"{len(findings)} finding(s)")
+    if not findings:
+        print("  clean: no findings recorded")
+        return 0
+    by_rule = {}
+    for rank, f in findings:
+        by_rule.setdefault(f.get("rule", "?"), []).append((rank, f))
+    for rule in sorted(by_rule):
+        fs = by_rule[rule]
+        print(f"\n[{rule}] — {len(fs)} finding(s)")
+        for rank, f in fs:
+            print(f"  rank {rank} @ {f.get('location', '?')}:")
+            print(f"    {f.get('message', '')}")
+            if f.get("remediation"):
+                print(f"    remediation: {f['remediation']}")
+            det = f.get("details") or {}
+            stacks = det.get("stacks")
+            if stacks:
+                for side, pair in stacks.items():
+                    if isinstance(pair, dict) and "acquiring" in pair:
+                        tail = pair["acquiring"][-1] \
+                            if pair["acquiring"] else "?"
+                        print(f"    {side} acquisition: {tail}")
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# zoo mode
+# ---------------------------------------------------------------------------
+
+def lint_model(model, steps, batch, optimizer):
+    """Build `model` + trainer with check armed, run `steps` train steps
+    and one hybridized forward; returns the findings recorded for it.
+    Under --check error a CheckError aborts THIS model's drive (the
+    finding it carries is still recorded/returned) without killing the
+    remaining --model entries — the CLI's contract is a per-model
+    report + findings-based exit code, not a traceback."""
+    from mxnet_tpu import check
+    from tools.autofit import build
+
+    before = len(check.findings())
+    try:
+        trainer, make_batch = build(model, optimizer, None)
+        data, labels = make_batch(batch)
+        for _ in range(max(1, steps)):
+            trainer.step(data, labels)
+        # the forward (HybridBlock jit-cache) path lints too
+        net = trainer.block
+        net.hybridize()
+        try:
+            net(*data)
+        except check.CheckError:
+            raise
+        except Exception:
+            pass    # a forward signature some models reserve for training
+    except check.CheckError as e:
+        found = check.findings()[before:]
+        if not any(f.get("rule") == e.finding.get("rule")
+                   and f.get("location") == e.finding.get("location")
+                   for f in found):
+            found = found + [e.finding]
+        return found
+    return check.findings()[before:]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render mx.check findings from dumps, or graph-lint "
+        "the model zoo (--model)")
+    ap.add_argument("target", nargs="?", default=None,
+                    help="check.json file or check_dir directory "
+                    "(report mode)")
+    ap.add_argument("--model", action="append", default=[],
+                    help="zoo mode: lint this model (dense | bert_tiny | "
+                    "gpt_tiny | ... — repeatable)")
+    ap.add_argument("--steps", type=int, default=2,
+                    help="train steps per zoo model (default 2)")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="global batch per zoo model (default 8)")
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--check", default="warn", choices=("warn", "error"),
+                    help="zoo mode check knob (default warn: collect "
+                    "everything, then exit 1 if anything fired)")
+    args = ap.parse_args(argv)
+
+    if args.model:
+        import mxnet_tpu as mx
+        from mxnet_tpu import check
+        mx.config.set("check", args.check)
+        check.enable()
+        bad = 0
+        for model in args.model:
+            found = lint_model(model, args.steps, args.batch,
+                               args.optimizer)
+            status = "clean" if not found else \
+                f"{len(found)} finding(s)"
+            print(f"check_graph: {model}: {status}")
+            for f in found:
+                print(f"  [{f['rule']}] {f['location']}: {f['message']}")
+            bad += len(found)
+        return 1 if bad else 0
+
+    if not args.target:
+        ap.error("give a check.json/check_dir target, or --model for "
+                 "zoo mode")
+    dumps = load_dumps(args.target)
+    if not dumps:
+        print(f"check_graph: no check.json found under {args.target!r}",
+              file=sys.stderr)
+        return 1
+    return render_report(dumps)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

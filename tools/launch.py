@@ -55,6 +55,33 @@ import sys
 import threading
 import time
 
+# the launcher must stay import-light (no jax, no mxnet_tpu package
+# import), but its locks ride the same mx.check tsan-lite analysis as the
+# framework's: load the stdlib-only instrumented-lock module directly by
+# path. Any failure falls back to plain threading primitives.
+def _load_locklint():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "_locklint.py")
+    spec = importlib.util.spec_from_file_location("mx_locklint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+try:
+    _locklint = _load_locklint()
+    _make_lock = _locklint.make_lock
+except Exception:   # pragma: no cover - standalone copy of this script
+    _make_lock = lambda name: threading.Lock()   # noqa: E731  # mx.check: disable=raw-lock
+
+# serializes the pump threads' line writes onto the launcher's stdout:
+# one lock, taken per line — without it two ranks' prefixed lines can
+# interleave mid-write on a pipe (found by adopting the mx.check
+# instrumented-lock sweep here; the per-rank worker.log tees stay
+# single-writer and need no lock)
+_out_lock = _make_lock("launch.stdout")
+
 # mirrors of mxnet_tpu.resilience exit codes (the launcher must stay
 # import-light — no jax): a worker exiting EXIT_PREEMPTED saved a final
 # checkpoint on SIGTERM and is safe to relaunch; EXIT_SHRINK/EXIT_GROW
@@ -105,8 +132,9 @@ def _pump(stream, rank, tee_file):
     with its rank; raw (unprefixed) lines tee into the per-rank log."""
     prefix = f"[rank {rank}] "
     for line in stream:
-        sys.stdout.write(prefix + line)
-        sys.stdout.flush()
+        with _out_lock:
+            sys.stdout.write(prefix + line)
+            sys.stdout.flush()
         if tee_file is not None:
             tee_file.write(line)
             tee_file.flush()
