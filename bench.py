@@ -79,8 +79,15 @@ def enable_compile_cache():
         print(f"# compile cache unavailable: {e}", file=sys.stderr)
 
 
-def probe_tpu(timeout=150.0, retries=3, sleep=10.0):
-    """Return True iff the TPU backend initializes in a subprocess."""
+def probe_tpu(timeout=None, retries=3, sleep=10.0):
+    """Return True iff the TPU backend initializes in a subprocess.
+    Timeout from MXNET_TPU_BENCH_PROBE_TIMEOUT_S (default 150): BENCH_r05
+    showed every CPU-fallback bench run burning the full fixed 150 s
+    here before degrading — chipless environments (CI, laptops) set the
+    knob low instead of paying the probe's worst case each run."""
+    if timeout is None:
+        timeout = float(os.environ.get("MXNET_TPU_BENCH_PROBE_TIMEOUT_S",
+                                       "150"))
     code = "import jax; assert jax.default_backend() == 'tpu'; print('OK')"
     for attempt in range(retries):
         try:
@@ -115,6 +122,7 @@ def run_bench(on_tpu):
     from mxnet_tpu import check as mxcheck
     from mxnet_tpu import diagnostics, memsafe, nd, parallel, telemetry
     from mxnet_tpu import inspect as mxinspect
+    from mxnet_tpu import trace as mxtrace
     from mxnet_tpu.models import bert as bert_mod
 
     # telemetry rides along (compile accounting happens during warmup, so
@@ -140,6 +148,11 @@ def run_bench(on_tpu):
     # configuration's graph is CLEAN — a perf trajectory whose findings
     # count creeps up caught a hazard before it cost a recompile or an OOM
     mxcheck.enable("warn")
+    # mx.trace rides along (in-memory spans, no trace_dir): the JSON line
+    # gets measured step-arrival skew and this rank's dominant span — the
+    # gang-timeline trajectory next to the throughput one. Sampled steps
+    # fence, but telemetry above already fences every step.
+    mxtrace.enable()
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
@@ -301,6 +314,13 @@ def run_bench(on_tpu):
     # configuration (0 = lint-clean; the trajectory should stay 0)
     out["check_findings"] = len(mxcheck.findings()) \
         + len(mxcheck.thread_findings())
+    # mx.trace gang-timeline fields: p99 of the measured multi-rank
+    # step-arrival spread at the collective boundary (null below 2
+    # participants — a lone process cannot measure gang skew), and this
+    # rank's dominant span as the local leg of the critical path (null on
+    # 1 device, where there is no gang to attribute)
+    out["step_skew_p99_ms"] = mxtrace.skew_p99_ms()
+    out["critical_path"] = mxtrace.critical_path() if n_dev > 1 else None
     # memory/recompute tradeoff, measured not guessed: with a remat policy
     # active (MXNET_TPU_BENCH_REMAT or the remat_policy knob), re-run the
     # same timed loop under policy='none' and report the step-time ratio

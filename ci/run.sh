@@ -115,7 +115,7 @@ import json
 d = json.load(open('/tmp/_bench_sanity.json'))
 for k in ('mfu', 'achieved_tflops', 'peak_device_bytes',
           'comm_bytes_per_step', 'memory_headroom_bytes',
-          'oom_recoveries', 'check_findings'):
+          'oom_recoveries', 'check_findings', 'step_skew_p99_ms'):
     assert k in d, f'bench JSON missing {k}: {sorted(d)}'
     assert d[k] is None or isinstance(d[k], (int, float)), (k, d[k])
 assert d.get('remat_policy') in ('none', 'dots_saveable', 'layers',
@@ -124,9 +124,18 @@ assert d['mfu'] is None, 'CPU run must report mfu null, not a number'
 assert d['achieved_tflops'] is None or d['achieved_tflops'] > 0
 assert d['check_findings'] == 0, \
     f'bench graph must lint clean, got {d[\"check_findings\"]} findings'
+# mx.trace gang fields: a single-process CPU run can measure neither
+# gang skew nor a gang critical path — both must be null, never 0
+assert d['step_skew_p99_ms'] is None, \
+    'single-process bench must report null skew, not a number'
+assert 'critical_path' in d, f'bench JSON missing critical_path'
+assert d['critical_path'] is None or isinstance(d['critical_path'],
+                                                dict), d['critical_path']
+assert d['critical_path'] is None, '1-device bench must report null'
 print('bench efficiency fields OK:', {k: d[k] for k in
       ('mfu', 'achieved_tflops', 'peak_device_bytes',
-       'comm_bytes_per_step', 'check_findings')})
+       'comm_bytes_per_step', 'check_findings', 'step_skew_p99_ms',
+       'critical_path')})
 "
     # mx.check must be disabled by default: the trainer and block hot
     # paths make zero analyzer calls (one module-bool check each), no
@@ -264,6 +273,44 @@ print('resilience disabled fast path OK (no handlers, no hashing)')
     JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_resilience.py::test_kill_and_relaunch_resumes_bit_exact \
         -q -p no:cacheprovider
+    # trace must be disabled by default: the trainer/dataflow/block hook
+    # sites make zero recorder calls (one module-bool check each), no
+    # span buffer exists, and no skew probe or annotation runs — the
+    # zero-overhead fast path
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, dataflow, trace
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not trace.enabled(), 'trace must default to off'
+calls = {'span': 0, 'skew': 0, 'ann': 0}
+real = (trace.record_span, trace.skew_tick, trace.annotate)
+trace.record_span = lambda *a, **k: (calls.__setitem__('span', calls['span'] + 1), real[0](*a, **k))[1]
+trace.skew_tick = lambda *a, **k: (calls.__setitem__('skew', calls['skew'] + 1), real[1](*a, **k))[1]
+trace.annotate = lambda *a, **k: (calls.__setitem__('ann', calls['ann'] + 1), real[2](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for d, l in dataflow.prefetch_to_mesh(iter([([x], [y])] * 3), tr, depth=2):
+    tr.step(d, l)
+net2 = nn.Dense(4, in_units=8); net2.initialize(); net2.hybridize()
+net2(x)
+trace.record_span, trace.skew_tick, trace.annotate = real
+assert calls == {'span': 0, 'skew': 0, 'ann': 0}, calls
+assert trace._buf is None, 'disabled fast path allocated the span buffer'
+assert trace.spans() == [], 'disabled fast path recorded spans'
+print('trace disabled fast path OK (no recorder calls, no buffer)')
+"
+    # trace acceptance: 2-rank launch with an injected input stall on
+    # rank 1 -> per-rank span files merge into one clock-aligned Perfetto
+    # trace and the gang verdict names rank 1 as the input-bound straggler
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_trace.py::test_two_rank_straggler_report_names_rank1 \
+        -q -p no:cacheprovider
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "
@@ -299,6 +346,7 @@ static_stage() {
     MXNET_TPU_CHECK_THREADS=1 JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_telemetry.py tests/unittest/test_check.py \
         tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
+        tests/unittest/test_trace.py \
         -q -m 'not slow' -p no:cacheprovider
 }
 

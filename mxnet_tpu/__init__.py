@@ -57,6 +57,7 @@ _LAZY = {
     "resilience": ".resilience",
     "memsafe": ".memsafe",
     "check": ".check",
+    "trace": ".trace",
     "inspect": ".inspect",
     "dataflow": ".dataflow",
     "parallel": ".parallel",

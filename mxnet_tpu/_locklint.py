@@ -10,7 +10,7 @@ A is acquired, the pair can deadlock. This module records that graph.
 
 `make_lock(name)` / `make_rlock(name)` are drop-in factories the
 instrumented modules (telemetry, diagnostics, dataflow, resilience,
-inspect, memsafe, profiler — and tools/launch.py) use instead of raw
+inspect, memsafe, profiler, trace — and tools/launch.py) use instead of raw
 `threading.Lock()` / `threading.RLock()` (the mx.check `raw-lock` AST
 rule enforces it). Disarmed (the default) they return the PLAIN
 threading primitive — zero wrapper, zero overhead, byte-for-byte the old

@@ -430,6 +430,44 @@ register_option(
     "recompile, and the component is predicted to keep varying. "
     "<=0 disables the rule.")
 register_option(
+    "trace", "off", choices=("off", "on"),
+    doc="mx.trace distributed step tracing. 'off' (default) is the "
+        "zero-overhead fast path: every hook site (dataflow batch-wait "
+        "and H2D staging, ShardedTrainer dispatch/fence, block compile, "
+        "checkpoint save) reduces to one module-bool check — no span "
+        "buffer, no recorder calls (asserted by ci/run.sh sanity). 'on' "
+        "records host-side spans tagged (rank, step) for every "
+        "trace_sample_every-th step, wraps sampled steps in "
+        "jax.profiler.TraceAnnotation so XLA device traces carry the "
+        "same step id, and runs the step-skew probe. tools/launch.py "
+        "--trace-dir arms every worker; merge the per-rank files with "
+        "tools/trace_report.py.")
+register_option(
+    "trace_dir", "",
+    "Base directory for mx.trace span files: each rank appends its "
+    "sampled spans and skew probes to <dir>/<rank>/trace.jsonl (meta "
+    "line first, carrying the rank's wall-clock epoch so "
+    "tools/trace_report.py can align all ranks on one timeline). Empty "
+    "keeps spans in-memory only (bounded buffer; mx.trace.flush(path) "
+    "still works).")
+register_option(
+    "trace_sample_every", 1,
+    "Record mx.trace spans for every N-th step (and every N-th record "
+    "of step-less streams like the input batch-wait). 1 traces "
+    "everything — right for short diagnostic windows; raise it for "
+    "always-on production tracing so the span volume and the sampled-"
+    "step fence cost shrink by N. Compile and checkpoint spans are "
+    "always recorded (rare, seconds-scale).")
+register_option(
+    "trace_skew_every", 16,
+    "Run the mx.trace step-skew probe every N SAMPLED steps: each rank "
+    "wall-stamps its arrival at the collective boundary (an all-gather "
+    "of timestamps when jax runs multi-process), feeding the "
+    "step_skew_seconds / straggler_rank telemetry gauges, a flight-ring "
+    "'trace' entry, and per-rank skew records tools/trace_report.py "
+    "turns into measured cross-rank arrival spread. 0 disables the "
+    "probe (spans still record).")
+register_option(
     "check_threads", False, env="MXNET_TPU_CHECK_THREADS",
     doc="tsan-lite mode (read by mxnet_tpu/_locklint.py at import, also "
         "directly from the env var so the jax-free tools/launch.py sees "

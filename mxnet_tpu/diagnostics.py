@@ -607,6 +607,18 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
     except Exception as e:
         pm["memsafe"] = {"error": str(e)}
     try:
+        # gang-timeline story (mx.trace — via sys.modules so a run that
+        # never touched it pays no import): sampling config, span/skew
+        # volume, the LAST measured step-skew probe (spread + straggler
+        # rank), and where this rank's trace.jsonl landed — a post-mortem
+        # of a stalled gang then names the straggler next to the hang
+        # evidence, and tools/trace_report.py knows what to merge
+        _tr = sys.modules.get(__package__ + ".trace")
+        if _tr is not None and (_tr._enabled or _tr._skews):
+            pm["trace"] = _tr.snapshot()
+    except Exception as e:
+        pm["trace"] = {"error": str(e)}
+    try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:
         pm["profiler_tail"] = []

@@ -31,6 +31,7 @@ from .. import memsafe as _memsafe
 from .. import ndarray as nd_mod
 from .. import random as _random
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
@@ -338,7 +339,8 @@ class HybridBlock(Block):
         entry = self._cache.get(key)
         is_miss = entry is None
         t0 = time.perf_counter() if (
-            is_miss and (_telemetry._enabled or _diagnostics._enabled)) \
+            is_miss and (_telemetry._enabled or _diagnostics._enabled
+                         or _trace._enabled)) \
             else None
         if is_miss:
             entry = self._build_cached(args, grad_params, aux_params, train)
@@ -409,6 +411,12 @@ class HybridBlock(Block):
                     "compile", block=type(self).__name__,
                     compile_time_s=round(dt, 6),
                     shapes=[list(a.shape) for a in args])
+            if _trace._enabled:
+                # every compile is a span (always=True: compiles are rare
+                # and seconds-scale — sampling away the exact event a
+                # trace exists to show would be self-defeating)
+                _trace.record_span("compile", t0, t0 + dt, cat="compile",
+                                   always=True, block=type(self).__name__)
         elif _telemetry._enabled and not is_miss:
             _M_CACHE_HITS.inc()
         if is_miss and _inspect._enabled \

@@ -13,6 +13,7 @@ Gluon blocks plug in unchanged via `gluon.functional_call`.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import time
 
@@ -28,6 +29,7 @@ from .. import inspect as _inspect
 from .. import memsafe as _memsafe
 from .. import resilience as _resilience
 from .. import telemetry as _telemetry
+from .. import trace as _trace
 from ..gluon.block import functional_call
 from ..ndarray import NDArray
 from . import specs as _specs
@@ -35,6 +37,10 @@ from .functional_opt import FunctionalOptimizer
 from .mesh import current_mesh
 
 __all__ = ["ShardedTrainer", "call_loss"]
+
+# reusable do-nothing context for the unsampled/disabled trace path (a
+# fresh nullcontext per step would be an allocation on the hot path)
+_NULLCTX = contextlib.nullcontext()
 
 # shared, framework-wide series (get-or-create: same objects as the
 # HybridBlock jit cache and the gluon Trainer register)
@@ -420,11 +426,20 @@ class ShardedTrainer:
         key = (len(data), len(labels), shapes, self._lr_cache_key(),
                self._accum, getattr(self.block, "_remat_epoch", 0), pol)
         is_miss = key not in self._step_cache
+        # committed only AFTER the jitted call returns, so a trace-time
+        # error or failed dispatch can't desync the host counter from the
+        # device-resident _t_dev (which only advances on a completed call)
+        step_no = self.num_update + 1
         # per-step config read (sub-µs vs a ms-scale step) so
         # mx.config.set("nan_sentinel", ...) takes effect mid-run
         sentinel = _config.get("nan_sentinel")
+        # mx.trace: decided up front so an unsampled step pays nothing
+        # beyond the module bool + one modulo (disabled: the bool alone).
+        # A cache-miss step traces regardless of sampling — compiles are
+        # always-record events (rare, seconds-scale)
+        tracing = _trace._enabled and (is_miss or _trace.sampled(step_no))
         observing = (_telemetry._enabled or _diagnostics._enabled or sentinel
-                     or _inspect._enabled)
+                     or _inspect._enabled or tracing)
         t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
@@ -443,10 +458,6 @@ class ShardedTrainer:
                       if k[:3] == key[:3] and k[4:] == key[4:]
                       and k[3] != key[3]]:
                 del self._step_cache[k]
-        # committed only AFTER the jitted call returns, so a trace-time
-        # error or failed dispatch can't desync the host counter from the
-        # device-resident _t_dev (which only advances on a completed call)
-        step_no = self.num_update + 1
         if _resilience._enabled:
             # the `oom@step:N` injection fires here — BEFORE any transfer
             # or dispatch, like a pre-flight rejection, so the donated
@@ -528,16 +539,22 @@ class ShardedTrainer:
                     # dispatch past the check
                     del self._step_cache[key]
                     raise
+            # sampled steps also carry an mx.trace annotation so the XLA
+            # device trace groups this step's kernels under the same
+            # (rank, step) tag as the host spans
+            ann = _trace.annotate(step_no) if tracing else _NULLCTX
             with jax.profiler.StepTraceAnnotation("train_step",
-                                                  step_num=step_no):
+                                                  step_num=step_no), ann:
                 loss, self.params, self.aux, self.opt_state, self._t_dev = \
                     self._step_cache[key](
                         self.params, self.aux, self.opt_state, self._t_dev,
                         *scalars, rngk, *batch)
+            t_disp = time.perf_counter() if tracing else None
             self.num_update = step_no
             fenced = False
             if observing:
-                if _telemetry._enabled or sentinel or _inspect._enabled:
+                if _telemetry._enabled or sentinel or _inspect._enabled \
+                        or tracing:
                     # fence on the loss (one output of the step executable
                     # fences the whole executable) so the histogram records
                     # device step time, not just async dispatch; on tunnel
@@ -558,6 +575,9 @@ class ShardedTrainer:
                         lr_host if lr_host is not None
                         else self.fopt.lr_at(self.num_update),
                         shapes, t_build, sentinel)
+                if tracing:
+                    self._trace_record_step(step_no, t_build, t_step,
+                                            t_disp, t_done)
                 if _inspect._enabled:
                     # LAST observer: the miss-path analysis lower+compile
                     # takes real wall time that must not leak into the
@@ -585,6 +605,27 @@ class ShardedTrainer:
             # one module-bool check on the disabled fast path
             _resilience.on_step(self)
         return NDArray(loss)
+
+    def _trace_record_step(self, step_no, t_build, t_step, t_disp, t_done):
+        """mx.trace spans for one SAMPLED step: host dispatch
+        (t_step→t_disp) and the fence (t_disp→t_done — device-time share
+        on backends where block_until_ready actually blocks; tracing
+        forces the fence exactly so this span means device time, the same
+        trade telemetry makes), plus the skew-probe tick at the
+        collective boundary. A cache-miss step records ONE compile span
+        (build through fenced first call) instead — its dispatch is
+        compile-dominated and would poison the step category the verdict
+        sums, the same exclusion the telemetry step histogram makes."""
+        if t_build is not None:
+            _trace.record_span("step.compile", t_build, t_done,
+                               step=step_no, cat="compile", always=True,
+                               block=type(self.block).__name__)
+        else:
+            _trace.record_span("step.dispatch", t_step, t_disp,
+                               step=step_no, cat="step")
+            _trace.record_span("step.fence", t_disp, t_done, step=step_no,
+                               cat="step")
+        _trace.skew_tick(step_no)
 
     def _diag_record_step(self, loss, lr, shapes, t_build, sentinel):
         """Flight-recorder entry for one sharded step; with the

@@ -15,9 +15,9 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 
 from . import _locklint
+from . import util as _util
 
 __all__ = [
     "set_config", "set_state", "start", "stop", "pause", "resume",
@@ -40,11 +40,13 @@ _config = {
 _state = {"running": False, "paused": False}
 _events = []            # chrome-trace event dicts (ts in µs)
 _agg = {}               # name -> [count, total_us, min_us, max_us]
-_epoch_ns = time.perf_counter_ns()
 
 
 def _now_us():
-    return (time.perf_counter_ns() - _epoch_ns) / 1e3
+    # the SHARED monotonic epoch (mxnet_tpu.util): profiler scopes,
+    # telemetry counter mirrors, and mx.trace spans all timestamp against
+    # the same zero point, so merged timelines align without clock math
+    return _util.now_us()
 
 
 def set_config(**kwargs):

@@ -60,6 +60,7 @@ import zlib
 from . import _locklint
 from . import config as _config
 from . import telemetry as _telemetry
+from . import trace as _trace
 
 __all__ = [
     "enable", "disable", "enabled", "install", "uninstall", "preempted",
@@ -656,6 +657,12 @@ class CheckpointManager:
             _M_SAVE_SECONDS.observe(dt)
             _telemetry.event("checkpoint", step=step, path=path,
                              dur_s=round(dt, 6))
+        if _trace._enabled:
+            # checkpoint saves serialize with the step loop on this rank:
+            # a gang whose straggler's timeline shows checkpoint.save where
+            # the peers show step spans is checkpoint-bound, not slow
+            _trace.record_span("checkpoint.save", t0, t0 + dt, step=step,
+                               cat="checkpoint", always=True)
         try:
             from . import diagnostics as _diagnostics
             _diagnostics.record_event("checkpoint", step=step, path=path,

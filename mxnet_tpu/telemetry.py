@@ -31,6 +31,8 @@ Instrumented layers (each site degrades to the bool check when disabled):
   * resilience.py           — checkpoint save/restore seconds, verify
                               failures, restarts/preemptions/retries
                               counters
+  * trace.py                — step_skew_seconds / straggler_rank gauges
+                              from the mx.trace cross-rank skew probe
 
 Config: `telemetry` (enable at import), `telemetry_jsonl_path` (auto-flush
 target), `telemetry_flush_interval` (seconds between auto-flushes) — all in
@@ -47,6 +49,7 @@ import time
 
 from . import _locklint
 from . import config
+from . import util as _util
 
 __all__ = [
     "enable", "disable", "enabled", "reset",
@@ -303,11 +306,15 @@ def _mirror(name, value):
 def event(kind, **payload):
     """Append one structured event (compile / recompile / step / ...).
     Buffered in memory; auto-flushed to `telemetry_jsonl_path` when
-    configured, else held for dump_jsonl()."""
+    configured, else held for dump_jsonl(). `mono_us` stamps the shared
+    monotonic trace epoch (mxnet_tpu.util) next to the wall `ts`, so
+    JSONL events line up with mx.profiler scopes and mx.trace spans on
+    one merged timeline without wall-clock smearing."""
     global _dropped_events
     if not _enabled:
         return
-    ev = {"ts": time.time(), "kind": kind}
+    ev = {"ts": time.time(), "mono_us": round(_util.now_us(), 1),
+          "kind": kind}
     ev.update(payload)
     with _lock:
         if len(_events) == _MAX_EVENTS:

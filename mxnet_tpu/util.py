@@ -6,9 +6,37 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 __all__ = ["makedirs", "is_np_array", "use_np", "getenv", "setenv",
-           "fmt_bytes"]
+           "fmt_bytes", "now_us", "perf_to_us", "epoch_unix_ns"]
+
+# The process-wide monotonic trace epoch: ONE (perf_counter, wall-clock)
+# anchor pair, captured together at first import, shared by mx.profiler's
+# chrome-trace events, mx.telemetry's event mirror, and mx.trace's span
+# records — so a merged timeline never mixes clocks with different zero
+# points. epoch_unix_ns() maps the monotonic zero back to wall time, which
+# is how tools/trace_report.py aligns per-rank span files onto one axis.
+_EPOCH_PC_NS = time.perf_counter_ns()
+_EPOCH_UNIX_NS = time.time_ns()
+
+
+def now_us():
+    """Microseconds since the shared monotonic trace epoch."""
+    return (time.perf_counter_ns() - _EPOCH_PC_NS) / 1e3
+
+
+def perf_to_us(t):
+    """Map a raw time.perf_counter() reading (seconds) onto the shared
+    microsecond epoch, so timestamps captured before a record call lands
+    on the same axis as now_us()."""
+    return t * 1e6 - _EPOCH_PC_NS / 1e3
+
+
+def epoch_unix_ns():
+    """Wall-clock time (ns since the unix epoch) at the monotonic epoch's
+    zero point: absolute_ns = epoch_unix_ns() + round(ts_us * 1000)."""
+    return _EPOCH_UNIX_NS
 
 
 def fmt_bytes(n, show_raw=False):

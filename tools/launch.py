@@ -27,6 +27,11 @@ XLA collectives replace the parameter server. So this launcher:
     continue from the last good checkpoint); restart events append to
     `<diagnostics-dir>/restarts.jsonl` with the per-generation world
     size and surviving-worker set,
+  * with `--trace-dir` arms mx.trace in every worker against ONE shared
+    gang trace epoch, so the per-rank `<dir>/<rank>/trace.jsonl` span
+    files merge into a single clock-aligned timeline
+    (`tools/trace_report.py` renders the Perfetto trace and the
+    gang-wide straggler verdict),
   * with `--elastic` (plus `--min-workers M`) the relaunch happens at
     the SURVIVING world size instead of the original shape: ranks that
     lost their slot (signal death, preemption save, injected
@@ -100,7 +105,7 @@ ELASTIC_SETTLE_S = 3.0
 
 
 def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
-              restart_count=0):
+              restart_count=0, trace_dir=None, trace_epoch_ns=None):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -124,6 +129,17 @@ def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
         # (from JAX_PROCESS_ID) so ranks never clobber each other's dumps
         env["MXNET_TPU_DIAGNOSTICS"] = "1"
         env["MXNET_TPU_DIAGNOSTICS_DIR"] = diagnostics_dir
+    if trace_dir:
+        # arm mx.trace in every worker (per-rank span files under
+        # <dir>/<rank>/trace.jsonl) and export ONE shared gang trace
+        # epoch: every rank records its own wall-clock offset against it
+        # in its meta line, so tools/trace_report.py aligns all ranks on
+        # a single timeline. The epoch is fixed per launcher lifetime —
+        # relaunched generations stay on the same axis.
+        env["MXNET_TPU_TRACE"] = "on"
+        env["MXNET_TPU_TRACE_DIR"] = trace_dir
+        if trace_epoch_ns is not None:
+            env["MXNET_TPU_TRACE_EPOCH_NS"] = str(trace_epoch_ns)
     return env
 
 
@@ -291,7 +307,7 @@ def _plan_world(world, codes, elastic, min_workers, max_world):
 
 def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
                  max_restarts=0, restart_backoff=3.0, elastic=False,
-                 min_workers=1):
+                 min_workers=1, trace_dir=None):
     """Run the gang; with --max-restarts, supervise it: when any rank
     dies (crash, SIGKILL rank death, or a preemption save), tear down the
     peer ranks, back off exponentially (with jitter), and relaunch the
@@ -313,6 +329,7 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
     signal.signal(signal.SIGTERM, _kill)
     attempt = 0
     world = num_workers
+    trace_epoch_ns = time.time_ns() if trace_dir else None
     while True:
         if killed.get("sig"):
             # signal arrived during the restart backoff: no gang running,
@@ -321,7 +338,8 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
         procs, pumps = [], []
         for rank in range(world):
             env = build_env(rank, world, coordinator, diagnostics_dir,
-                            restart_count=attempt)
+                            restart_count=attempt, trace_dir=trace_dir,
+                            trace_epoch_ns=trace_epoch_ns)
             proc, pump = _spawn(command, env, rank, diagnostics_dir,
                                 restart_count=attempt)
             procs.append(proc)
@@ -368,12 +386,14 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
 
 
 def launch_ssh(hosts, num_workers, command, coordinator, username=None,
-               diagnostics_dir=None):
+               diagnostics_dir=None, trace_dir=None):
     procs, pumps = [], []
+    trace_epoch_ns = time.time_ns() if trace_dir else None
     for rank in range(num_workers):
         host = hosts[rank % len(hosts)]
         target = f"{username}@{host}" if username else host
-        env = build_env(rank, num_workers, coordinator, diagnostics_dir)
+        env = build_env(rank, num_workers, coordinator, diagnostics_dir,
+                        trace_dir=trace_dir, trace_epoch_ns=trace_epoch_ns)
         exports = " ".join(
             f"{k}={v!r}" for k, v in env.items()
             if k.startswith(("JAX_", "DMLC_", "MXNET_TPU_")))
@@ -407,6 +427,14 @@ def main(argv=None):
                    help="arm mx.diagnostics in every worker and tee each "
                         "worker's output to <dir>/<rank>/worker.log; "
                         "crashes leave <dir>/<rank>/postmortem.json")
+    p.add_argument("--trace-dir", default=None,
+                   help="arm mx.trace in every worker (MXNET_TPU_TRACE=on)"
+                        ": each rank appends sampled step/input/compile/"
+                        "checkpoint spans and skew probes to "
+                        "<dir>/<rank>/trace.jsonl against one shared gang "
+                        "trace epoch; merge into a clock-aligned Perfetto "
+                        "trace + straggler verdict with "
+                        "tools/trace_report.py")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="supervised relaunch (local launcher): when any "
                         "rank exits nonzero, tear down the peers, back "
@@ -455,13 +483,14 @@ def main(argv=None):
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
                           args.coordinator, args.username,
-                          args.diagnostics_dir)
+                          args.diagnostics_dir, trace_dir=args.trace_dir)
     return launch_local(args.num_workers, args.command, args.coordinator,
                         args.diagnostics_dir,
                         max_restarts=args.max_restarts,
                         restart_backoff=args.restart_backoff,
                         elastic=args.elastic,
-                        min_workers=args.min_workers)
+                        min_workers=args.min_workers,
+                        trace_dir=args.trace_dir)
 
 
 if __name__ == "__main__":
