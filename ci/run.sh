@@ -311,6 +311,48 @@ print('trace disabled fast path OK (no recorder calls, no buffer)')
     JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_trace.py::test_two_rank_straggler_report_names_rank1 \
         -q -p no:cacheprovider
+    # guard must be disabled by default: the trainer/dataflow hook sites
+    # make zero guard calls (one module-bool check each), no heartbeat
+    # record or file exists, and no collective-deadline thread runs —
+    # the zero-overhead fast path
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, dataflow, guard
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not guard.enabled(), 'guard must default to off'
+calls = {'beat': 0, 'begin': 0, 'step': 0, 'sdc': 0}
+real = (guard.heartbeat, guard.step_begin, guard.on_step, guard.sdc_check)
+guard.heartbeat = lambda *a, **k: (calls.__setitem__('beat', calls['beat'] + 1), real[0](*a, **k))[1]
+guard.step_begin = lambda *a, **k: (calls.__setitem__('begin', calls['begin'] + 1), real[1](*a, **k))[1]
+guard.on_step = lambda *a, **k: (calls.__setitem__('step', calls['step'] + 1), real[2](*a, **k))[1]
+guard.sdc_check = lambda *a, **k: (calls.__setitem__('sdc', calls['sdc'] + 1), real[3](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for d, l in dataflow.prefetch_to_mesh(iter([([x], [y])] * 3), tr, depth=2):
+    tr.step(d, l)
+guard.heartbeat, guard.step_begin, guard.on_step, guard.sdc_check = real
+assert calls == {'beat': 0, 'begin': 0, 'step': 0, 'sdc': 0}, calls
+assert guard._beat is None, 'disabled fast path recorded a heartbeat'
+assert guard._deadline is None, 'deadline armed while disabled'
+print('guard disabled fast path OK (no beats, no deadline, no digests)')
+"
+    # guard acceptance smokes: (a) an injected hang on rank 1 goes
+    # heartbeat-stale, the supervisor kills the stuck-but-alive rank
+    # within --heartbeat-timeout, and the --elastic relaunch completes
+    # the run (restarts.jsonl records the slot loss); (b) an injected
+    # gradient bit-flip on rank 0 is caught by the SDC digest vote,
+    # attributed to rank 0 by majority, and rolled back to the last
+    # verified checkpoint with a bit-exact final loss on both ranks
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_guard.py::test_hang_detected_killed_and_relaunched \
+        tests/unittest/test_guard.py::test_corrupt_grad_vote_restores_bit_exact \
+        -q -p no:cacheprovider
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "
@@ -346,7 +388,7 @@ static_stage() {
     MXNET_TPU_CHECK_THREADS=1 JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_telemetry.py tests/unittest/test_check.py \
         tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
-        tests/unittest/test_trace.py \
+        tests/unittest/test_trace.py tests/unittest/test_guard.py \
         -q -m 'not slow' -p no:cacheprovider
 }
 

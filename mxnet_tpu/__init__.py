@@ -57,6 +57,7 @@ _LAZY = {
     "resilience": ".resilience",
     "memsafe": ".memsafe",
     "check": ".check",
+    "guard": ".guard",
     "trace": ".trace",
     "inspect": ".inspect",
     "dataflow": ".dataflow",

@@ -283,7 +283,15 @@ register_option(
     "final checkpoint, exit EXIT_SHRINK=84 / EXIT_GROW=85 so a "
     "tools/launch.py --elastic supervisor relaunches the gang smaller by "
     "every rank that fired / one worker larger — use 'shrink@step:3"
-    "@rank:N' to lose exactly one worker). Append '@rank:N' to target "
+    "@rank:N' to lose exactly one worker), 'hang@step:3' (the step "
+    "boundary blocks and never returns — a stuck collective; drives the "
+    "mx.guard heartbeat-staleness kill and the peers' collective "
+    "deadline), 'corrupt_grad@step:4' (deterministic bit-flip in one "
+    "replica of the first gradient/parameter leaf as the step-4 update "
+    "lands — the SDC the mx.guard digest vote must catch and attribute), "
+    "'stall_heartbeat:500' (suppress heartbeat file writes for 500 ms; "
+    "the process stays healthy, only its liveness signal goes dark). "
+    "Append '@rank:N' to target "
     "one rank, '@every_restart' to "
     "re-fire after a supervised relaunch. Empty (default) injects "
     "nothing.")
@@ -478,6 +486,45 @@ register_option(
         "on mutation. Off (default): the factories return plain "
         "threading primitives, zero overhead. The CI 'static' stage runs "
         "the threaded unit tests under this mode.")
+register_option(
+    "guard", False,
+    "Arm mx.guard at import: per-rank liveness heartbeats (written to "
+    "diagnostics_dir/<rank>/heartbeat.json, polled by tools/launch.py "
+    "--heartbeat-timeout, which kills stuck-but-alive workers so the "
+    "elastic relaunch path takes over), the gang-aware collective "
+    "deadline (collective_timeout_s), and the SDC digest vote "
+    "(sdc_check_every). Off by default: every hook site then reduces to "
+    "a single module-bool check — no heartbeat record, no deadline "
+    "thread, no digest (asserted by ci/run.sh sanity). "
+    "mx.guard.enable() arms at runtime.")
+register_option(
+    "heartbeat_timeout_s", 60.0,
+    "Seconds without a fresh heartbeat before a rank is considered "
+    "stuck: tools/launch.py --heartbeat-timeout (which exports this "
+    "env to workers) SIGKILLs the stuck-but-alive process so the gang "
+    "relaunches — with --elastic, at the surviving world size — instead "
+    "of blocking in a collective forever. Also paces the heartbeat "
+    "file-write interval (timeout/4, capped at 1 s). Size it above the "
+    "worst-case checkpoint write: saves beat at start and end, but a "
+    "single write longer than the timeout reads as a stall.")
+register_option(
+    "collective_timeout_s", 0.0,
+    "mx.guard gang-aware deadline on the step fence/collective "
+    "boundary: when no step completes within this many seconds (first "
+    "step onward; compiles and checkpoint writes suspend the clock), "
+    "the rank dumps a post-mortem naming the suspected dead peer "
+    "(oldest peer heartbeat + last mx.trace skew straggler) and exits "
+    "EXIT_PEER_LOST (86) so the supervisor relaunches the gang. 0 "
+    "(default) disables the deadline thread entirely.")
+register_option(
+    "sdc_check_every", 0,
+    "Run the mx.guard silent-data-corruption digest vote every N "
+    "completed trainer steps: hash a deterministic per-replica digest "
+    "of the post-all-reduce params (bit-identical across data-parallel "
+    "replicas by construction), exchange gang-wide, majority-vote the "
+    "corrupt rank, and roll the gang back to the last verified "
+    "checkpoint (a twice-corrupt rank is quarantined via the elastic "
+    "shrink path). Needs param_mode='replicate'. 0 (default) disables.")
 register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "

@@ -43,6 +43,7 @@ import numpy as np
 
 from . import _locklint
 from . import config as _config
+from . import guard as _guard
 from . import resilience as _resilience
 from . import telemetry as _telemetry
 from . import trace as _trace
@@ -245,6 +246,13 @@ def _stage_resilient(stage, item, closed, policy_cell):
     not per batch, this is the input hot path — and retries abort early
     if the prefetcher closes underneath. Disabled: one bool check, then
     the plain call."""
+    if _guard._enabled:
+        # mx.guard liveness from the input worker: a trainer blocked on
+        # a slow input queue still shows a fresh beat (phase=input), so
+        # the supervisor distinguishes "starving" from "dead" — the
+        # in-memory record updates every batch, the file write stays
+        # rate-limited
+        _guard.heartbeat(phase="input")
     if not _resilience._enabled:
         return stage(item)
     _resilience.fault_point("input")

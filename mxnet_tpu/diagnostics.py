@@ -53,6 +53,7 @@ __all__ = [
     "enable", "disable", "enabled", "reset", "install", "uninstall",
     "record_step", "record_event", "annotate_step", "records", "scope",
     "Watchdog", "arm_watchdog", "disarm_watchdog", "notify_progress",
+    "suspend_watchdog",
     "NonFiniteError", "sentinel_check", "grad_global_norm",
     "memory_watermarks", "dump", "postmortem_path",
 ]
@@ -237,7 +238,7 @@ class Watchdog:
     until the next notify() re-arms it."""
 
     def __init__(self, deadline_s, on_fire=None, clock=time.monotonic,
-                 interval=None):
+                 interval=None, armed=True):
         self.deadline = float(deadline_s)
         self.clock = clock
         self.interval = interval if interval is not None else \
@@ -247,18 +248,43 @@ class Watchdog:
         self.last_message = None
         self._last = clock()
         self._last_step = None
-        self._armed = True
+        # armed=False starts the watchdog DORMANT: the first notify() arms
+        # it, so a minutes-long startup (first compile, data prep) before
+        # any step completes can never read as a stall (mx.guard's
+        # collective deadline starts this way)
+        self._armed = armed
+        self._suspended = 0
         self._stop = threading.Event()
         self._thread = None
 
-    def notify(self, step=None):
+    def notify(self, step=None, arm=True):
+        """Progress: restart the idle clock. `arm=False` defers an armed
+        deadline without waking a DORMANT one — mx.guard's pre-step beats
+        (restore, input staging) are progress but must not arm the
+        collective deadline before the first step completes."""
         self._last = self.clock()
         if step is not None:
             self._last_step = step
-        self._armed = True
+        if arm:
+            self._armed = True
+
+    def suspend(self):
+        """Enter a legitimate long non-step region (checkpoint write,
+        reshard restore, cold compile): the deadline cannot fire until
+        the matching resume(). Nestable (counted)."""
+        self._suspended += 1
+
+    def resume(self):
+        """Leave a suspended region; the suspended time does not count
+        against the deadline (the idle clock restarts at resume)."""
+        self._suspended = max(0, self._suspended - 1)
+        if self._suspended == 0:
+            self._last = self.clock()
 
     def _check(self):
         """One poll: returns True iff the deadline fired this call."""
+        if self._suspended:
+            return False
         idle = self.clock() - self._last
         if idle <= self.deadline or not self._armed:
             return False
@@ -332,6 +358,50 @@ def notify_progress(step=None):
     w = _watchdog
     if w is not None:
         w.notify(step)
+
+
+class suspend_watchdog:
+    """Context manager for a NAMED legitimate long non-step region — a
+    multi-GB checkpoint write, a resharding restore — during which
+    neither the module watchdog nor the mx.guard collective deadline may
+    fire (a long save is progress, not a hang). Both deadlines restart
+    their idle clocks at exit, so a save just under the deadline can't
+    trip it one poll later. Doubles as a diagnostics scope: a REAL hang
+    *inside* the region still gets named by the post-mortem ("stuck in
+    checkpoint.save @ step N") even though the timers stay quiet. Cheap
+    enough for the disabled fast path: two module-global reads when
+    nothing is armed."""
+
+    def __init__(self, name, step=None):
+        self.name = name
+        self.step = step
+        self._dogs = ()
+        self._scoped = False
+
+    def __enter__(self):
+        dogs = []
+        w = _watchdog
+        if w is not None:
+            dogs.append(w)
+        g = sys.modules.get(__package__ + ".guard")
+        if g is not None:
+            d = g._deadline
+            if d is not None:
+                dogs.append(d)
+        self._dogs = tuple(dogs)
+        for d in self._dogs:
+            d.suspend()
+        if _enabled:
+            self._scoped = True
+            _scope_begin(self.name, self.step)
+        return self
+
+    def __exit__(self, *exc):
+        if self._scoped:
+            _scope_end()
+        for d in self._dogs:
+            d.resume()
+        return False
 
 
 def _dump_thread_stacks():
@@ -618,6 +688,19 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
             pm["trace"] = _tr.snapshot()
     except Exception as e:
         pm["trace"] = {"error": str(e)}
+    try:
+        # liveness/SDC story (mx.guard — via sys.modules so a run that
+        # never touched it pays no import): last heartbeat, deadline and
+        # digest-vote config, the last SDC verdict, and — when the
+        # collective deadline fired — the suspected dead peer, so
+        # tools/postmortem_report.py can name the rank that stopped
+        # heartbeating next to the hang evidence
+        _g = sys.modules.get(__package__ + ".guard")
+        if _g is not None and (_g._enabled or _g._peer_lost_info
+                               or _g._last_sdc):
+            pm["guard"] = _g.snapshot()
+    except Exception as e:
+        pm["guard"] = {"error": str(e)}
     try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:

@@ -25,6 +25,7 @@ from .. import _engine
 from .. import check as _check
 from .. import config as _config
 from .. import diagnostics as _diagnostics
+from .. import guard as _guard
 from .. import inspect as _inspect
 from .. import memsafe as _memsafe
 from .. import resilience as _resilience
@@ -108,6 +109,7 @@ class ShardedTrainer:
         # check per subsystem
         _memsafe.maybe_enable()
         _check.maybe_enable()
+        _guard.maybe_enable()
         # persistent XLA compilation cache (compile_cache_dir knob): wired
         # once, at first trainer construction, before anything compiles
         from .. import dataflow as _dataflow
@@ -464,6 +466,12 @@ class ShardedTrainer:
             # train state is intact and every degradation-ladder rung is
             # drivable in tests
             _resilience.fault_point("dispatch", step=step_no)
+        if _guard._enabled:
+            # mx.guard liveness: beat the dispatch (rate-limited file
+            # write) and suspend the collective deadline across a cold
+            # executable build — a minutes-scale first compile is a
+            # legitimate non-step region, not a dead peer
+            _guard.step_begin(step_no, compiling=is_miss)
         scalars = ()
         lr_host = None
         if not self._lr_inside:
@@ -604,6 +612,13 @@ class ShardedTrainer:
             # graceful-preemption final save + EXIT_PREEMPTED — all behind
             # one module-bool check on the disabled fast path
             _resilience.on_step(self)
+        if _guard._enabled:
+            # mx.guard: completed-step heartbeat (feeds the supervisor's
+            # staleness clock AND re-arms the collective deadline), then
+            # the SDC digest vote on its sdc_check_every cadence — after
+            # resilience so a just-injected corrupt_grad is caught by
+            # the vote this same boundary
+            _guard.on_step(self, step_no)
         return NDArray(loss)
 
     def _trace_record_step(self, step_no, t_build, t_step, t_disp, t_done):

@@ -59,6 +59,8 @@ import zlib
 
 from . import _locklint
 from . import config as _config
+from . import diagnostics as _diagnostics
+from . import guard as _guard
 from . import telemetry as _telemetry
 from . import trace as _trace
 
@@ -70,7 +72,7 @@ __all__ = [
     "check_fingerprint", "trainer_fingerprint", "CheckpointManager",
     "manager_for", "FaultInjector", "fault_point", "restart_count",
     "last_resume", "note_preemption", "save_estimator", "restore_estimator",
-    "EXIT_SHRINK", "EXIT_GROW", "reshard_gate",
+    "EXIT_SHRINK", "EXIT_GROW", "reshard_gate", "request_shrink",
 ]
 
 # distinct "preempted: state saved, exiting on request" process exit code —
@@ -188,11 +190,7 @@ def install(signals=(_signal.SIGTERM, _signal.SIGINT)):
     n = restart_count()
     if n:
         _M_RESTARTS.inc(n)
-        try:
-            from . import diagnostics as _diagnostics
-            _diagnostics.record_event("restart", count=n)
-        except Exception:
-            pass
+        _diagnostics.record_event("restart", count=n)
     return _installed
 
 
@@ -649,8 +647,20 @@ class CheckpointManager:
             return None
         t0 = time.perf_counter()
         path = self._step_dir(step)
-        self.policy.call(self.trainer.save_states, path,
-                         site="checkpoint-io")
+        if _guard._enabled:
+            # liveness: the supervisor's staleness clock must see the
+            # save START (a long write is progress, not a hang)
+            _guard.heartbeat(step, phase="checkpoint.save", force=True)
+        # a multi-GB (or resharding) checkpoint write is a legitimate
+        # long non-step region: suspend the hang watchdog and the
+        # mx.guard collective deadline for its duration so neither can
+        # falsely fire mid-save (a REAL hang inside still gets named —
+        # the suspend context doubles as a diagnostics scope)
+        with _diagnostics.suspend_watchdog("checkpoint.save", step):
+            self.policy.call(self.trainer.save_states, path,
+                             site="checkpoint-io")
+        if _guard._enabled:
+            _guard.heartbeat(step, phase="checkpoint.save", force=True)
         self._last_saved_step = step
         dt = time.perf_counter() - t0
         if _telemetry._enabled:
@@ -663,12 +673,8 @@ class CheckpointManager:
             # the peers show step spans is checkpoint-bound, not slow
             _trace.record_span("checkpoint.save", t0, t0 + dt, step=step,
                                cat="checkpoint", always=True)
-        try:
-            from . import diagnostics as _diagnostics
-            _diagnostics.record_event("checkpoint", step=step, path=path,
-                                      dur_s=round(dt, 6))
-        except Exception:
-            pass
+        _diagnostics.record_event("checkpoint", step=step, path=path,
+                                  dur_s=round(dt, 6))
         self._gc()
         return path
 
@@ -692,17 +698,23 @@ class CheckpointManager:
             pass
 
     # ---------------------------------------------------------- restore
-    def restore_latest(self):
+    def restore_latest(self, max_step=None):
         """Restore the newest checkpoint that verifies, falling back past
         torn/corrupt ones (each rejection counts
         checkpoint_verify_failures_total). Returns the restored step, or
-        None when no usable checkpoint exists. A mesh-mismatch raises
-        MeshMismatchError — that is a configuration error, not corruption,
-        and older checkpoints would mismatch identically."""
+        None when no usable checkpoint exists. `max_step` bounds the
+        search: checkpoints above it are skipped without being counted as
+        corrupt (mx.guard's SDC rollback passes the last digest-verified
+        step — a CRC-clean file saved from already-corrupt params must
+        not be reloaded). A mesh-mismatch raises MeshMismatchError —
+        that is a configuration error, not corruption, and older
+        checkpoints would mismatch identically."""
         _recover_displaced(self.base_dir)
         ckpts = list_checkpoints(self.base_dir)
         fallbacks = 0
         for step, path in reversed(ckpts):
+            if max_step is not None and step > max_step:
+                continue
             try:
                 self.restore(path)
             except CheckpointCorruptError as e:
@@ -741,8 +753,14 @@ class CheckpointManager:
             # the reshard knob allows redistribution)
             manifest = verify_checkpoint(path)
             reshard_gate(manifest, self.trainer, str(path))
-        self.policy.call(self.trainer.load_states, path,
-                         site="checkpoint-io")
+        # restores (possibly resharding onto a new topology) are long
+        # non-step regions too: same watchdog/deadline suspension as save
+        with _diagnostics.suspend_watchdog("checkpoint.restore"):
+            self.policy.call(self.trainer.load_states, path,
+                             site="checkpoint-io")
+        if _guard._enabled:
+            _guard.heartbeat(int(self.trainer.num_update),
+                             phase="checkpoint.restore", force=True)
         self._last_saved_step = int(self.trainer.num_update)
         if _telemetry._enabled:
             _M_RESTORE_SECONDS.observe(time.perf_counter() - t0)
@@ -823,12 +841,8 @@ def _note_resume(path, step, fallbacks=0):
     if _telemetry._enabled:
         _telemetry.event("resume", path=path, step=int(step),
                          fallbacks=fallbacks)
-    try:
-        from . import diagnostics as _diagnostics
-        _diagnostics.record_event("resume", path=path, step=int(step),
-                                  fallbacks=fallbacks)
-    except Exception:
-        pass
+    _diagnostics.record_event("resume", path=path, step=int(step),
+                              fallbacks=fallbacks)
 
 
 # ---------------------------------------------------------------------------
@@ -887,9 +901,24 @@ def on_step(trainer):
     if mgr is not None and every > 0 and step % every == 0:
         mgr.save()
     if _injector is not None:
-        _injector.fire("step", step=step)
+        _injector.fire("step", step=step, trainer=trainer)
     if _preempt["flag"]:
         _finalize_preemption(mgr, step)
+
+
+def request_shrink(reason=None):
+    """Ask this rank out of the gang at the NEXT step boundary:
+    piggybacks on the preemption machinery — on_step's flag check saves
+    a final checkpoint and raises PreemptedExit(EXIT_SHRINK), so a
+    tools/launch.py --elastic supervisor relaunches the gang one worker
+    smaller without this rank. How mx.guard quarantines a repeat-SDC
+    rank (hardware corrupting data faster than rollback launders it)."""
+    print(f"mx.resilience: shrink requested"
+          + (f" ({reason})" if reason else "")
+          + " — exiting EXIT_SHRINK at the next step boundary",
+          file=sys.stderr)
+    _preempt["flag"] = True
+    _preempt["resize"] = "shrink"
 
 
 def note_preemption(step, path=None, signum=None, kind=None):
@@ -903,12 +932,8 @@ def note_preemption(step, path=None, signum=None, kind=None):
         _M_PREEMPTIONS.inc()
         _telemetry.event("preempt", step=step, signum=signum, path=path,
                          request=kind or "preempt")
-    try:
-        from . import diagnostics as _diagnostics
-        _diagnostics.record_event("preempt", step=step, signum=signum,
-                                  path=path, request=kind or "preempt")
-    except Exception:
-        pass
+    _diagnostics.record_event("preempt", step=step, signum=signum,
+                              path=path, request=kind or "preempt")
 
 
 def _finalize_preemption(mgr, step):
@@ -979,6 +1004,25 @@ class FaultInjector:
       grow@step:3           — same, exit EXIT_GROW (85): relaunch one
                               worker LARGER (capacity returned), capped at
                               the original -n
+      hang@step:3           — the step-3 boundary BLOCKS and never
+                              returns: a stuck collective / wedged host.
+                              The heartbeat goes stale, the tools/
+                              launch.py --heartbeat-timeout poll kills
+                              the stuck-but-alive process (slot loss →
+                              elastic relaunch), and any peer stuck
+                              waiting trips its mx.guard collective
+                              deadline
+      corrupt_grad@step:4   — deterministic bit-flip in ONE REPLICA of
+                              the first gradient/parameter leaf as the
+                              step-4 update lands — the silent data
+                              corruption the mx.guard digest vote must
+                              catch, attribute by majority, and roll
+                              back past
+      stall_heartbeat:500   — suppress heartbeat FILE writes for 500 ms
+                              (consumed by mx.guard at its next beat):
+                              the process stays healthy, only its
+                              liveness signal goes dark — the
+                              supervisor-side staleness drill
     Any spec may append @rank:N to fire on that rank only. Specs fire at
     most once, and only on the FIRST launch (MXNET_TPU_RESTART_COUNT=0)
     unless @every_restart is appended — a relaunched gang must not re-kill
@@ -1020,19 +1064,23 @@ class FaultInjector:
                         f"{part!r}")
             if spec["kind"] not in ("sigterm", "kill", "corrupt_ckpt",
                                     "stall_input", "exc", "shrink", "grow",
-                                    "oom"):
+                                    "oom", "hang", "corrupt_grad",
+                                    "stall_heartbeat"):
                 raise ValueError(
                     f"fault_inject: unknown fault {spec['kind']!r} in "
                     f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
-                    "stall_input, exc, shrink, grow, oom)")
+                    "stall_input, exc, shrink, grow, oom, hang, "
+                    "corrupt_grad, stall_heartbeat)")
             specs.append(spec)
         return cls(specs)
 
-    def fire(self, point, step=None, path=None):
+    def fire(self, point, step=None, path=None, trainer=None):
         """Run every armed spec matching this fault point. `point` is
         "step" (trainer step boundary), "dispatch" (about to dispatch a
         step; nothing transferred or donated yet), "ckpt" (checkpoint
-        just written), or "input" (input pipeline worker)."""
+        just written), or "input" (input pipeline worker). `trainer` is
+        handed through at the step boundary so corrupt_grad can reach
+        the live parameter replicas."""
         rank = _process_index()
         for spec in self._specs:
             if spec["fired"]:
@@ -1042,11 +1090,17 @@ class FaultInjector:
             if not spec["every_restart"] and restart_count() > 0:
                 continue
             kind = spec["kind"]
-            if point == "step" and kind in ("sigterm", "kill", "exc"):
+            if point == "step" and kind in ("sigterm", "kill", "exc",
+                                            "hang"):
                 if spec["step"] is not None and step != spec["step"]:
                     continue
                 spec["fired"] = True
                 self._fire_process_fault(kind, step)
+            elif point == "step" and kind == "corrupt_grad":
+                if spec["step"] is not None and step != spec["step"]:
+                    continue
+                spec["fired"] = True
+                self.corrupt_gradient(trainer, step)
             elif point == "step" and kind in ("shrink", "grow"):
                 if spec["step"] is not None and step != spec["step"]:
                     continue
@@ -1091,6 +1145,72 @@ class FaultInjector:
         elif kind == "exc":
             raise RuntimeError(
                 f"mx.resilience fault injection: crash at step {step}")
+        elif kind == "hang":
+            # stuck collective / wedged host: the step boundary never
+            # returns. SIGTERM can't break the loop (the resilience
+            # handler is flag-only by design) — exactly the stuck-but-
+            # alive process the heartbeat-staleness kill exists for.
+            while True:
+                time.sleep(3600)
+
+    def consume(self, kind):
+        """Pop one armed spec of `kind` (honoring @rank targeting and
+        the one-shot / first-launch-only disarm rules) and return its
+        arg string, or None. How point-less specs like stall_heartbeat
+        reach the subsystem that implements them (mx.guard)."""
+        rank = _process_index()
+        for spec in self._specs:
+            if spec["fired"] or spec["kind"] != kind:
+                continue
+            if spec["rank"] is not None and spec["rank"] != rank:
+                continue
+            if not spec["every_restart"] and restart_count() > 0:
+                continue
+            spec["fired"] = True
+            return spec["arg"] or ""
+        return None
+
+    @staticmethod
+    def corrupt_gradient(trainer, step):
+        """Deterministic silent data corruption: flip one bit in ONE
+        REPLICA (the first addressable device's copy) of the first
+        gradient/parameter leaf, as the step's update lands. Flipping a
+        single replica — not the logical array — reproduces real SDC
+        (one chip computed wrong bytes) and leaves the majority of
+        replicas clean, so the mx.guard digest vote can attribute the
+        corruption to this rank even in a 2-rank gang (15-vs-1 over an
+        8-device mesh pair, not an unresolvable 1-vs-1 tie)."""
+        if trainer is None or not hasattr(trainer, "params"):
+            return
+        import jax
+        import numpy as np
+
+        params = trainer.params
+        leaf_is_list = isinstance(params, (list, tuple))
+        leaf = params[0] if leaf_is_list else params
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            datas = [np.array(s.data) for s in shards]
+            buf = datas[0].view(np.uint8).reshape(-1)
+            buf[buf.size // 2] ^= 0x10
+            arrs = [jax.device_put(d, s.device)
+                    for d, s in zip(datas, shards)]
+            new = jax.make_array_from_single_device_arrays(
+                leaf.shape, leaf.sharding, arrs)
+            where = f"replica on device {shards[0].device.id}"
+        else:
+            data = np.array(leaf)
+            buf = data.view(np.uint8).reshape(-1)
+            buf[buf.size // 2] ^= 0x10
+            new = data
+            where = "host copy (no device replicas)"
+        if leaf_is_list:
+            params[0] = new
+        else:
+            trainer.params = new
+        print(f"mx.resilience: fault injection: corrupt_grad at step "
+              f"{step} (rank {_process_index()}): flipped one bit in "
+              f"param leaf 0, {where}", file=sys.stderr)
 
     @staticmethod
     def corrupt_checkpoint(path):
@@ -1117,13 +1237,13 @@ class FaultInjector:
               file=sys.stderr)
 
 
-def fault_point(point, step=None, path=None):
+def fault_point(point, step=None, path=None, trainer=None):
     """Hook production code paths call (only does anything while enabled
     AND a fault_inject spec is armed — the common case is one None
     check)."""
     inj = _injector
     if inj is not None and _enabled:
-        inj.fire(point, step=step, path=path)
+        inj.fire(point, step=step, path=path, trainer=trainer)
 
 
 # ---------------------------------------------------------------------------

@@ -38,7 +38,14 @@ XLA collectives replace the parameter server. So this launcher:
     shrink@step) shrink the gang, an EXIT_GROW request grows it back
     toward `-n`; workers resuming with mx.resilience reshard='auto'
     redistribute the checkpoint onto the new topology
-    (`tools/postmortem_report.py` renders the reshape history).
+    (`tools/postmortem_report.py` renders the reshape history),
+  * with `--heartbeat-timeout S` arms mx.guard liveness in every worker
+    and polls the per-rank heartbeat files: a rank whose beat goes stale
+    (stuck host, wedged collective — alive but making no progress) is
+    SIGKILLed so the relaunch machinery treats it as an ordinary slot
+    loss; a worker that exits EXIT_PEER_LOST (86 — its mx.guard
+    collective deadline named a dead peer) is relaunched like any other
+    failure.
 
 `-s` (servers) is accepted and ignored with a warning: there are no
 parameter servers on TPU (SURVEY.md §2.5).
@@ -95,6 +102,11 @@ _out_lock = _make_lock("launch.stdout")
 EXIT_PREEMPTED = 83
 EXIT_SHRINK = 84
 EXIT_GROW = 85
+# a HEALTHY rank concluded a peer died inside a blocking collective
+# (mx.guard collective deadline) and exited so the gang can relaunch —
+# the actually-dead peer is the slot loss, not this rank
+EXIT_PEER_LOST = 86
+HEARTBEAT_FILE = "heartbeat.json"
 
 # seconds an elastic supervisor keeps polling after the FIRST failure
 # before snapshotting exit codes: co-failing ranks (a slice losing several
@@ -105,7 +117,8 @@ ELASTIC_SETTLE_S = 3.0
 
 
 def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
-              restart_count=0, trace_dir=None, trace_epoch_ns=None):
+              restart_count=0, trace_dir=None, trace_epoch_ns=None,
+              heartbeat_timeout=None):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -140,6 +153,12 @@ def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
         env["MXNET_TPU_TRACE_DIR"] = trace_dir
         if trace_epoch_ns is not None:
             env["MXNET_TPU_TRACE_EPOCH_NS"] = str(trace_epoch_ns)
+    if heartbeat_timeout:
+        # arm mx.guard in every worker: per-rank liveness heartbeats
+        # under <diagnostics_dir>/<rank>/heartbeat.json, which the
+        # supervisor's staleness poll ages against this same timeout
+        env["MXNET_TPU_GUARD"] = "1"
+        env["MXNET_TPU_HEARTBEAT_TIMEOUT_S"] = str(heartbeat_timeout)
     return env
 
 
@@ -252,7 +271,9 @@ def _log_restart(diagnostics_dir, event):
     MXNET_TPU_RESTART_COUNT; tools/postmortem_report.py renders the
     reshape history from the per-generation world sizes recorded here)."""
     kind = {EXIT_PREEMPTED: "preempted", EXIT_SHRINK: "requested shrink",
-            EXIT_GROW: "requested grow"}.get(event["exit_code"], "failed")
+            EXIT_GROW: "requested grow",
+            EXIT_PEER_LOST: "lost a peer (collective deadline)",
+            }.get(event["exit_code"], "failed")
     reshape = ""
     if event.get("new_world_size") != event.get("world_size"):
         reshape = (f" at world size {event['new_world_size']} "
@@ -262,6 +283,13 @@ def _log_restart(diagnostics_dir, event):
           f"{reshape} in {event['backoff_s']:.1f}s "
           f"(restart {event['attempt']})",
           file=sys.stderr)
+    _append_restart_event(diagnostics_dir, event)
+
+
+def _append_restart_event(diagnostics_dir, event):
+    """Append one record to <diagnostics_dir>/restarts.jsonl (the
+    single supervision log: restart events and stale-heartbeat kills
+    share it, so tools/postmortem_report.py renders one history)."""
     if not diagnostics_dir:
         return
     try:
@@ -269,7 +297,99 @@ def _log_restart(diagnostics_dir, event):
         with open(os.path.join(diagnostics_dir, "restarts.jsonl"), "a") as f:
             f.write(json.dumps(event) + "\n")
     except OSError as e:
-        print(f"launch: cannot record restart event: {e}", file=sys.stderr)
+        print(f"launch: cannot record {event.get('kind', 'restart')} "
+              f"event: {e}", file=sys.stderr)
+
+
+class _HeartbeatMonitor:
+    """Supervisor-side liveness poll (--heartbeat-timeout): ages every
+    rank's mx.guard heartbeat file and SIGKILLs a stuck-but-alive worker
+    whose beat goes stale — turning an invisible hang (a wedged host
+    blocking its peers inside a collective) into an ordinary slot loss
+    the --elastic relaunch path already handles, instead of waiting on
+    the cluster scheduler. A rank that has not yet written a
+    CURRENT-GENERATION beat is left alone (startup and first compile
+    legitimately precede the first step), and every kill is recorded in
+    <diagnostics_dir>/restarts.jsonl as a stale_heartbeat event.
+
+    At most ONE rank is killed per generation — the OLDEST stale beat.
+    When one rank wedges a blocking collective, every peer blocks behind
+    it and ALL their beats go stale nearly simultaneously; the wedged
+    rank stopped beating first, so it ages out first, and killing only
+    it keeps the healthy-but-blocked peers out of the slot-loss
+    accounting (they die to the ordinary teardown and relaunch at full
+    surviving strength — an elastic gang shrinks by one, not by the
+    whole blocked membership). A second simultaneous wedge is caught by
+    the next generation's monitor."""
+
+    def __init__(self, procs, diagnostics_dir, timeout_s, generation):
+        self.procs = procs
+        self.dir = diagnostics_dir
+        self.timeout = float(timeout_s)
+        self.gen = generation
+        self.killed = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="launch-heartbeat-poll",
+                                        daemon=True)
+        self._thread.start()
+
+    def _read(self, rank):
+        path = os.path.join(self.dir, str(rank), HEARTBEAT_FILE)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # missing or torn beat: the workers write atomically, so
+            # this is "no evidence", never "stale evidence"
+            return None
+
+    def _run(self):
+        interval = max(0.25, min(1.0, self.timeout / 4.0))
+        while not self._stop.wait(interval):
+            now = time.time()
+            worst = None
+            for rank, p in enumerate(self.procs):
+                if p.poll() is not None:
+                    continue
+                rec = self._read(rank)
+                if not rec or rec.get("gen") != self.gen:
+                    continue
+                age = now - float(rec.get("ts", now))
+                if age <= self.timeout:
+                    continue
+                if worst is None or age > worst[0]:
+                    worst = (age, rank, p, rec)
+            if worst is None:
+                continue
+            age, rank, p, rec = worst
+            self.killed.append(rank)
+            print(f"launch: rank {rank} heartbeat stale ({age:.1f}s > "
+                  f"{self.timeout:.1f}s; last beat step "
+                  f"{rec.get('step')}, phase {rec.get('phase') or '?'})"
+                  " — killing the stuck worker (slot loss; the "
+                  "supervisor relaunches the gang)", file=sys.stderr)
+            try:
+                p.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+            _append_restart_event(self.dir, {
+                "ts": now, "kind": "stale_heartbeat",
+                "rank": rank, "age_s": round(age, 3),
+                "timeout_s": self.timeout,
+                "generation": self.gen,
+                "last_step": rec.get("step"),
+                "phase": rec.get("phase")})
+            # one kill per generation: stop polling — the reap sees the
+            # death, tears the gang down, and the NEXT generation gets a
+            # fresh monitor (killing every stale beat in one pass would
+            # also reap the healthy peers blocked behind the wedged
+            # rank's collective, over-shrinking an elastic gang)
+            return
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
 
 
 def _plan_world(world, codes, elastic, min_workers, max_world):
@@ -307,7 +427,7 @@ def _plan_world(world, codes, elastic, min_workers, max_world):
 
 def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
                  max_restarts=0, restart_backoff=3.0, elastic=False,
-                 min_workers=1, trace_dir=None):
+                 min_workers=1, trace_dir=None, heartbeat_timeout=0.0):
     """Run the gang; with --max-restarts, supervise it: when any rank
     dies (crash, SIGKILL rank death, or a preemption save), tear down the
     peer ranks, back off exponentially (with jitter), and relaunch the
@@ -339,15 +459,30 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
         for rank in range(world):
             env = build_env(rank, world, coordinator, diagnostics_dir,
                             restart_count=attempt, trace_dir=trace_dir,
-                            trace_epoch_ns=trace_epoch_ns)
+                            trace_epoch_ns=trace_epoch_ns,
+                            heartbeat_timeout=heartbeat_timeout)
             proc, pump = _spawn(command, env, rank, diagnostics_dir,
                                 restart_count=attempt)
             procs.append(proc)
             pumps.append(pump)
-        code, rank = _reap(procs, pumps, early_exit=max_restarts > 0,
+        monitor = None
+        if heartbeat_timeout and diagnostics_dir:
+            # liveness poll for THIS generation: a rank whose mx.guard
+            # heartbeat goes stale is SIGKILLed (slot loss), so a hung
+            # collective resolves into a relaunch instead of an
+            # indefinite stall
+            monitor = _HeartbeatMonitor(procs, diagnostics_dir,
+                                        heartbeat_timeout, attempt)
+        # the heartbeat monitor implies early-exit even without
+        # --max-restarts: its SIGKILL of a stuck rank leaves the peers
+        # blocked in the dead collective, so waiting for ALL ranks would
+        # turn the detected hang into a permanent launcher hang — reap
+        # the first death, tear the gang down, and exit with the code
+        code, rank = _reap(procs, pumps,
+                           early_exit=max_restarts > 0 or monitor is not None,
                            killed=killed)
         codes = [p.poll() for p in procs]
-        if code != 0 and max_restarts > 0:
+        if code != 0 and (max_restarts > 0 or monitor is not None):
             if elastic:
                 # settle window: let co-failing ranks (several workers of
                 # one evicted slice) finish dying before the snapshot, so
@@ -361,10 +496,41 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             # early-exit reap leaves the peers running: tear the gang down
             # whether or not a relaunch follows (no orphans on giving up)
             _terminate_gang(procs, pumps)
+        if monitor is not None:
+            monitor.stop()
         if code == 0 or attempt >= max_restarts:
             return code
         new_world, surviving, lost = _plan_world(
             world, codes, elastic, min_workers, num_workers)
+        # EXIT_PEER_LOST inverts the usual attribution: the exiting rank
+        # is the HEALTHY reporter, and the actually-dead peer is still
+        # wedged (no exit code) at snapshot time — it only dies to the
+        # teardown SIGKILL, which the pre-teardown snapshot can never
+        # see. Prefer the reporter's own post-mortem evidence (its guard
+        # section names the suspect from heartbeat ages): in gangs >2 the
+        # OTHER still-running ranks are healthy peers whose deadlines
+        # simply haven't fired yet, not dead ones — so when no reporter
+        # post-mortem names a suspect (guard dir unwritable, heartbeat
+        # evidence missing), the suspicion stays EMPTY rather than
+        # smearing every running rank. Record both sides so
+        # restarts.jsonl doesn't list the dead peer as a survivor.
+        reporters = [r for r, c in enumerate(codes) if c == EXIT_PEER_LOST]
+        suspected = []
+        if reporters:
+            running = [r for r, c in enumerate(codes) if c is None]
+            named = set()
+            for rr in reporters:
+                try:
+                    with open(os.path.join(diagnostics_dir, str(rr),
+                                           "postmortem.json")) as f:
+                        pm = json.load(f)
+                    s = (((pm.get("guard") or {}).get("peer_lost") or {})
+                         .get("suspect") or {})
+                    if s.get("rank") is not None:
+                        named.add(int(s["rank"]))
+                except (OSError, TypeError, ValueError):
+                    continue
+            suspected = sorted(named & set(running))
         attempt += 1
         backoff = restart_backoff * (2.0 ** (attempt - 1)) \
             * random.uniform(0.8, 1.2)
@@ -373,7 +539,11 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             "failed_rank": rank, "exit_code": code,
             "preempted": code == EXIT_PREEMPTED,
             "world_size": world, "new_world_size": new_world,
-            "surviving_ranks": surviving, "lost_ranks": lost,
+            "surviving_ranks": [r for r in surviving
+                                if r not in suspected],
+            "lost_ranks": lost,
+            "peer_lost_reporters": reporters,
+            "suspected_dead_ranks": suspected,
             "elastic": bool(elastic),
             "backoff_s": round(backoff, 3)})
         world = new_world
@@ -435,6 +605,19 @@ def main(argv=None):
                         "trace epoch; merge into a clock-aligned Perfetto "
                         "trace + straggler verdict with "
                         "tools/trace_report.py")
+    p.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                   help="arm mx.guard liveness in every worker "
+                        "(MXNET_TPU_GUARD=1) and poll the per-rank "
+                        "heartbeat files under --diagnostics-dir: a rank "
+                        "whose beat goes stale for more than this many "
+                        "seconds is SIGKILLed (a stuck-but-alive hang "
+                        "becomes a slot loss, which --elastic relaunches "
+                        "at the surviving world size). 0 (default) "
+                        "disables. Explicit flag only — the "
+                        "MXNET_TPU_HEARTBEAT_TIMEOUT_S env var is the "
+                        "WORKER-side staleness knob (this flag exports "
+                        "it), and its presence alone must not arm "
+                        "supervisor kills.")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="supervised relaunch (local launcher): when any "
                         "rank exits nonzero, tear down the peers, back "
@@ -473,12 +656,20 @@ def main(argv=None):
               "parameter servers; gradients reduce via XLA collectives",
               file=sys.stderr)
 
+    if args.heartbeat_timeout and not args.diagnostics_dir:
+        p.error("--heartbeat-timeout needs --diagnostics-dir (the "
+                "heartbeat files live under it)")
+
     if args.launcher == "ssh":
         if not args.hostfile:
             p.error("ssh launcher needs -H hostfile")
         if args.max_restarts or args.elastic:
             print("warning: --max-restarts/--elastic are local-launcher "
                   "only (supervise ssh gangs externally)", file=sys.stderr)
+        if args.heartbeat_timeout:
+            print("warning: --heartbeat-timeout is local-launcher only "
+                  "(remote heartbeat files are not visible here)",
+                  file=sys.stderr)
         with open(args.hostfile) as f:
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
@@ -490,7 +681,8 @@ def main(argv=None):
                         restart_backoff=args.restart_backoff,
                         elastic=args.elastic,
                         min_workers=args.min_workers,
-                        trace_dir=args.trace_dir)
+                        trace_dir=args.trace_dir,
+                        heartbeat_timeout=args.heartbeat_timeout)
 
 
 if __name__ == "__main__":

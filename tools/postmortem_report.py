@@ -94,6 +94,8 @@ def _status(pm):
         return "NAN", pm.get("note", "non-finite value")
     if reason == "watchdog":
         return "HUNG", pm.get("note", "watchdog fired")
+    if reason == "peer_lost":
+        return "PEER LOST", pm.get("note", "collective deadline expired")
     if reason == "exit":
         prior = {d.get("reason") for d in pm.get("prior_dumps", [])}
         flagged = sorted(prior & {"watchdog", "nan"})
@@ -195,6 +197,16 @@ def reshape_history(events):
     relaunched at (the reshape, when --elastic shrank/grew the gang)."""
     lines = []
     for e in events:
+        if e.get("kind") == "stale_heartbeat":
+            # supervisor-side liveness kill (tools/launch.py
+            # --heartbeat-timeout): the slot loss that precedes the
+            # restart event which reshapes the gang
+            lines.append(
+                f"  gen {e.get('generation')}: rank {e.get('rank')} "
+                f"heartbeat stale ({e.get('age_s')}s > "
+                f"{e.get('timeout_s')}s, last step {e.get('last_step')}) "
+                "-> KILLED by the supervisor")
+            continue
         if e.get("kind") != "restart":
             continue
         world = e.get("world_size")
@@ -202,11 +214,15 @@ def reshape_history(events):
         gen = e.get("attempt", "?")
         code = e.get("exit_code")
         what = {83: "preempted (state saved)", 84: "requested shrink",
-                85: "requested grow"}.get(code, f"failed (code {code})")
+                85: "requested grow",
+                86: "lost a peer (collective deadline)",
+                }.get(code, f"failed (code {code})")
         line = (f"  gen {int(gen) - 1 if isinstance(gen, int) else gen}"
                 f" ({world} worker(s)): rank {e.get('failed_rank')} {what}")
         if e.get("lost_ranks"):
             line += f", lost {e['lost_ranks']}"
+        if e.get("suspected_dead_ranks"):
+            line += f", suspected dead {e['suspected_dead_ranks']}"
         if new != world:
             line += f" -> RESHAPED to {new} worker(s)"
         else:
@@ -282,6 +298,41 @@ def report(args):
                     f" MB of {chk['capacity_bytes'] / 1e6:.1f} MB capacity "
                     f"(headroom {(chk.get('headroom_bytes') or 0) / 1e6:.1f}"
                     " MB)")
+        g = pm.get("guard")
+        if isinstance(g, dict) and "error" not in g:
+            # liveness/SDC story (mx.guard): the rank that stopped
+            # heartbeating, what the collective deadline concluded, and
+            # any silent-corruption verdicts/rollbacks
+            hb = g.get("heartbeat")
+            if isinstance(hb, dict) and hb.get("step") is not None:
+                lines.append(f"  guard: last heartbeat at step "
+                             f"{hb.get('step')} "
+                             f"(phase {hb.get('phase') or '?'})")
+            pl = g.get("peer_lost")
+            if isinstance(pl, dict):
+                sus = pl.get("suspect") or {}
+                who = (f"suspect rank {sus.get('rank')} (last beat step "
+                       f"{sus.get('step')}, {sus.get('age_s')}s stale)"
+                       if sus else "no peer heartbeat evidence")
+                dl = pl.get("deadline_s")
+                dl = f" ({dl}s)" if isinstance(dl, (int, float)) else ""
+                lines.append(f"  guard: collective deadline{dl} expired "
+                             f"— {who}")
+            sdc = g.get("last_sdc")
+            if isinstance(sdc, dict) and not sdc.get("ok", True):
+                corrupt = sdc.get("corrupt_ranks") or []
+                named = (f"corrupt rank(s) {corrupt}" if corrupt
+                         else "no majority to name a culprit")
+                line = (f"  guard: SDC digest mismatch at step "
+                        f"{sdc.get('step')}: {sdc.get('corrupt_replicas')}"
+                        f" of {sdc.get('replicas')} replica(s) disagree — "
+                        f"{named}")
+                if sdc.get("quarantined"):
+                    line += " -> QUARANTINED via elastic shrink"
+                lines.append(line)
+            if g.get("sdc_restores"):
+                lines.append(f"  guard: {g['sdc_restores']} rollback "
+                             "restore(s) to the last verified checkpoint")
         if status != "clean":
             failing.append(rank)
 
