@@ -6,7 +6,12 @@ host-loop token pays a full round trip, the on-device scan pays one.
 
 One JSON line per row:
   {"path": "on_device"|"host_loop", "tokens_per_sec": ..., "ms_per_dispatch":
-   ..., "dispatches": ..., "batch": B, "prompt": Lp, "new": N}
+   ..., "dispatches": ..., "batch": B, "prompt": Lp, "new": N,
+   "platform": ..., "devices": ..., "smoke_mode": ...}
+
+platform/devices/smoke_mode carry the provenance every bench row carries
+since PR 11: smoke_mode=true marks a CPU-fallback row whose numbers must
+never be compared against TPU rows.
 
 tokens_per_sec is END-TO-END (prompt ingestion + N new tokens) so the two
 rows are directly comparable; dispatches makes the mechanism visible —
@@ -28,10 +33,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def main():
     import bench
-    on_tpu = bench.probe_tpu()
+    on_tpu = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
     if on_tpu:
         bench.acquire_bench_lock()
-        bench.enable_compile_cache()
 
     import jax
     import numpy as np
@@ -40,6 +45,9 @@ def main():
         from jax.extend.backend import clear_backends
         clear_backends()
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache on every platform: a warm re-run skips the
+    # whole-generation program's cold compile (the dominant cost here)
+    bench.enable_compile_cache()
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
@@ -75,6 +83,9 @@ def main():
             "dispatches": dispatches,
             "batch": B, "prompt": Lp, "new": N,
             "backend": jax.default_backend(),
+            "platform": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "smoke_mode": not on_tpu,
         }), flush=True)
 
 

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""mx.serve load benchmark: a Poisson OPEN-LOOP generator (arrivals do
+not wait for completions — the honest way to measure an overloadable
+server) against the continuous-batching scheduler.
+
+One JSON line:
+  {"tokens_per_sec": ..., "requests_per_sec": ..., "ttft_p50_ms": ...,
+   "ttft_p99_ms": ..., "requests": ..., "completed": ..., "rejected":
+   ..., "shed": ..., "deadline_missed": ..., "cancelled": ...,
+   "degraded": ..., "requeues": ..., "slots": ..., "queue_depth": ...,
+   "offered_rps": ..., "platform": ..., "devices": ..., "smoke_mode":
+   ...}
+
+The row contract (and zero deadline misses at low load) is asserted by
+ci/run.sh sanity. tokens_per_sec counts GENERATED tokens over the
+span from first submit to last completion; ttft is submit-to-first-
+token. Knobs via env: MXNET_TPU_BENCH_SERVE_REQUESTS / _RATE (req/s) /
+_DEADLINE_MS. CPU smoke mode (tiny model) when no TPU; GPT-2 117m bf16
+on the chip. Rides the persistent compile cache like every bench."""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              int(round(q / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def main():
+    import bench
+    on_tpu = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
+    if on_tpu:
+        bench.acquire_bench_lock()
+
+    import jax
+    import numpy as np
+
+    if not on_tpu:
+        from jax.extend.backend import clear_backends
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+    bench.enable_compile_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel, serve
+    from mxnet_tpu.models import gpt as gpt_mod
+
+    parallel.make_mesh(dp=-1)
+    if on_tpu:
+        cfg = gpt_mod.gpt2_117m_config(dtype="bfloat16")
+        n_requests, rate, slots = 64, 8.0, 8
+        lp_range, new_range = (16, 64), (16, 64)
+    else:
+        cfg = gpt_mod.gpt_tiny_config()
+        n_requests, rate, slots = 16, 40.0, 4
+        lp_range, new_range = (4, 12), (4, 10)
+    n_requests = int(os.environ.get("MXNET_TPU_BENCH_SERVE_REQUESTS",
+                                    n_requests))
+    rate = float(os.environ.get("MXNET_TPU_BENCH_SERVE_RATE", rate))
+    deadline_ms = float(os.environ.get("MXNET_TPU_BENCH_SERVE_DEADLINE_MS",
+                                       30_000.0))
+
+    model = gpt_mod.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    model.initialize()
+    rng = np.random.RandomState(0)
+
+    srv = serve.Server(model, slots=slots)
+    # warm the common bucket so the measured window is steady-state, not
+    # the one-off jit compile (the persistent cache makes re-runs warm)
+    warm = srv.submit(rng.randint(0, cfg["vocab_size"], (lp_range[1],))
+                      .astype(np.int32), max_new_tokens=new_range[1])
+    srv.drain()
+    assert warm.state == serve.DONE
+
+    # open loop: Poisson interarrivals, pre-drawn so the offered load is
+    # independent of how the server keeps up
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    prompts = [rng.randint(0, cfg["vocab_size"],
+                           (rng.randint(*lp_range),)).astype(np.int32)
+               for _ in range(n_requests)]
+    news = [int(rng.randint(*new_range)) for _ in range(n_requests)]
+
+    srv.start()
+    reqs = []
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        delay = arrivals[i] - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        reqs.append(srv.submit(prompts[i], max_new_tokens=news[i],
+                               deadline_ms=deadline_ms))
+    # a consumer per request: streams drain concurrently (and honor any
+    # injected slow_client fault) without blocking the scheduler
+    threads = [threading.Thread(target=lambda r=r: list(r.stream()))
+               for r in reqs]
+    for th in threads:
+        th.start()
+    for r in reqs:
+        r.result(timeout=600)
+    wall = time.perf_counter() - t0
+    for th in threads:
+        th.join(timeout=60)
+    srv.stop()
+
+    st = srv.stats()
+    ttfts = sorted(r.ttft_s * 1e3 for r in reqs if r.ttft_s is not None)
+    done = [r for r in reqs if r.state == serve.DONE]
+    tokens = sum(len(r.tokens) for r in reqs)
+    row = {
+        "tokens_per_sec": round(tokens / wall, 1),
+        "requests_per_sec": round(len(done) / wall, 2),
+        "ttft_p50_ms": round(_percentile(ttfts, 50), 2) if ttfts else None,
+        "ttft_p99_ms": round(_percentile(ttfts, 99), 2) if ttfts else None,
+        "requests": n_requests,
+        "completed": len(done),
+        "rejected": st["rejected"],
+        "shed": st["shed"],
+        "deadline_missed": st["expired"],
+        "cancelled": st["cancelled"],
+        "degraded": st["degraded"],
+        "requeues": st["requeues"],
+        "slots": slots,
+        "queue_depth": srv._queue_depth,
+        "offered_rps": round(rate, 2),
+        "platform": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "smoke_mode": not on_tpu,
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

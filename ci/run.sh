@@ -353,6 +353,82 @@ print('guard disabled fast path OK (no beats, no deadline, no digests)')
         tests/unittest/test_guard.py::test_hang_detected_killed_and_relaunched \
         tests/unittest/test_guard.py::test_corrupt_grad_vote_restores_bit_exact \
         -q -p no:cacheprovider
+    # serve must be disabled by default: the shared decode dispatch site
+    # (jit_flat_step) makes zero note_dispatch calls while no Server
+    # exists and the knob is off — the zero-overhead fast path; a
+    # constructed Server arms it
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, serve
+from mxnet_tpu.models import gpt as gpt_mod
+assert not serve.enabled(), 'serve must default to off'
+calls = {'dispatch': 0}
+real = serve.note_dispatch
+serve.note_dispatch = lambda *a, **k: (calls.__setitem__('dispatch', calls['dispatch'] + 1), real(*a, **k))[1]
+parallel.make_mesh(dp=-1)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+mx.random.seed(0); model.initialize()
+model.generate(np.arange(4, dtype=np.int32)[None], max_new_tokens=4,
+               on_device=False)
+serve.note_dispatch = real
+assert calls == {'dispatch': 0}, calls
+assert serve.dispatches() == 0, 'disabled fast path counted dispatches'
+print('serve disabled fast path OK (no decode-hook calls)')
+"
+    # serving acceptance smoke (slow-marked out of the tier-1 sweep):
+    # queue full + slow client + mid-generation cancel + deadline expiry
+    # + forced memory rejection at admission — the scheduler never
+    # raises, never dispatches a predicted-overrun batch, evicts expired
+    # slots between decode steps, and every completed request's tokens
+    # are bit-identical to its unloaded single-request generation
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_serve.py::test_overload_acceptance_smoke \
+        -q -p no:cacheprovider
+    # bench_serve row contract: the Poisson open-loop load generator
+    # reports throughput, TTFT percentiles and every overload counter —
+    # and a low-load CPU smoke must complete everything with ZERO
+    # deadline misses
+    JAX_PLATFORMS=cpu MXNET_TPU_BENCH_FORCE_CPU=1 \
+        python benchmarks/bench_serve.py \
+        > /tmp/_bench_serve.out 2>/dev/null
+    tail -1 /tmp/_bench_serve.out > /tmp/_bench_serve.json
+    python -c "
+import json
+d = json.load(open('/tmp/_bench_serve.json'))
+for k in ('tokens_per_sec', 'requests_per_sec', 'ttft_p50_ms',
+          'ttft_p99_ms', 'requests', 'completed', 'rejected', 'shed',
+          'deadline_missed', 'cancelled', 'degraded', 'requeues',
+          'slots', 'queue_depth', 'offered_rps', 'platform', 'devices',
+          'smoke_mode'):
+    assert k in d, f'bench_serve JSON missing {k}: {sorted(d)}'
+assert d['tokens_per_sec'] > 0 and d['requests_per_sec'] > 0, d
+assert d['ttft_p50_ms'] is not None and d['ttft_p99_ms'] >= d['ttft_p50_ms']
+assert d['completed'] == d['requests'], \
+    f'low-load smoke must complete everything: {d}'
+assert d['deadline_missed'] == 0, \
+    f'low-load smoke must miss zero deadlines: {d}'
+assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
+print('bench_serve contract OK:', {k: d[k] for k in
+      ('tokens_per_sec', 'ttft_p50_ms', 'ttft_p99_ms',
+       'requests_per_sec', 'deadline_missed')})
+"
+    # bench_generate rows carry platform provenance like every bench row
+    # since PR 11 (smoke_mode=true CPU rows never compare against TPU)
+    JAX_PLATFORMS=cpu MXNET_TPU_BENCH_FORCE_CPU=1 \
+        python benchmarks/bench_generate.py \
+        > /tmp/_bench_gen.out 2>/dev/null
+    python -c "
+import json
+rows = [json.loads(l) for l in open('/tmp/_bench_gen.out')
+        if l.strip().startswith('{')]
+assert len(rows) == 2, rows
+for d in rows:
+    for k in ('platform', 'devices', 'smoke_mode', 'tokens_per_sec'):
+        assert k in d, f'bench_generate row missing {k}: {sorted(d)}'
+    assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
+print('bench_generate provenance OK')
+"
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "
@@ -389,6 +465,7 @@ static_stage() {
         tests/unittest/test_telemetry.py tests/unittest/test_check.py \
         tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
         tests/unittest/test_trace.py tests/unittest/test_guard.py \
+        tests/unittest/test_serve.py \
         -q -m 'not slow' -p no:cacheprovider
 }
 

@@ -58,6 +58,7 @@ _LAZY = {
     "memsafe": ".memsafe",
     "check": ".check",
     "guard": ".guard",
+    "serve": ".serve",
     "trace": ".trace",
     "inspect": ".inspect",
     "dataflow": ".dataflow",

@@ -290,7 +290,13 @@ register_option(
     "replica of the first gradient/parameter leaf as the step-4 update "
     "lands — the SDC the mx.guard digest vote must catch and attribute), "
     "'stall_heartbeat:500' (suppress heartbeat file writes for 500 ms; "
-    "the process stays healthy, only its liveness signal goes dark). "
+    "the process stays healthy, only its liveness signal goes dark), "
+    "'slow_client:200' (mx.serve: the request stream consumer stalls "
+    "200 ms per token — scheduler throughput must not care), "
+    "'burst:8@step:3' (mx.serve: the server fires its on_burst hook "
+    "with 8 at scheduler step 3 — a deterministic load spike), "
+    "'cancel@req:2' (mx.serve: cancel request id 2 at the next "
+    "scheduler step — the mid-generation cancellation drill). "
     "Append '@rank:N' to target "
     "one rank, '@every_restart' to "
     "re-fire after a supervised relaunch. Empty (default) injects "
@@ -525,6 +531,53 @@ register_option(
     "corrupt rank, and roll the gang back to the last verified "
     "checkpoint (a twice-corrupt rank is quarantined via the elastic "
     "shrink path). Needs param_mode='replicate'. 0 (default) disables.")
+register_option(
+    "serve", False,
+    "Arm mx.serve instrumentation at import: the shared decode dispatch "
+    "site (models/_decode.jit_flat_step) counts dispatches for the "
+    "serving scheduler. Off by default: the hook reduces to a single "
+    "module-bool check — zero calls, zero allocations (asserted by "
+    "ci/run.sh sanity). Constructing a serve.Server arms it regardless.")
+register_option(
+    "serve_slots", 4,
+    "Decode batch slots per KV-cache bucket in the mx.serve continuous-"
+    "batching scheduler: each active bucket runs one batched step over "
+    "this many request slots (its caches are (slots, H, bucket, D)). "
+    "More slots = more requests decoded per dispatch, more KV memory "
+    "per bucket.")
+register_option(
+    "serve_queue_depth", 64,
+    "Bound on the mx.serve admission queue. A submit beyond it triggers "
+    "the serve_shed load-shedding policy instead of growing the queue "
+    "without limit — the backpressure half of overload safety.")
+register_option(
+    "serve_shed", "reject", choices=("reject", "oldest"),
+    doc="mx.serve load-shedding policy when the bounded queue is full: "
+        "'reject' turns the NEW request away (503-style verdict, the "
+        "client can back off), 'oldest' displaces the longest-waiting "
+        "queued request in favor of the newcomer (freshness over "
+        "fairness — right for requests whose answers go stale).")
+register_option(
+    "serve_deadline_ms", 0.0,
+    "Default per-request deadline for mx.serve, in milliseconds from "
+    "submit (per-request deadline_ms overrides). Expired requests are "
+    "evicted between decode steps — mid-generation — and their KV pages "
+    "reclaimed; requests that expire while still queued are dropped "
+    "with the same 504-style verdict. 0 (default) sets no deadline.")
+register_option(
+    "serve_min_new_tokens", 1,
+    "Floor for the mx.serve graceful-degradation shrink rung: under "
+    "memory pressure a request's max_new_tokens may be clamped down to "
+    "the largest KV bucket that fits, but never below this many new "
+    "tokens — beyond that the ladder moves to evict-and-requeue, then "
+    "rejection.")
+register_option(
+    "serve_buckets", "",
+    "Comma-separated total-length (prompt + max_new_tokens) buckets for "
+    "the mx.serve KV caches, e.g. '64,128,256'. Empty (default) uses "
+    "power-of-two buckets floored at bucket_pad_min and capped at the "
+    "model's max_length — either way a stream of novel request lengths "
+    "compiles at most one step executable per bucket.")
 register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "

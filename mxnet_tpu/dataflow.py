@@ -49,7 +49,8 @@ from . import telemetry as _telemetry
 from . import trace as _trace
 
 __all__ = ["prefetch_to_mesh", "MeshPrefetcher", "BucketPad",
-           "ensure_compile_cache", "autofit", "AutofitResult"]
+           "bucket_length", "ensure_compile_cache", "autofit",
+           "AutofitResult"]
 
 _M_DEPTH = _telemetry.gauge(
     "dataloader_prefetch_depth", "batches buffered ahead of the consumer "
@@ -370,6 +371,27 @@ def prefetch_to_mesh(iterator, trainer_or_shardings=None, depth=None,
 # shape bucketing
 # ---------------------------------------------------------------------------
 
+def bucket_length(length, buckets="pow2", floor=None):
+    """The bucket a raw length rounds up to — the ONE bucketing policy
+    shared by `BucketPad` (varlen batch axes) and `mx.serve` (KV-cache
+    lengths), so the two subsystems can never bucket the same stream
+    differently. `buckets` is a sorted sequence of sizes or \"pow2\"
+    (next power of two, floored at `floor` — default the
+    `bucket_pad_min` knob). Lengths above the largest configured bucket
+    keep their raw size (one compile per such outlier, same as
+    unbucketed)."""
+    length = int(length)
+    if buckets == "pow2":
+        if floor is None:
+            floor = max(1, int(_config.get("bucket_pad_min")))
+        return max(int(floor),
+                   1 << max(0, math.ceil(math.log2(max(length, 1)))))
+    for b in buckets:
+        if b >= length:
+            return int(b)
+    return length
+
+
 class BucketPad:
     """Pad varlen batches up to configured (or power-of-two) buckets so a
     stream of novel raw lengths compiles a bounded set of step executables.
@@ -405,13 +427,7 @@ class BucketPad:
         self.append_valid_length = append_valid_length
 
     def _bucket(self, length, buckets):
-        if buckets == "pow2":
-            floor = max(1, int(_config.get("bucket_pad_min")))
-            return max(floor, 1 << max(0, math.ceil(math.log2(max(length, 1)))))
-        for b in buckets:
-            if b >= length:
-                return b
-        return length  # above the largest bucket: keep raw (one-off compile)
+        return bucket_length(length, buckets)
 
     def _pad_leaf(self, leaf, pad_value, collect_valid):
         arr = _raw(leaf)

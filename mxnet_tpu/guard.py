@@ -301,7 +301,9 @@ def heartbeat(step=None, phase="", force=False):
         # data-prep phase read as a dead peer. Dispatch must arm —
         # a FIRST step blocked in a dead peer's collective never
         # completes, and its hang still has to fire the deadline.
-        d.notify(rec["step"], arm=phase.startswith("step"))
+        # Serving gangs arm the same way: an mx.serve scheduler step
+        # is the serving analog of a train step.
+        d.notify(rec["step"], arm=phase.startswith(("step", "serve")))
     if _telemetry._enabled:
         _M_HB_AGE.set(0.0)
     stall_ms = _consume_stall()

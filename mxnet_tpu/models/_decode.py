@@ -42,6 +42,46 @@ def cached_self_attention_step(q, k_new, v_new, k_cache, v_cache, t):
     return apply_op(f, q, k_new, v_new, k_cache, v_cache, t)
 
 
+def batched_cached_attention_step(q, k_new, v_new, k_cache, v_cache, t):
+    """`cached_self_attention_step` with PER-ROW positions — the
+    continuous-batching variant mx.serve's decode slots need: row b
+    writes its K/V at its own position t[b] and attends over positions
+    <= t[b]. The math per row is exactly the scalar-t version's
+    (f32 score/softmax/PV accumulation), so a request's logits do not
+    depend on what the other slots are doing — the property mx.serve's
+    bit-identical-under-load guarantee rests on.
+
+    q/k_new/v_new (B,H,1,D); caches (B,H,Lmax,D); t (B,) traced int.
+    Returns (out (B,1,H*D), new_k, new_v)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ndarray import apply_op
+
+    def f(q_, kn, vn, kc, vc, tt):
+        ti = tt.astype(jnp.int32)                      # (B,)
+
+        def write(c, n, t1):                           # (H,L,D),(H,1,D)
+            return lax.dynamic_update_slice(c, n.astype(c.dtype),
+                                            (0, t1, 0))
+
+        kc = jax.vmap(write)(kc, kn, ti)
+        vc = jax.vmap(write)(vc, vn, ti)
+        B, H, _, D = q_.shape
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / (D ** 0.5)
+        valid = jnp.arange(kc.shape[2])[None, None, None, :] \
+            <= ti[:, None, None, None]
+        s = jnp.where(valid, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                       vc.astype(jnp.float32)).astype(q_.dtype)
+        return o.transpose(0, 2, 1, 3).reshape(B, 1, H * D), kc, vc
+
+    return apply_op(f, q, k_new, v_new, k_cache, v_cache, t)
+
+
 def beam_search_loop(logits0, step, reorder, B, beam, eos, max_steps,
                      alpha=0.6, seqs0=None, lengths0=1):
     """Host-side beam bookkeeping shared by TransformerNMT.beam_search and
@@ -128,10 +168,17 @@ def jit_flat_step(model, step_fn, n_state, donate_state=0):
 
     Returns run(*leading_arrays, state_list) -> (primary, new_state) with
     everything jitted; `leading` are the per-call scalars/arrays before the
-    flat state (token ids, step index, masks...)."""
+    flat state (token ids, step index, masks...). The runner also carries
+    `run.aot_exec_peak(*leading_avals, state_avals)` — AOT lower+compile
+    at those (shape, dtype)s purely for XLA memory analysis (mx.serve's
+    admission control budgets KV-cache growth with it; nothing is
+    dispatched and no batch transfers)."""
+    import time
+
     import jax
 
     from .. import check as _check
+    from .. import serve as _serve
     from ..gluon.block import functional_call
 
     class _Step(HybridBlock):
@@ -173,7 +220,39 @@ def jit_flat_step(model, step_fn, n_state, donate_state=0):
             except _check.CheckError:
                 cache.pop(len(leading), None)
                 raise
-        outs, _ = entry(gp_data, aux_data, rng, *leading, *state)
+        if _serve._enabled:
+            t0 = time.perf_counter()
+            outs, _ = entry(gp_data, aux_data, rng, *leading, *state)
+            _serve.note_dispatch(type(model).__name__, t0)
+        else:
+            outs, _ = entry(gp_data, aux_data, rng, *leading, *state)
         return outs[0], list(outs[1:])
 
+    def aot_exec_peak(*args):
+        """Execution-peak bytes (beyond argument buffers) of a call with
+        these (shape, dtype) arguments — jax.ShapeDtypeStructs or arrays;
+        pure AOT analysis via mx.memsafe, no dispatch, no transfer, and
+        nothing installed into the call cache (the real first call still
+        runs the mx.check lint; with compile_cache_dir set it
+        deserializes this same executable warm). None when the backend
+        withholds memory analysis."""
+        from .. import memsafe as _memsafe
+
+        leading, state = args[:-1], list(args[-1])
+        gp_data = [p.data()._data for _, p in gp]
+        aux_data = [p.data()._data for _, p in aux]
+        base = 3 + len(leading)
+        donate = tuple(range(base, base + int(donate_state)))
+        jitted = jax.jit(pure, donate_argnums=donate)
+
+        def aval(x):
+            if isinstance(x, jax.ShapeDtypeStruct):
+                return x
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+        full = (gp_data, aux_data, rng) + tuple(aval(a) for a in leading) \
+            + tuple(aval(s) for s in state)
+        return _memsafe.aot_exec_peak(jitted, full)
+
+    run.aot_exec_peak = aot_exec_peak
     return run

@@ -149,6 +149,34 @@ class GPTBlock(HybridBlock):
                                        act_type="gelu"))
         return x + h2, k_cache, v_cache
 
+    def step_slots(self, x, k_cache, v_cache, t):
+        """`step` with PER-SLOT positions t (B,) — the mx.serve
+        continuous-batching variant: each batch row is an independent
+        request at its own decode position. Row math is identical to
+        `step`'s, so a row's output never depends on its neighbors."""
+        from ..ndarray import apply_op
+        from ._decode import batched_cached_attention_step
+
+        attn = self.attn
+        H = attn._num_heads
+        qkv = attn.qkv(self.ln1(x))             # (B, 1, 3E)
+        B, _, E3 = qkv.shape
+        D = E3 // 3 // H
+
+        def split(qkv_d):
+            r = qkv_d.reshape(B, 1, 3, H, D)
+            return (r[:, :, 0].transpose(0, 2, 1, 3),
+                    r[:, :, 1].transpose(0, 2, 1, 3),
+                    r[:, :, 2].transpose(0, 2, 1, 3))   # (B,H,1,D) each
+
+        q, k_new, v_new = apply_op(split, qkv)
+        o, k_cache, v_cache = batched_cached_attention_step(
+            q, k_new, v_new, k_cache, v_cache, t)
+        x = x + attn.proj(o)
+        h2 = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
+                                       act_type="gelu"))
+        return x + h2, k_cache, v_cache
+
 
 class GPTModel(HybridBlock):
     """Token+position embeddings -> pre-LN block stack -> final LN.
@@ -261,6 +289,32 @@ class GPTForCausalLM(HybridBlock):
         new_k, new_v = [], []
         for i, layer in enumerate(g.layers):
             x, k, v = layer.step(x, self_k[i], self_v[i], t)
+            new_k.append(k)
+            new_v.append(v)
+        x = g.ln_f(x)
+        logits = apply_op(
+            lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
+            x, g.word_embed.weight.data())
+        return logits.reshape(shape=(tok.shape[0], -1)), new_k, new_v
+
+    def decode_step_slots(self, tok, t, self_k, self_v):
+        """`decode_step` with PER-SLOT positions: tok (B,) int32, t (B,)
+        traced int32 — batch row b is an independent request at its own
+        position t[b] (mx.serve's continuous-batching decode). Returns
+        (logits (B,V), new_self_k, new_self_v); one compile serves every
+        position mix in a (B, cache-length) bucket."""
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+
+        g = self.gpt
+        x = g.word_embed(tok.reshape(shape=(-1, 1)))
+        pos = apply_op(
+            lambda pe, tt: pe[tt.astype(jnp.int32)][:, None, :],
+            NDArray(g.position_embed.data()._data), t)
+        x = x + pos
+        new_k, new_v = [], []
+        for i, layer in enumerate(g.layers):
+            x, k, v = layer.step_slots(x, self_k[i], self_v[i], t)
             new_k.append(k)
             new_v.append(v)
         x = g.ln_f(x)

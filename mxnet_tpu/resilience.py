@@ -1023,6 +1023,19 @@ class FaultInjector:
                               the process stays healthy, only its
                               liveness signal goes dark — the
                               supervisor-side staleness drill
+      slow_client:200       — mx.serve: the request STREAM consumer
+                              stalls 200 ms per token (consumed by
+                              Request.stream at its first read); the
+                              scheduler's throughput must not care
+      burst:8@step:3        — mx.serve: at scheduler step 3 the server
+                              fires its on_burst hook with 8 — a
+                              deterministic load spike driving the
+                              shed / backpressure paths
+      cancel@req:2          — mx.serve: cancel request id 2 at the next
+                              scheduler step (append @step:N to pick
+                              the step) — the mid-generation
+                              cancellation drill; the slot is evicted
+                              between decode steps
     Any spec may append @rank:N to fire on that rank only. Specs fire at
     most once, and only on the FIRST launch (MXNET_TPU_RESTART_COUNT=0)
     unless @every_restart is appended — a relaunched gang must not re-kill
@@ -1049,13 +1062,15 @@ class FaultInjector:
             head = fields[0]
             kind, _, arg = head.partition(":")
             spec = {"kind": kind, "arg": arg, "step": None, "rank": None,
-                    "every_restart": False, "fired": False}
+                    "req": None, "every_restart": False, "fired": False}
             for field in fields[1:]:
                 k, _, v = field.partition(":")
                 if k == "step":
                     spec["step"] = int(v)
                 elif k == "rank":
                     spec["rank"] = int(v)
+                elif k == "req":
+                    spec["req"] = int(v)
                 elif k == "every_restart":
                     spec["every_restart"] = True
                 else:
@@ -1065,12 +1080,14 @@ class FaultInjector:
             if spec["kind"] not in ("sigterm", "kill", "corrupt_ckpt",
                                     "stall_input", "exc", "shrink", "grow",
                                     "oom", "hang", "corrupt_grad",
-                                    "stall_heartbeat"):
+                                    "stall_heartbeat", "slow_client",
+                                    "burst", "cancel"):
                 raise ValueError(
                     f"fault_inject: unknown fault {spec['kind']!r} in "
                     f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
                     "stall_input, exc, shrink, grow, oom, hang, "
-                    "corrupt_grad, stall_heartbeat)")
+                    "corrupt_grad, stall_heartbeat, slow_client, burst, "
+                    "cancel)")
             specs.append(spec)
         return cls(specs)
 
@@ -1153,11 +1170,37 @@ class FaultInjector:
             while True:
                 time.sleep(3600)
 
+    def take(self, kind, step=None, ready=None):
+        """Pop one armed spec of `kind` for a caller that implements the
+        fault itself (mx.serve's scheduler: burst, cancel). Honors @rank
+        and the one-shot / first-launch-only disarm rules; a spec with
+        @step:N fires only when `step` matches, a step-less spec fires
+        at the first opportunity. `ready(spec)` False leaves the spec
+        ARMED instead of consuming it — how a step-less cancel@req:N
+        waits for request N to exist rather than burning itself on an
+        idle scheduler tick. Returns {"arg", "req"} or None."""
+        rank = _process_index()
+        for spec in self._specs:
+            if spec["fired"] or spec["kind"] != kind:
+                continue
+            if spec["rank"] is not None and spec["rank"] != rank:
+                continue
+            if not spec["every_restart"] and restart_count() > 0:
+                continue
+            if spec["step"] is not None and step != spec["step"]:
+                continue
+            if ready is not None and not ready(spec):
+                continue
+            spec["fired"] = True
+            return {"arg": spec["arg"] or "", "req": spec["req"]}
+        return None
+
     def consume(self, kind):
         """Pop one armed spec of `kind` (honoring @rank targeting and
         the one-shot / first-launch-only disarm rules) and return its
         arg string, or None. How point-less specs like stall_heartbeat
-        reach the subsystem that implements them (mx.guard)."""
+        reach the subsystem that implements them (mx.guard,
+        mx.serve's slow_client)."""
         rank = _process_index()
         for spec in self._specs:
             if spec["fired"] or spec["kind"] != kind:

@@ -1,0 +1,1115 @@
+"""mx.serve — overload-safe inference serving.
+
+The training runtime is production-grade (elastic, never-OOM, guarded)
+but a model that cannot answer a request serves nobody. This module is
+the request path, built robustness-first over the existing donated-KV
+decode machinery (`models/_decode.jit_flat_step`): a continuous-batching
+decode scheduler that never device-OOMs, never wedges on a slow client,
+and sheds load gracefully instead of falling over.
+
+Mechanics — the Orca-style token-level continuous batching loop:
+
+  * **fixed batch slots, bucketed KV caches** — requests are grouped by
+    the `dataflow.bucket_length` bucket of their total length
+    (prompt + max_new_tokens); each active bucket owns one KV cache of
+    shape (slots, H, bucket, D) per layer and ONE step executable
+    (per-slot positions, `GPTForCausalLM.decode_step_slots`), so a
+    stream of novel lengths compiles at most one executable per bucket
+    — never one per length. Caches are allocated when a bucket first
+    admits and freed when it drains ("pages" reclaimed).
+  * **admit/evict per decode step** — every scheduler step evicts
+    expired slots, admits queued requests into free slots, runs one
+    batched decode step per active bucket (prompt tokens are fed
+    through the same step: prefill IS decode, so under-load and
+    unloaded requests run the SAME executable and their outputs are
+    bit-identical), and streams freshly sampled tokens to each
+    request's consumer.
+
+Robustness — the request lifecycle:
+
+  * **admission control** — every accept is gated on AOT KV-cache
+    budgeting (mx.memsafe `check_budget` over the bucket's cache bytes
+    + resident params + the step executable's AOT-compiled execution
+    peak, `jit_flat_step(...).aot_exec_peak`). A predicted overrun is a
+    `429`-style verdict on the request — never a device OOM, never a
+    dispatched predicted-overrun batch.
+  * **bounded queue, backpressure, load shedding** — the submit queue
+    holds at most `serve_queue_depth` requests; beyond that the
+    `serve_shed` policy rejects the newcomer (`reject`) or displaces
+    the oldest waiter (`oldest`), each with a `503`-style verdict.
+  * **deadlines with mid-generation cancellation** — a request carries
+    an absolute deadline (`deadline_ms` or the `serve_deadline_ms`
+    default); expired slots are evicted BETWEEN decode steps (partial
+    tokens already streamed stay delivered) and their KV pages
+    reclaimed. `Server.cancel` / the `cancel@req:N` fault do the same
+    on demand.
+  * **retry/backoff on transient dispatch faults** — each batched step
+    dispatch runs under `resilience.RetryPolicy` (exponential backoff,
+    retryable-exception classification); donated-buffer safety is
+    checked before every retry.
+  * **graceful degradation under pressure** — when admission predicts
+    an overrun the server walks a ladder mirroring memsafe's: (1)
+    shrink the request's max_new_tokens to the largest bucket that
+    fits (floored at `serve_min_new_tokens`), (2) evict-and-requeue
+    the YOUNGEST running request (its replay is deterministic, already
+    -streamed tokens are not re-sent), each transition annotated in
+    telemetry, then (3) reject with the budget accounting only when
+    the request cannot fit even alone.
+
+Every path is deterministically testable: `resilience.FaultInjector`
+grows `slow_client:ms` (stream consumer stalls; the scheduler must not
+care), `burst:N@step:K` (K-th scheduler step injects N requests via
+`Server.on_burst`) and `cancel@req:N` (mid-generation cancellation).
+mx.guard heartbeats carry a `serve` phase; mx.trace spans cover
+admit / queue-wait / decode-step / stream so `tools/trace_report.py`
+can issue queue-bound vs decode-bound verdicts.
+
+Cost model: DISABLED (the default) is the production fast path — the
+decode dispatch hook site checks one module bool (`ci/run.sh sanity`
+asserts zero `note_dispatch` calls). Constructing a `Server` arms it.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _pyqueue
+import sys
+import threading
+import time
+
+import numpy as np
+
+from . import _locklint
+from . import config as _config
+from . import diagnostics as _diagnostics
+from . import guard as _guard
+from . import memsafe as _memsafe
+from . import resilience as _resilience
+from . import telemetry as _telemetry
+from . import trace as _trace
+
+__all__ = [
+    "Server", "Request", "enable", "disable", "enabled", "note_dispatch",
+    "QUEUED", "RUNNING", "DONE", "REJECTED", "SHED", "EXPIRED",
+    "CANCELLED", "FAILED", "TERMINAL",
+]
+
+# request lifecycle states
+QUEUED = "queued"        # accepted, waiting for a slot
+RUNNING = "running"      # owns a batch slot, decoding
+DONE = "done"            # all tokens generated (or eos)
+REJECTED = "rejected"    # admission control refused (429-style)
+SHED = "shed"            # load shedding dropped it (503-style)
+EXPIRED = "expired"      # deadline passed; evicted between decode steps
+CANCELLED = "cancelled"  # client/injected cancellation (499-style)
+FAILED = "failed"        # scheduler error surfaced to the request (500)
+TERMINAL = frozenset({DONE, REJECTED, SHED, EXPIRED, CANCELLED, FAILED})
+
+_lock = _locklint.make_lock("serve.module")
+_enabled = False          # the fast-path bool; the decode hook reads it
+_dispatches = 0           # decode dispatches seen at the shared hook site
+
+_M_REQUESTS = _telemetry.counter(
+    "serve_requests_total", "serving requests by terminal outcome "
+    "(completed / rejected / shed / expired / cancelled / failed)")
+_M_TOKENS = _telemetry.counter(
+    "serve_tokens_total", "tokens generated and streamed by mx.serve")
+_M_DEADLINE_MISS = _telemetry.counter(
+    "serve_deadline_missed_total", "requests whose deadline expired "
+    "(evicted between decode steps, or expired while still queued)")
+_M_DEGRADED = _telemetry.counter(
+    "serve_degraded_total", "graceful-degradation ladder transitions, by "
+    "action: shrink_max_new (request admitted with a clamped token "
+    "budget) or evict_requeue (youngest running request evicted and "
+    "requeued to free KV pages)")
+_M_TTFT = _telemetry.histogram(
+    "serve_ttft_seconds", "time-to-first-token: submit to the first "
+    "generated token landing in the request's stream")
+_M_QWAIT = _telemetry.histogram(
+    "serve_queue_wait_seconds", "time a request waited in the bounded "
+    "queue before admission to a decode slot")
+_M_QDEPTH = _telemetry.gauge(
+    "serve_queue_depth", "requests currently waiting in the bounded "
+    "admission queue (capacity serve_queue_depth)")
+_M_ACTIVE = _telemetry.gauge(
+    "serve_active_requests", "requests currently holding a decode slot")
+
+_EOS_SENTINEL = object()
+
+
+def enabled():
+    """True while mx.serve instrumentation is armed (the decode dispatch
+    hook reads the module bool directly; this is the public spelling)."""
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def note_dispatch(model_name, t0=None):
+    """Decode-dispatch hook, called from `models/_decode.jit_flat_step`
+    while serving is armed: counts every dispatch through the shared
+    donated-KV decode path (the scheduler's own steps and any concurrent
+    `generate()` traffic). Callers gate on the module bool — this
+    function is never reached while disabled (ci sanity counts the
+    calls)."""
+    global _dispatches
+    with _lock:
+        _dispatches += 1
+
+
+def dispatches():
+    """Decode dispatches observed at the shared hook site this process."""
+    with _lock:
+        return _dispatches
+
+
+def _fmt_bytes(n):
+    from .util import fmt_bytes
+    return fmt_bytes(n, show_raw=True)
+
+
+# ---------------------------------------------------------------------------
+# Request
+# ---------------------------------------------------------------------------
+
+class Request:
+    """One generation request moving through the serving lifecycle.
+
+    Public surface: `id` (admission-order sequence number — the N the
+    `cancel@req:N` fault spec targets), `state` / `verdict` (terminal
+    verdicts are HTTP-flavored: '200 ok', '429 ...', '503 ...',
+    '504 deadline ...', '499 cancelled', '500 ...'), `tokens` (generated
+    so far), `max_new_tokens` (EFFECTIVE — the shrink rung may clamp it,
+    recorded in `degraded`), `requeues`, and the timing properties
+    `queue_wait_s` / `ttft_s`.
+
+    Consume results with `stream()` (yields tokens as they are
+    generated; honors the `slow_client:ms` fault spec) or
+    `result(timeout)` (blocks until terminal, returns the token array).
+    Both need someone driving the scheduler: `Server.start()` (the
+    background thread) or explicit `Server.step()`/`drain()` calls.
+    """
+
+    def __init__(self, seq, prompt, max_new_tokens, eos, temperature,
+                 top_k, seed, deadline):
+        self.id = seq
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.requested_new_tokens = int(max_new_tokens)
+        self.eos = eos
+        self.temperature = float(temperature or 0.0)
+        self.top_k = int(top_k or 0)
+        self.seed = int(seed)
+        self.deadline = deadline          # absolute, on the server's clock
+        self.state = QUEUED
+        self.verdict = None
+        self.tokens = []
+        self.degraded = None
+        self.requeues = 0
+        self.evicted_once = False         # each request triggers <= 1 evict
+        self._streamed = 0                # replay high-water mark
+        self._rng = None
+        self._stream_q = _pyqueue.Queue()
+        self._done = threading.Event()
+        self._submit_perf = time.perf_counter()
+        self._admit_perf = None
+        self._first_token_perf = None
+        self._finish_perf = None
+
+    # -- consumer side ---------------------------------------------------
+    def result(self, timeout=None):
+        """Block until the request reaches a terminal state; returns the
+        generated tokens as an int32 array (possibly partial — check
+        `state`/`verdict`). Raises TimeoutError if the deadline passes
+        with the request still live (the scheduler is not being driven,
+        or the timeout was too tight)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} still {self.state} after {timeout}s — "
+                "is the server running? (Server.start() or drain())")
+        return np.asarray(self.tokens, np.int32)
+
+    def stream(self):
+        """Iterate tokens as the scheduler generates them, ending when
+        the request reaches a terminal state (partial on expiry/cancel).
+        A `slow_client:ms` fault spec (mx.resilience) injects a per-token
+        consumer stall here — the CLIENT side — which must never slow the
+        scheduler itself down."""
+        delay = None
+        inj = _resilience._injector if _resilience._enabled else None
+        if inj is not None:
+            arg = inj.consume("slow_client")
+            if arg:
+                delay = float(arg) / 1000.0
+                print(f"mx.serve: fault injection: slow client — "
+                      f"{arg} ms stall per streamed token (request "
+                      f"{self.id})", file=sys.stderr)
+        while True:
+            tok = self._stream_q.get()
+            if tok is _EOS_SENTINEL:
+                return
+            if delay:
+                time.sleep(delay)
+            yield tok
+
+    @property
+    def done(self):
+        return self.state in TERMINAL
+
+    @property
+    def queue_wait_s(self):
+        """Seconds spent queued before admission (None before admit)."""
+        if self._admit_perf is None:
+            return None
+        return self._admit_perf - self._submit_perf
+
+    @property
+    def ttft_s(self):
+        """Submit-to-first-token seconds (None before the first token)."""
+        if self._first_token_perf is None:
+            return None
+        return self._first_token_perf - self._submit_perf
+
+    def _reset_for_replay(self):
+        """Requeue support: generation restarts from the prompt and —
+        being deterministic per request (greedy, or the per-request rng
+        reseeded here) — reproduces the same tokens; `_streamed` keeps
+        already-delivered tokens from being re-sent."""
+        self.tokens = []
+        self._rng = None
+        self.requeues += 1
+        self.state = QUEUED
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state!r}, "
+                f"tokens={len(self.tokens)}/{self.max_new_tokens}"
+                + (f", verdict={self.verdict!r}" if self.verdict else "")
+                + ")")
+
+
+# ---------------------------------------------------------------------------
+# bucket group: one KV cache + one executable per total-length bucket
+# ---------------------------------------------------------------------------
+
+class _Group:
+    """The decode state for one length bucket: `slots` requests sharing
+    one set of (slots, H, bucket, D) KV caches and one per-slot-position
+    step executable. `pos[i]` is the next position slot i writes — while
+    `pos < len(prompt)` the slot is prefilling (prompt tokens fed through
+    the same step), after that it consumes its own sampled tokens."""
+
+    __slots__ = ("bucket", "run", "slots", "pos", "caches", "cache_bytes")
+
+    def __init__(self, bucket, run, caches):
+        self.bucket = bucket
+        self.run = run
+        self.caches = caches
+        self.cache_bytes = sum(int(c.nbytes) for c in caches)
+        n = int(caches[0].shape[0])     # slots = the cache leading axis
+        self.slots = [None] * n
+        self.pos = [0] * n
+
+    def free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def active(self):
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class Server:
+    """Continuous-batching inference server over one autoregressive
+    model (the `GPTForCausalLM` decode surface: `decode_step_slots` +
+    `_alloc_caches`).
+
+    `submit()` never raises for overload — rejection, shedding and
+    expiry are VERDICTS on the returned Request, so the scheduler loop
+    cannot be crashed by traffic. Drive it with `start()`/`stop()` (a
+    background thread), a `with` block, or synchronously via `step()` /
+    `drain()` (tests inject `clock=` for deterministic deadlines).
+
+    `slots`/`queue_depth`/`shed`/`default_deadline_ms`/`buckets` default
+    to the `serve_*` knobs. `on_burst(n)`, when set, is how the
+    `burst:N@step:K` fault spec materializes synthetic load."""
+
+    def __init__(self, model, slots=None, queue_depth=None, shed=None,
+                 default_deadline_ms=None, buckets=None, max_len=None,
+                 clock=None, retry=None):
+        enable()
+        self.model = model
+        g = model.gpt
+        self._n_l = len(g.layers)
+        self._heads = g.layers[0].attn._num_heads
+        self._units = g.word_embed.weight.shape[1]
+        self._cache_dtype = g.word_embed.weight.data()._data.dtype
+        self._max_len = int(max_len or g.position_embed.shape[0])
+        self._slots = int(slots or _config.get("serve_slots"))
+        self._queue_depth = int(queue_depth
+                                if queue_depth is not None
+                                else _config.get("serve_queue_depth"))
+        shed = shed or _config.get("serve_shed")
+        if shed not in ("reject", "oldest"):
+            raise ValueError(
+                f"serve_shed must be 'reject' or 'oldest', got {shed!r}")
+        self._shed = shed
+        self._default_deadline_ms = float(
+            default_deadline_ms if default_deadline_ms is not None
+            else _config.get("serve_deadline_ms"))
+        self._buckets = self._parse_buckets(buckets)
+        self._clock = clock or time.monotonic
+        self._retry = retry or _resilience.RetryPolicy()
+        self._lock = _locklint.make_rlock("serve.server")
+        self._queue = collections.deque()
+        self._groups = {}          # bucket -> _Group
+        self._runners = {}         # bucket -> jit_flat_step runner
+        self._exec_peaks = {}      # bucket -> AOT exec-peak bytes (or None)
+        self._by_id = {}
+        self._pending_cancels = []
+        self._seq = 0
+        self._sched_step = 0
+        self._stats = {
+            "submitted": 0, "completed": 0, "rejected": 0, "shed": 0,
+            "expired": 0, "cancelled": 0, "failed": 0, "tokens": 0,
+            "steps": 0, "requeues": 0, "degraded": 0, "retries": 0,
+        }
+        self._params_bytes = self._measure_params()
+        self.on_burst = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._error = None
+        self._stopped = False
+
+    # -- construction helpers -------------------------------------------
+    def _parse_buckets(self, buckets):
+        if buckets is None:
+            raw = _config.get("serve_buckets")
+            buckets = [int(b) for b in str(raw).split(",") if b.strip()] \
+                if raw else None
+        if buckets is None:
+            return None                       # pow2 policy
+        bl = sorted(int(b) for b in buckets)
+        if not bl:
+            raise ValueError("serve buckets: empty list")
+        if bl[-1] > self._max_len:
+            raise ValueError(
+                f"serve bucket {bl[-1]} exceeds the model's max_length "
+                f"{self._max_len}")
+        return bl
+
+    def _measure_params(self):
+        try:
+            leaves = [p.data()._data
+                      for p in self.model.collect_params().values()]
+            return _memsafe.resident_bytes(leaves)
+        except Exception:
+            return 0
+
+    # -- client surface --------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, eos=None, temperature=0.0,
+               top_k=0, seed=0, deadline_ms=None):
+        """Enqueue one generation request; returns a Request immediately
+        (possibly already terminal: shed when the bounded queue is full
+        under `serve_shed=reject`, or rejected when the request cannot
+        fit the device even alone). Never raises for overload."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0 or int(max_new_tokens) <= 0:
+            raise ValueError("submit needs a non-empty prompt and "
+                             "max_new_tokens >= 1")
+        ms = deadline_ms if deadline_ms is not None \
+            else (self._default_deadline_ms or None)
+        deadline = (self._clock() + float(ms) / 1000.0) if ms else None
+        with self._lock:
+            req = Request(self._seq, prompt, max_new_tokens, eos,
+                          temperature, top_k, seed, deadline)
+            self._seq += 1
+            self._by_id[req.id] = req
+            self._stats["submitted"] += 1
+            # a dead scheduler must fail fast, not enqueue a request no
+            # thread will ever drive (the client would wedge in result())
+            if self._error is not None:
+                self._finish(req, FAILED,
+                             f"500 scheduler failed earlier: "
+                             f"{type(self._error).__name__}: {self._error}")
+                return req
+            if self._stopped:
+                self._finish(req, SHED, "503 server stopped")
+                return req
+            need = prompt.size + int(max_new_tokens)
+            if need > self._max_len:
+                self._finish(req, REJECTED,
+                             f"413 too long: prompt {prompt.size} + "
+                             f"max_new_tokens {max_new_tokens} exceeds "
+                             f"max_length {self._max_len}")
+                return req
+            over = self._solo_overrun(req)
+            if over is not None:
+                self._finish(req, REJECTED, over)
+                return req
+            if len(self._queue) >= self._queue_depth:
+                if self._shed == "reject":
+                    self._finish(req, SHED,
+                                 "503 shed: queue full "
+                                 f"({self._queue_depth} deep, "
+                                 "serve_shed=reject)")
+                    return req
+                oldest = self._queue.popleft()
+                self._finish(oldest, SHED,
+                             "503 shed: displaced by newer request "
+                             f"{req.id} (serve_shed=oldest)")
+            self._queue.append(req)
+            if _telemetry._enabled:
+                _M_QDEPTH.set(len(self._queue))
+        self._wake.set()
+        return req
+
+    def cancel(self, req_or_id):
+        """Cancel a request: removed from the queue immediately, or — if
+        running — evicted between decode steps (partial tokens stay
+        delivered). No-op on already-terminal requests."""
+        req = self._by_id.get(req_or_id) \
+            if not isinstance(req_or_id, Request) else req_or_id
+        if req is None:
+            return
+        with self._lock:
+            self._pending_cancels.append(req)
+        self._wake.set()
+
+    def stats(self):
+        """Counter snapshot plus live occupancy (plain dict)."""
+        with self._lock:
+            out = dict(self._stats)
+            out["queued"] = len(self._queue)
+            out["running"] = sum(len(g.active())
+                                 for g in self._groups.values())
+            out["buckets_allocated"] = sorted(self._groups)
+            out["executables"] = len(self._runners)
+            out["scheduler_steps"] = self._sched_step
+        out["dispatches"] = dispatches()
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        """Run the scheduler in a background thread until `stop()`."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stopped = False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="mx-serve-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the background scheduler; outstanding (non-terminal)
+        requests are finished with a '499 server stopped' verdict so no
+        client blocks forever."""
+        self._stopped = True
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None \
+                and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
+        with self._lock:
+            live = [r for r in self._by_id.values()
+                    if r.state not in TERMINAL]
+            for r in live:
+                self._remove_from_slots(r)
+                self._finish(r, CANCELLED, "499 server stopped")
+            self._queue.clear()
+            self._gc_groups()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                work = self.step()
+            except Exception as e:  # noqa: BLE001 — surfaced to requests
+                self._scheduler_failed(e)
+                return
+            if not work:
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    def _scheduler_failed(self, exc):
+        """A non-overload error escaped a scheduler step (overload paths
+        — budget, deadline, shed, cancel — are all verdicts and cannot
+        reach here). Fail every live request with a 500 verdict so no
+        client wedges on a dead scheduler, and keep the error for
+        `raise_if_failed`."""
+        self._error = exc
+        print(f"mx.serve: scheduler error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        if _diagnostics._enabled:
+            _diagnostics.record_event("serve", action="scheduler_error",
+                                      error=f"{type(exc).__name__}: {exc}")
+        with self._lock:
+            for r in list(self._by_id.values()):
+                if r.state not in TERMINAL:
+                    self._remove_from_slots(r)
+                    self._finish(r, FAILED,
+                                 f"500 scheduler error: "
+                                 f"{type(exc).__name__}: {exc}")
+            self._queue.clear()
+
+    def raise_if_failed(self):
+        if self._error is not None:
+            raise self._error
+
+    def busy(self):
+        """True while any request is queued or holds a slot."""
+        with self._lock:
+            if self._queue or self._pending_cancels:
+                return True
+            return any(g.active() for g in self._groups.values())
+
+    def drain(self, max_steps=100_000):
+        """Drive the scheduler synchronously until idle (tests and batch
+        use). Raises RuntimeError after `max_steps` — a wedged scheduler
+        must fail loudly, not hang the caller."""
+        n = 0
+        while self.busy():
+            self.step()
+            n += 1
+            if n >= max_steps:
+                raise RuntimeError(
+                    f"mx.serve: scheduler still busy after {max_steps} "
+                    f"steps — {self.stats()}")
+        return n
+
+    # -- scheduler -------------------------------------------------------
+    def step(self):
+        """One scheduler iteration: fire injected faults, evict expired
+        slots, admit from the queue (admission control + degradation
+        ladder), run one batched decode step per active bucket, stream
+        the new tokens. Returns True while work remains. Overload never
+        raises out of here — only scheduler bugs do."""
+        with self._lock:
+            self._sched_step += 1
+            n = self._sched_step
+        self._fire_faults(n)
+        if _guard._enabled:
+            _guard.heartbeat(phase="serve")
+        # bucket executables and their AOT peaks are built OUTSIDE the
+        # lock (an XLA compile is seconds on a real model; submit/cancel
+        # from client threads must not block behind it)
+        self._prewarm_buckets()
+        with self._lock:
+            self._apply_cancels()
+            self._evict_expired()
+            # reclaim drained buckets BEFORE admission: caches freed by
+            # a cancel/expiry this very step must not count against the
+            # incoming request's budget (a spurious 429/shrink otherwise)
+            self._gc_groups()
+            self._admit()
+            groups = [g for g in self._groups.values() if g.active()]
+        for grp in groups:
+            self._decode_group(grp, n)
+        with self._lock:
+            self._gc_groups()
+            if _telemetry._enabled:
+                _M_QDEPTH.set(len(self._queue))
+                _M_ACTIVE.set(sum(len(g.active())
+                                  for g in self._groups.values()))
+        return self.busy()
+
+    def _prewarm_buckets(self):
+        """Build the runner (functional_call trace) and AOT exec-peak
+        probe for every bucket the queue will need, before the locked
+        admission pass. Only the scheduler thread touches _runners /
+        _exec_peaks, so no lock is required here."""
+        with self._lock:
+            pending = [r for r in self._queue if r.state == QUEUED]
+        cap = _memsafe.capacity_bytes()
+        for r in pending:
+            b = self._bucket_for(r.prompt.size + r.max_new_tokens)
+            self._runner(b)
+            if cap is not None:
+                self._exec_peak(b)
+
+    def _fire_faults(self, sched_step):
+        inj = _resilience._injector if _resilience._enabled else None
+        if inj is None:
+            return
+        hit = inj.take("burst", step=sched_step)
+        if hit is not None:
+            count = int(hit["arg"] or 1)
+            print(f"mx.serve: fault injection: burst of {count} at "
+                  f"scheduler step {sched_step}", file=sys.stderr)
+            if self.on_burst is not None:
+                self.on_burst(count)
+        # a step-less cancel spec waits, still armed, until its target
+        # request has actually been submitted — consuming it at scheduler
+        # step 1 of an idling background server would silently no-op the
+        # documented cancellation drill
+        hit = inj.take("cancel", step=sched_step,
+                       ready=lambda spec: spec["req"] is not None
+                       and spec["req"] in self._by_id)
+        if hit is not None:
+            rid = hit.get("req")
+            print(f"mx.serve: fault injection: cancel request {rid} at "
+                  f"scheduler step {sched_step}", file=sys.stderr)
+            if rid is not None:
+                self.cancel(int(rid))
+
+    def _apply_cancels(self):
+        pending, self._pending_cancels = self._pending_cancels, []
+        for req in pending:
+            if req.state in TERMINAL:
+                continue
+            self._remove_from_slots(req)
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+            self._finish(req, CANCELLED,
+                         f"499 cancelled after {len(req.tokens)} tokens")
+
+    def _evict_expired(self):
+        now = self._clock()
+        for grp in self._groups.values():
+            for i in grp.active():
+                r = grp.slots[i]
+                if r.deadline is not None and now > r.deadline:
+                    grp.slots[i] = None
+                    self._note_deadline_miss(r, running=True)
+        for r in list(self._queue):
+            if r.deadline is not None and now > r.deadline:
+                self._queue.remove(r)
+                self._note_deadline_miss(r, running=False)
+
+    def _note_deadline_miss(self, req, running):
+        if _telemetry._enabled:
+            _M_DEADLINE_MISS.inc()
+        where = (f"evicted mid-generation after {len(req.tokens)} tokens "
+                 "(KV pages reclaimed)") if running else "expired in queue"
+        self._finish(req, EXPIRED, f"504 deadline: {where}")
+
+    # -- admission -------------------------------------------------------
+    def _bucket_for(self, need):
+        from . import dataflow as _dataflow
+        if self._buckets is not None:
+            b = _dataflow.bucket_length(need, self._buckets)
+        else:
+            b = _dataflow.bucket_length(need, "pow2")
+        return min(int(b), self._max_len)
+
+    def _buckets_below(self, bucket, floor):
+        """Candidate shrink buckets strictly below `bucket`, largest
+        first, each still holding `floor` total positions. The pow2
+        policy never goes below `bucket_pad_min` — shrinking must not
+        mint bucket sizes normal admission would never produce (each
+        would be one more executable)."""
+        if self._buckets is not None:
+            cands = [b for b in self._buckets if floor <= b < bucket]
+        else:
+            lo = max(1, int(_config.get("bucket_pad_min")))
+            cands, b = [], bucket // 2
+            while b >= max(floor, lo):
+                cands.append(b)
+                b //= 2
+        return sorted(cands, reverse=True)
+
+    def _cache_bytes(self, bucket):
+        """Analytic KV bytes for one bucket's caches: 2*n_l arrays of
+        (slots, H, bucket, D)."""
+        D = self._units // self._heads
+        item = np.dtype(self._cache_dtype).itemsize
+        return 2 * self._n_l * self._slots * self._heads * bucket * D * item
+
+    def _runner(self, bucket):
+        r = self._runners.get(bucket)
+        if r is None:
+            from .models._decode import jit_flat_step
+            model, n_l = self.model, self._n_l
+
+            def step(tok, t, flat):
+                logits, nk, nv = model.decode_step_slots(
+                    tok, t, flat[:n_l], flat[n_l:])
+                return logits, list(nk) + list(nv)
+
+            # the KV caches are threaded through every step: donate them
+            # (mx.check `donation-miss` — same rationale as generate)
+            r = jit_flat_step(model, step, 2 * n_l,
+                              donate_state=2 * n_l)
+            self._runners[bucket] = r
+        return r
+
+    def _cache_avals(self, bucket):
+        import jax
+        D = self._units // self._heads
+        return [jax.ShapeDtypeStruct(
+            (self._slots, self._heads, bucket, D), self._cache_dtype)
+            for _ in range(2 * self._n_l)]
+
+    def _exec_peak(self, bucket):
+        """AOT execution-peak bytes of the bucket's step executable
+        (beyond its argument buffers) — `predict_step_bytes`-style
+        analysis, no dispatch. Cached per bucket; None when the backend
+        withholds analysis (the budget then checks resident bytes
+        alone)."""
+        if bucket in self._exec_peaks:
+            return self._exec_peaks[bucket]
+        import jax
+        run = self._runner(bucket)
+        tok = jax.ShapeDtypeStruct((self._slots,), np.int32)
+        t = jax.ShapeDtypeStruct((self._slots,), np.int32)
+        try:
+            peak = run.aot_exec_peak(tok, t, self._cache_avals(bucket))
+        except Exception:   # noqa: BLE001 — degrade to resident-only
+            peak = None
+        self._exec_peaks[bucket] = peak
+        return peak
+
+    def _admit_budget(self, bucket):
+        """mx.memsafe budget check for admitting into `bucket`: resident
+        params + every allocated bucket's caches (+ this bucket's, if it
+        would be newly allocated) + the executable's AOT execution peak
+        vs device capacity. Raises MemoryBudgetError on predicted
+        overrun — BEFORE any cache allocation or dispatch."""
+        cap = _memsafe.capacity_bytes()
+        if cap is None:
+            return None
+        new_bytes = 0 if bucket in self._groups \
+            else self._cache_bytes(bucket)
+        resident = self._params_bytes + new_bytes + sum(
+            g.cache_bytes for g in self._groups.values())
+        return _memsafe.check_budget(
+            f"serve.decode(bucket={bucket},slots={self._slots})",
+            self._exec_peak(bucket), resident, capacity=cap)
+
+    def _solo_overrun(self, req):
+        """Cheap submit-time check: a request whose SMALLEST shrunk
+        bucket cannot fit next to the params alone can never be admitted
+        — reject it immediately with the accounting (429), instead of
+        letting it age out in the queue."""
+        cap = _memsafe.capacity_bytes()
+        if cap is None:
+            return None
+        floor_new = max(1, min(int(_config.get("serve_min_new_tokens")),
+                               req.max_new_tokens))
+        bucket = self._bucket_for(req.prompt.size + floor_new)
+        resident = self._params_bytes + self._cache_bytes(bucket)
+        if resident > cap:
+            return (f"429 over capacity: smallest viable KV bucket "
+                    f"{bucket} needs {_fmt_bytes(resident)} resident "
+                    f"(params + caches) but device capacity is "
+                    f"{_fmt_bytes(cap)}")
+        return None
+
+    def _admit(self):
+        """Admit queued requests into free slots, oldest first (younger
+        requests may pass one whose bucket group is full or over
+        budget). Loops while progress is made — an evict-and-requeue
+        may unblock the next pass."""
+        while True:
+            progress = False
+            for req in list(self._queue):
+                if req.state != QUEUED:
+                    continue
+                if self._try_admit(req):
+                    progress = True
+            if not progress:
+                return
+
+    def _try_admit(self, req):
+        bucket = self._bucket_for(req.prompt.size + req.max_new_tokens)
+        grp = self._groups.get(bucket)
+        if grp is not None and grp.free_slot() is None:
+            return False                     # bucket full: wait
+        try:
+            self._admit_budget(bucket)
+        except _memsafe.MemoryBudgetError as e:
+            return self._admit_pressure(req, bucket, e)
+        self._place(req, bucket)
+        return True
+
+    def _admit_pressure(self, req, bucket, err):
+        """The graceful-degradation ladder, walked when admission
+        predicts a memory overrun (mirrors memsafe's OOM ladder):
+        (1) shrink max_new_tokens to the largest smaller bucket that
+        passes the budget, (2) evict-and-requeue the youngest running
+        request (frees its bucket's KV pages when it drains the group),
+        then (3) reject with the accounting if the request cannot fit
+        even alone. Anything else stays queued. Every transition is
+        annotated in telemetry.
+
+        A REQUEUED request is never shrunk and never evicts: its client
+        is mid-stream on a promised token budget (shrinking below what
+        was already streamed would orphan delivered tokens), and letting
+        it evict in turn would let two requests displace each other
+        forever — it waits for the running work to drain instead."""
+        if req.requeues == 0 and self._admit_shrunk(req, bucket):
+            return True
+        if req.requeues == 0 and not req.evicted_once:
+            victim = self._youngest_running(exclude=req)
+            if victim is not None:
+                req.evicted_once = True
+                self._evict_requeue(victim, for_req=req)
+                self._gc_groups()
+                try:
+                    self._admit_budget(bucket)
+                except _memsafe.MemoryBudgetError:
+                    if self._admit_shrunk(req, bucket):
+                        return True
+                else:
+                    self._place(req, bucket)
+                    return True
+        if not any(g.active() for g in self._groups.values()):
+            # nothing else is holding memory: this request simply does
+            # not fit the device — a queue wait cannot save it
+            self._queue.remove(req)
+            self._finish(req, REJECTED, f"429 over capacity: {err}")
+            return True
+        return False
+
+    def _admit_shrunk(self, req, bucket):
+        """Degradation rung 1: clamp the request's token budget to the
+        largest smaller bucket that passes the memory budget (floored at
+        serve_min_new_tokens)."""
+        floor_new = max(1, min(int(_config.get("serve_min_new_tokens")),
+                               req.max_new_tokens))
+        floor_total = req.prompt.size + floor_new
+        for L in self._buckets_below(bucket, floor_total):
+            grp = self._groups.get(L)
+            if grp is not None and grp.free_slot() is None:
+                continue
+            try:
+                self._admit_budget(L)
+            except _memsafe.MemoryBudgetError:
+                continue
+            new_max = L - req.prompt.size
+            was = req.max_new_tokens
+            req.max_new_tokens = new_max
+            req.degraded = f"shrink_max_new:{was}->{new_max}"
+            self._note_degraded("shrink_max_new", req,
+                                {"from": was, "to": new_max, "bucket": L})
+            self._place(req, L)
+            return True
+        return False
+
+    def _youngest_running(self, exclude=None):
+        victim = None
+        for g in self._groups.values():
+            for i in g.active():
+                r = g.slots[i]
+                if r is exclude:
+                    continue
+                if victim is None or r.id > victim.id:
+                    victim = r
+        return victim
+
+    def _evict_requeue(self, victim, for_req):
+        """Degradation rung 2: evict the youngest running request and
+        requeue it at the FRONT of the queue — its deterministic replay
+        regenerates the same tokens, and `_streamed` keeps already-
+        delivered ones from being re-sent."""
+        self._remove_from_slots(victim)
+        victim._reset_for_replay()
+        self._queue.appendleft(victim)
+        self._stats["requeues"] += 1
+        self._note_degraded("evict_requeue", victim,
+                            {"to_admit": for_req.id,
+                             "streamed": victim._streamed})
+
+    def _note_degraded(self, action, req, extra):
+        self._stats["degraded"] += 1
+        print(f"mx.serve: degradation ladder: {action} (request "
+              f"{req.id}: {extra})", file=sys.stderr)
+        if _telemetry._enabled:
+            _M_DEGRADED.inc()
+            _telemetry.event("serve", action=action, req=req.id, **extra)
+        if _diagnostics._enabled:
+            _diagnostics.record_event("serve", action=action, req=req.id,
+                                      **extra)
+
+    def _place(self, req, bucket):
+        grp = self._groups.get(bucket)
+        t0 = time.perf_counter()
+        if grp is None:
+            run = self._runner(bucket)
+            caches = self.model._alloc_caches(self._slots, bucket)
+            grp = self._groups[bucket] = _Group(bucket, run, caches)
+        i = grp.free_slot()
+        grp.slots[i] = req
+        grp.pos[i] = 0
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        req.state = RUNNING
+        req._admit_perf = time.perf_counter()
+        if _telemetry._enabled:
+            _M_QWAIT.observe(req.queue_wait_s)
+        if _trace._enabled:
+            _trace.record_span("serve.queue_wait", req._submit_perf,
+                               req._admit_perf, cat="serve", req=req.id)
+            _trace.record_span("serve.admit", t0, cat="serve", req=req.id,
+                               bucket=bucket)
+
+    def _remove_from_slots(self, req):
+        for g in self._groups.values():
+            for i, r in enumerate(g.slots):
+                if r is req:
+                    g.slots[i] = None
+                    return True
+        return False
+
+    def _gc_groups(self):
+        """Free the KV caches of drained bucket groups — the 'pages
+        reclaimed' half of eviction (the jitted runner stays cached, so
+        re-admission into the bucket does not recompile)."""
+        for L in [L for L, g in self._groups.items() if not g.active()]:
+            del self._groups[L]
+
+    # -- decode ----------------------------------------------------------
+    def _decode_group(self, grp, sched_step):
+        import jax.numpy as jnp
+        tok = np.zeros((self._slots,), np.int32)
+        t = np.zeros((self._slots,), np.int32)
+        active = grp.active()
+        if not active:
+            return
+        for i in active:
+            r = grp.slots[i]
+            p = grp.pos[i]
+            lp = r.prompt.size
+            tok[i] = r.prompt[p] if p < lp else r.tokens[p - lp]
+            t[i] = p
+        t0 = time.perf_counter()
+        logits, new_state = self._dispatch(grp, jnp.asarray(tok),
+                                           jnp.asarray(t))
+        grp.caches = new_state
+        lg = np.asarray(logits, np.float32)     # host fetch = the fence
+        t1 = time.perf_counter()
+        if _trace._enabled:
+            _trace.record_span("serve.decode_step", t0, t1, cat="serve",
+                               step=sched_step, bucket=grp.bucket,
+                               slots=len(active))
+        t_emit = time.perf_counter()
+        with self._lock:
+            self._stats["steps"] += 1
+            for i in active:
+                r = grp.slots[i]
+                if r is None or r.state in TERMINAL:
+                    continue        # evicted/cancelled under the dispatch
+                p = grp.pos[i]
+                grp.pos[i] = p + 1
+                if p < r.prompt.size - 1:
+                    continue        # still prefilling the prompt
+                nxt = self._sample(r, lg[i])
+                self._emit(r, nxt)
+                if (r.eos is not None and nxt == r.eos) \
+                        or len(r.tokens) >= r.max_new_tokens:
+                    grp.slots[i] = None
+                    self._finish(r, DONE, "200 ok")
+        if _trace._enabled:
+            _trace.record_span("serve.stream", t_emit, cat="serve",
+                               step=sched_step)
+
+    def _dispatch(self, grp, tok, t):
+        """One batched decode dispatch under the transient-fault
+        RetryPolicy. Donated-buffer safety: a failure that consumed the
+        donated KV caches cannot be retried in place — that is re-raised
+        (non-retryable) instead of computing garbage."""
+        def call():
+            c0 = grp.caches[0]
+            if hasattr(c0, "is_deleted") and c0.is_deleted():
+                raise RuntimeError(
+                    "mx.serve: the failed dispatch consumed the donated "
+                    "KV buffers — cannot retry in place (bucket "
+                    f"{grp.bucket})")
+            return grp.run(tok, t, grp.caches)
+
+        def on_retry(exc, attempt, delay):
+            with self._lock:
+                self._stats["retries"] += 1
+            print(f"mx.serve: retrying decode dispatch after "
+                  f"{type(exc).__name__}: {exc} (attempt {attempt + 2}/"
+                  f"{self._retry.max_attempts}, backoff {delay:.2f}s)",
+                  file=sys.stderr)
+
+        return self._retry.call(call, site="serve-dispatch",
+                                abort=self._stop.is_set,
+                                on_retry=on_retry)
+
+    def _sample(self, req, lg):
+        """Next token from one slot's logits row (host-side, so each
+        request's stream is deterministic and independent of what else
+        shares the batch): greedy at temperature 0, else top-k softmax
+        sampling from the request's own seeded rng."""
+        if req.temperature > 0.0:
+            if req._rng is None:
+                req._rng = np.random.RandomState(req.seed)
+            if req.top_k:
+                kth = np.partition(lg, -req.top_k)[-req.top_k]
+                lg = np.where(lg < kth, -np.inf, lg)
+            lg = lg / req.temperature
+            p = np.exp(lg - lg.max())
+            p /= p.sum()
+            return int(req._rng.choice(p.size, p=p))
+        return int(lg.argmax())
+
+    def _emit(self, req, tok):
+        req.tokens.append(int(tok))
+        self._stats["tokens"] += 1
+        if _telemetry._enabled:
+            _M_TOKENS.inc()
+        if len(req.tokens) > req._streamed:
+            req._streamed = len(req.tokens)
+            if req._first_token_perf is None:
+                req._first_token_perf = time.perf_counter()
+                if _telemetry._enabled:
+                    _M_TTFT.observe(req.ttft_s)
+            req._stream_q.put(int(tok))
+
+    # -- terminal transitions -------------------------------------------
+    _OUTCOME = {DONE: "completed", REJECTED: "rejected", SHED: "shed",
+                EXPIRED: "expired", CANCELLED: "cancelled",
+                FAILED: "failed"}
+
+    def _finish(self, req, state, verdict):
+        if req.state in TERMINAL:
+            return
+        req.state = state
+        req.verdict = verdict
+        req._finish_perf = time.perf_counter()
+        # terminal requests leave the id table — a long-running server
+        # must not grow RSS with every request it ever answered (the
+        # caller keeps its own Request reference; cancel-by-id only ever
+        # targets live requests)
+        self._by_id.pop(req.id, None)
+        self._stats[self._OUTCOME[state]] += 1
+        if state != DONE:
+            print(f"mx.serve: request {req.id}: {verdict}",
+                  file=sys.stderr)
+        if _telemetry._enabled:
+            _M_REQUESTS.labels(outcome=self._OUTCOME[state]).inc()
+            if state != DONE:
+                _telemetry.event("serve", action="finish", req=req.id,
+                                 state=state, verdict=verdict)
+        req._stream_q.put(_EOS_SENTINEL)
+        req._done.set()
+
+
+if _config.get("serve"):
+    enable()

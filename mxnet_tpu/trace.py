@@ -505,7 +505,7 @@ def critical_path():
     its compute-bound dominant span."""
     with _lock:
         steady = {k: v for k, v in _agg.items()
-                  if k[0] in ("step", "input")}
+                  if k[0] in ("step", "input", "serve")}
         if not steady:
             return None
         total = sum(t for _, t in steady.values())

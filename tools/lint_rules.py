@@ -60,6 +60,7 @@ INSTRUMENTED = (
     os.path.join("mxnet_tpu", "config.py"),
     os.path.join("mxnet_tpu", "check.py"),
     os.path.join("mxnet_tpu", "trace.py"),
+    os.path.join("mxnet_tpu", "serve.py"),
     os.path.join("tools", "launch.py"),
 )
 

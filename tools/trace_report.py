@@ -240,6 +240,32 @@ def _window_stats(ranks, offsets, lo_us, hi_us):
 
 def _verdict(stats, skews_in_window):
     """(kind, straggler_rank, dominant_span, detail) for one window."""
+    # serving windows first: a rank whose window holds mx.serve spans but
+    # no train steps is an inference server — the question there is not
+    # input- vs compute-bound but where a request's latency went: waiting
+    # in the admission queue (queue-bound: add slots/capacity or shed
+    # earlier) or in the batched decode dispatch (decode-bound: the
+    # model/hardware is the floor)
+    serve_frac = {}
+    for rank, st in stats.items():
+        if st["by_cat"].get("step") or not st["by_cat"].get("serve"):
+            continue
+        qwait = st["by_span"].get("serve.queue_wait", 0.0)
+        decode = st["by_span"].get("serve.decode_step", 0.0)
+        if qwait + decode > 0:
+            serve_frac[rank] = (qwait / (qwait + decode), qwait, decode)
+    if serve_frac:
+        rank = max(serve_frac, key=lambda r: serve_frac[r][0])
+        frac, qwait, decode = serve_frac[rank]
+        if frac > 0.5:
+            return ("queue-bound", rank, "serve.queue_wait",
+                    f"{frac:.1%} of request time waiting for admission "
+                    f"({qwait / 1e6:.3f}s queued vs {decode / 1e6:.3f}s "
+                    "decoding) — add slots/capacity or shed earlier")
+        return ("decode-bound", rank, "serve.decode_step",
+                f"{1 - frac:.1%} of request time in batched decode "
+                f"({decode / 1e6:.3f}s decoding vs {qwait / 1e6:.3f}s "
+                "queued) — the model/hardware is the latency floor")
     input_frac = {}
     for rank, st in stats.items():
         # only the CONSUMER-visible stall counts as input waiting:
