@@ -318,6 +318,19 @@ def run_bench(on_tpu):
     out["remat_policy"] = memsafe.policy_marker(model)
     out["oom_recoveries"] = int(
         telemetry.counter("oom_recoveries_total").value)
+    # mx.zero provenance (nullable, like platform/smoke_mode): whether
+    # the headline trainer sharded its optimizer state across the data
+    # axes, and the PER-DEVICE resident opt-state bytes (sharded arrays
+    # count their shard) — the number the (D-1)/D memory win shows up in
+    # when compared across zero on/off rows on the same mesh
+    out["zero_enabled"] = bool(getattr(trainer, "_zero", False))
+    # fused LAMB keeps its fp32 flat master in trainer.params — it IS
+    # optimizer state (the README memory table's 12 bytes/param counts
+    # master+m+v), so include it or the field under-reports by a third
+    _opt_tree = (trainer.opt_state,
+                 trainer.params if getattr(trainer, "_fused", False) else ())
+    out["opt_state_bytes_per_device"] = int(memsafe.resident_bytes(
+        _opt_tree)) if getattr(trainer, "_ready", False) else None
     # mx.check: graph + concurrency findings for the benched
     # configuration (0 = lint-clean; the trajectory should stay 0)
     out["check_findings"] = len(mxcheck.findings()) \

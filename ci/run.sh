@@ -115,9 +115,15 @@ import json
 d = json.load(open('/tmp/_bench_sanity.json'))
 for k in ('mfu', 'achieved_tflops', 'peak_device_bytes',
           'comm_bytes_per_step', 'memory_headroom_bytes',
-          'oom_recoveries', 'check_findings', 'step_skew_p99_ms'):
+          'oom_recoveries', 'check_findings', 'step_skew_p99_ms',
+          'opt_state_bytes_per_device'):
     assert k in d, f'bench JSON missing {k}: {sorted(d)}'
     assert d[k] is None or isinstance(d[k], (int, float)), (k, d[k])
+# mx.zero provenance: always present; a default (zero=off) run reports
+# zero_enabled false and a positive unsharded opt-state byte count
+assert d.get('zero_enabled') is False, d.get('zero_enabled')
+assert d['opt_state_bytes_per_device'] is None \
+    or d['opt_state_bytes_per_device'] > 0, d['opt_state_bytes_per_device']
 assert d.get('remat_policy') in ('none', 'dots_saveable', 'layers',
                                  'full'), d.get('remat_policy')
 assert d['mfu'] is None, 'CPU run must report mfu null, not a number'
@@ -228,6 +234,38 @@ assert d['next_larger'] and \\
     d['next_larger']['predicted_bytes'] > d['capacity_bytes'], d
 print('autofit smoke OK: batch', d['batch_size'], 'predicted',
       d['predicted_bytes'], 'of', d['capacity_bytes'])
+"
+    # zero must be disabled by default (zero=off): trainer construction
+    # and the step make ZERO calls into the mx.zero module — no state
+    # planning, no flat-spec probe, no in-step sharding constraint — and
+    # the optimizer state stays in its parameter's sharding
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+from mxnet_tpu.parallel import zero
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not zero.enabled(), 'zero must default to off'
+calls = {'plan': 0, 'flat': 0, 'spec': 0, 'constrain': 0}
+real = (zero.plan_state, zero.flat_spec, zero.zero_spec, zero.constrain)
+zero.plan_state = lambda *a, **k: (calls.__setitem__('plan', calls['plan'] + 1), real[0](*a, **k))[1]
+zero.flat_spec = lambda *a, **k: (calls.__setitem__('flat', calls['flat'] + 1), real[1](*a, **k))[1]
+zero.zero_spec = lambda *a, **k: (calls.__setitem__('spec', calls['spec'] + 1), real[2](*a, **k))[1]
+zero.constrain = lambda *a, **k: (calls.__setitem__('constrain', calls['constrain'] + 1), real[3](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'adam',
+                             {'learning_rate': 0.01})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+zero.plan_state, zero.flat_spec, zero.zero_spec, zero.constrain = real
+assert calls == {'plan': 0, 'flat': 0, 'spec': 0, 'constrain': 0}, calls
+assert tr._zero is False and tr._zero_specs is None \
+    and tr._zero_flat is None, 'zero state armed while disabled'
+print('zero disabled fast path OK (no planning, no constraints)')
 "
     # resilience must be disabled by default: no signal handlers installed,
     # the trainer step hook reduces to one module-bool check (zero on_step
@@ -488,6 +526,14 @@ dist_stage() {
     # the loss trajectory matches the uninterrupted run
     JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_reshard.py::test_elastic_kill_shrink_resume_matches_reference \
+        -q -p no:cacheprovider
+    # mx.zero acceptance: 4-way zero'd training matches the unsharded
+    # reference loss trajectory step for step, then a kill-shrink
+    # elastic relaunch restores the sharded state bit-exactly onto the
+    # 2-way mesh and finishes (reporting the measured per-device
+    # opt-state byte drop along the way)
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_zero.py::test_zero_elastic_kill_shrink_acceptance \
         -q -p no:cacheprovider
 }
 

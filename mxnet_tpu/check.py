@@ -23,7 +23,9 @@ runtime failure classes into pre-merge static findings. Three layers:
     observed to keep varying (`retrace-hazard`, the BEFORE-the-fact
     complement of telemetry's recompile-cause diff) — and degenerate
     sharding: large fully-replicated params/batches on a multi-device
-    mesh (`degenerate-sharding`, feeding the mx.zero roadmap item).
+    mesh (`degenerate-sharding`; remediated by the now-real `zero=auto`
+    knob — mx.zero optimizer-state sharding — and quiet on a zero'd
+    trainer).
   * **concurrency analysis** — `mxnet_tpu/_locklint.py`: the
     instrumented-lock wrapper adopted by telemetry, diagnostics,
     dataflow's prefetcher, resilience, inspect, memsafe, profiler, and
@@ -687,7 +689,11 @@ def _lint_sharding(trainer, name, key, batch):
         return
     if extent <= 1:
         return
-    if getattr(trainer, "param_mode", "replicate") == "replicate":
+    if getattr(trainer, "param_mode", "replicate") == "replicate" \
+            and not getattr(trainer, "_zero", False):
+        # a zero'd trainer already shards its optimizer state and updates
+        # per-shard (reduce-scatter/all-gather weight update) — exactly
+        # the remediation this finding names, so it goes quiet
         from . import memsafe as _memsafe
         pbytes = int(_memsafe.resident_bytes(
             (trainer.params, trainer.opt_state)))
@@ -698,10 +704,13 @@ def _lint_sharding(trainer, name, key, batch):
                 f"fully replicated across {extent} data-parallel "
                 "devices: every device holds and updates the complete "
                 "train state.",
-                "param_mode='fsdp' shards params + optimizer state over "
-                "the data axes (weight-update sharding; mx.zero, ROADMAP "
-                "item 2) — or raise check_replicated_min_bytes if this "
-                "model is small enough to replicate deliberately",
+                "set zero='auto' (mx.zero: shard optimizer state across "
+                "the data replicas with a reduce-scatter/all-gather "
+                "weight update — resident opt-state bytes /= data "
+                "extent, values unchanged), or param_mode='fsdp' to "
+                "shard params + optimizer state over the data axes; "
+                "raise check_replicated_min_bytes if this model is "
+                "small enough to replicate deliberately",
                 dedupe=(name, "replicated-params"),
                 nbytes=pbytes, devices=extent)
     # batch inputs: re-derive the shardings the step will use

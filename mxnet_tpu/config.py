@@ -381,10 +381,35 @@ register_option(
         "path (one module bool, no handlers — asserted by ci/run.sh "
         "sanity). 'auto' catches RESOURCE_EXHAUSTED and pre-flight "
         "MemoryBudgetError and walks the degradation ladder: escalate the "
-        "remat policy one rung, then halve the batch via gradient-"
+        "remat policy one rung, then shard the optimizer state across "
+        "the data replicas (mx.zero — bit-identical values, (D-1)/D of "
+        "the opt-state bytes back), then halve the batch via gradient-"
         "accumulation microbatching (loss/grad parity up to reduction "
         "order), re-plan, retry — each transition logged to telemetry, "
         "the flight ring, and the post-mortem 'memsafe' section.")
+register_option(
+    "zero", "off", choices=("off", "auto", "on"),
+    doc="mx.zero cross-replica optimizer-state sharding "
+        "(parallel/zero.py). 'off' (default) is the zero-overhead fast "
+        "path: the ShardedTrainer makes no call into the zero module — "
+        "no state planning, no sharding constraints (asserted by "
+        "ci/run.sh sanity). 'auto' shards the optimizer state (SGD/Adam "
+        "moments; the fused-LAMB fp32 flat master and moments) across "
+        "the mesh's data axes at trainer construction whenever they "
+        "span >1 device, replacing the step's gradient psum + "
+        "replicated update with reduce-scatter -> per-shard update -> "
+        "all-gather inside the same jitted step: resident opt-state "
+        "bytes per device drop by (D-1)/D at data extent D, collective "
+        "payload unchanged. 'on' insists — construction raises when "
+        "nothing can shard. Independent of the knob, the "
+        "oom_recover=auto ladder may enable sharding on a live trainer "
+        "as the rung between remat=full and gradient accumulation.")
+register_option(
+    "zero_min_size", 1024,
+    "Smallest parameter (elements) whose optimizer state mx.zero shards "
+    "across the data axes; smaller state (LayerNorm/bias moments) stays "
+    "with its parameter's sharding — the reshard churn would outweigh "
+    "the bytes (same argument as fsdp_min_size).")
 register_option(
     "check", "off", choices=("off", "warn", "error"),
     doc="mx.check static analysis mode. 'off' (default) is the "

@@ -727,11 +727,21 @@ def sdc_check(trainer, step):
     global _last_sdc, _strikes, _sdc_round, _sdc_warned, _sdc_restores
     global _verified_step
     mode = getattr(trainer, "param_mode", "replicate")
-    if mode != "replicate":
+    # a zero'd fused-LAMB trainer keeps param_mode='replicate' but its
+    # resident flat master is SHARDED over the data axes — per-device
+    # digests would hash different shards and every vote would read as
+    # corruption. (A zero'd per-parameter trainer is fine: its params
+    # stay replicated; only the moments shard.)
+    zero_fused = getattr(trainer, "_zero", False) \
+        and getattr(trainer, "_fused", False)
+    if mode != "replicate" or zero_fused:
         if not _sdc_warned:
             _sdc_warned = True
+            why = (f"param_mode={mode!r} shards params"
+                   if mode != "replicate"
+                   else "mx.zero shards the fused-LAMB flat master")
             print(f"mx.guard: sdc checks need bit-identical data-parallel "
-                  f"replicas; param_mode={mode!r} shards params — digest "
+                  f"replicas; {why} — digest "
                   "vote skipped (warning once)", file=sys.stderr)
         return None
     if _telemetry._enabled:

@@ -178,7 +178,7 @@ def peak_bandwidth_per_chip():
 # collective-traffic estimate
 # ---------------------------------------------------------------------------
 
-def estimate_collectives(mesh, sized_shardings):
+def estimate_collectives(mesh, sized_shardings, zero=None):
     """Estimated collective payload bytes per train step for one
     executable, from its parameter shardings + mesh shape.
 
@@ -188,7 +188,11 @@ def estimate_collectives(mesh, sized_shardings):
     Model: replicated params all-reduce (psum) their gradient over the
     data axes; fsdp-sharded params all-gather before use and
     reduce-scatter the gradient over fsdp, then all-reduce the shard over
-    dp. Tensor-parallel activation collectives are not modeled — this is
+    dp. `zero`: optional per-entry bools — a mx.zero'd parameter's
+    would-be gradient psum is replaced by the reduce-scatter(grad) +
+    all-gather(updated param) pair the zero step actually runs: the SAME
+    ring bytes ((n-1)/n each way vs 2*(n-1)/n), attributed to the real
+    ops. Tensor-parallel activation collectives are not modeled — this is
     the data-parallel budget, labeled an estimate everywhere it surfaces.
     Returns {} when no data axis spans more than one device."""
     dp = int(mesh.shape.get("dp", 1))
@@ -197,8 +201,20 @@ def estimate_collectives(mesh, sized_shardings):
     if n <= 1:
         return {}
     out = {"psum": 0.0, "all_gather": 0.0, "reduce_scatter": 0.0}
-    for nbytes, sharding in sized_shardings:
+
+    def _reduce(nbytes, degree, zeroed):
+        # one gradient reduction over `degree` devices: psum classically,
+        # the rs/ag split (half the 2(n-1)/n each) when zero'd
+        cost = 2.0 * (degree - 1) / degree * nbytes
+        if zeroed:
+            out["reduce_scatter"] += cost / 2.0
+            out["all_gather"] += cost / 2.0
+        else:
+            out["psum"] += cost
+
+    for i, (nbytes, sharding) in enumerate(sized_shardings):
         nbytes = float(nbytes)
+        zeroed = bool(zero[i]) if zero else False
         spec = getattr(sharding, "spec", sharding)
         axes = set()
         for entry in (spec or ()):
@@ -209,9 +225,9 @@ def estimate_collectives(mesh, sized_shardings):
             out["all_gather"] += (fsdp - 1) / fsdp * nbytes
             out["reduce_scatter"] += (fsdp - 1) / fsdp * nbytes
             if dp > 1:
-                out["psum"] += 2.0 * (dp - 1) / dp * (nbytes / fsdp)
+                _reduce(nbytes / fsdp, dp, zeroed)
         else:
-            out["psum"] += 2.0 * (n - 1) / n * nbytes
+            _reduce(nbytes, n, zeroed)
     return {k: int(v) for k, v in out.items() if v > 0}
 
 

@@ -29,7 +29,9 @@ with XLA Compiler" (PAPERS.md, arxiv 2206.14148) — in four pieces:
   * **graceful OOM degradation** — with `oom_recover=auto`, a
     RESOURCE_EXHAUSTED (or pre-flight MemoryBudgetError) at the trainer
     step boundary walks a degradation ladder instead of crashing: escalate
-    the remat policy one rung, then halve the effective batch via
+    the remat policy one rung, then shard the optimizer state across the
+    data replicas (mx.zero — bit-identical values, (D-1)/D of the
+    opt-state bytes back), then halve the effective batch via
     gradient-accumulation microbatching (loss/grad parity preserved up to
     reduction order), re-plan, retry. Each transition is logged to
     telemetry, the diagnostics flight ring, and the post-mortem "memsafe"
@@ -116,10 +118,12 @@ class MemoryBudgetError(RuntimeError):
             "cheapest first: (1) rematerialization — "
             "block.remat(policy='dots_saveable'|'layers'|'full') or the "
             "remat_policy knob trades recompute for activation memory; "
-            "(2) a smaller batch or BucketPad bucket — dataflow.autofit() "
-            "binary-searches the largest configuration that fits; "
-            "(3) shard optimizer state across data replicas (mx.zero, "
-            "ROADMAP item 2). Set oom_recover=auto to walk these "
+            "(2) shard optimizer state across the data replicas — set "
+            "zero=auto (mx.zero) or trainer.set_zero(True): resident "
+            "opt-state bytes drop by (D-1)/D with values unchanged; "
+            "(3) a smaller batch or BucketPad bucket — dataflow.autofit() "
+            "binary-searches the largest configuration that fits. "
+            "Set oom_recover=auto to walk these "
             "automatically, or raise device_bytes_limit if the simulated "
             "capacity is wrong.")
 
@@ -222,19 +226,33 @@ def capacity_bytes():
 
 
 def resident_bytes(*trees):
-    """Total nbytes of every array leaf in the given pytrees — the state
-    that stays resident on device while the executable runs (params,
-    optimizer moments, aux, the staged batch)."""
+    """Total PER-DEVICE bytes of every array leaf in the given pytrees —
+    the state that stays resident on each device while the executable
+    runs (params, optimizer moments, aux, the staged batch). A sharded
+    array (mx.zero optimizer state, fsdp params, a sharded batch) counts
+    only its per-device shard, not the global array: that is what each
+    device actually keeps, and what the budget check must compare against
+    per-chip capacity. Replicated arrays count in full."""
+    import math
+
     import jax
     total = 0
     for tree in trees:
         for leaf in jax.tree_util.tree_leaves(tree):
             try:
-                total += int(leaf.nbytes)
+                nbytes = int(leaf.nbytes)
             except Exception:
                 # typed PRNG keys (and other extended dtypes) refuse
                 # .nbytes; they are a handful of words — negligible
-                pass
+                continue
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None:
+                try:
+                    shard = sharding.shard_shape(tuple(leaf.shape))
+                    nbytes = int(math.prod(shard)) * leaf.dtype.itemsize
+                except Exception:
+                    pass    # host arrays / odd shardings: global count
+            total += nbytes
     return total
 
 
@@ -512,14 +530,32 @@ def _state_intact(trainer):
                for leaf in leaves)
 
 
+def _zero_rung_available(trainer):
+    """True when the 'enable mx.zero' rung can fire: the trainer is not
+    already sharding optimizer state and its mesh/state could (lazy
+    import: memsafe must not pull the parallel package at import)."""
+    if getattr(trainer, "_zero", False) or not hasattr(trainer, "set_zero"):
+        return False
+    try:
+        from .parallel import zero as _zero
+        return _zero.eligible(trainer)
+    except Exception:
+        return False
+
+
 def _next_rung(trainer, data, labels):
     """The next degradation to try: escalate the remat policy one rung
-    while possible, then double the gradient-accumulation factor while the
-    batch still divides. None when the ladder is exhausted."""
+    while possible, then shard the optimizer state across the data
+    replicas (mx.zero — a pure layout change, bit-identical values,
+    (D-1)/D of the opt-state bytes back), then double the gradient-
+    accumulation factor while the batch still divides. None when the
+    ladder is exhausted."""
     cur = policy_marker(trainer.block)
     if hasattr(trainer.block, "remat") and cur in LADDER \
             and cur != LADDER[-1]:
         return ("remat", LADDER[LADDER.index(cur) + 1])
+    if _zero_rung_available(trainer):
+        return ("zero", True)
     data = data if isinstance(data, (list, tuple)) else [data]
     labels = labels if isinstance(labels, (list, tuple)) else [labels]
     new_accum = int(getattr(trainer, "_accum", 1)) * 2
@@ -537,12 +573,19 @@ def _next_rung(trainer, data, labels):
 def _note_transition(trainer, kind, value, step):
     entry = {"kind": kind, "value": value, "step": step, "ts": time.time(),
              "policy": policy_marker(trainer.block),
-             "accum": int(getattr(trainer, "_accum", 1))}
+             "accum": int(getattr(trainer, "_accum", 1)),
+             "zero": bool(getattr(trainer, "_zero", False))}
     with _lock:
         _transitions.append(entry)
-    what = (f"remat policy -> {value!r}" if kind == "remat"
-            else f"gradient accumulation x{value} (microbatch = batch/"
-            f"{value})")
+    if kind == "remat":
+        what = f"remat policy -> {value!r}"
+    elif kind == "zero":
+        what = ("optimizer-state sharding ON (mx.zero: reduce-scatter/"
+                "all-gather weight update; values unchanged, resident "
+                "opt-state bytes /= data extent)")
+    else:
+        what = (f"gradient accumulation x{value} (microbatch = batch/"
+                f"{value})")
     print(f"mx.memsafe: degradation ladder at step {step}: {what}",
           file=sys.stderr)
     if _telemetry._enabled:
@@ -594,6 +637,8 @@ def recover_trainer(trainer, exc, data, labels, fence_every):
         kind, value = rung
         if kind == "remat":
             trainer.block.remat(value)
+        elif kind == "zero":
+            trainer.set_zero(True)
         else:
             trainer.set_grad_accum(value)
         trainer._step_cache.clear()
@@ -612,8 +657,10 @@ def recover_trainer(trainer, exc, data, labels, fence_every):
         if _telemetry._enabled:
             _M_OOM_RECOVERIES.inc()
         print(f"mx.memsafe: step {step} recovered (policy="
-              f"{policy_marker(trainer.block)!r}, grad accumulation x"
-              f"{getattr(trainer, '_accum', 1)})", file=sys.stderr)
+              f"{policy_marker(trainer.block)!r}, zero="
+              f"{bool(getattr(trainer, '_zero', False))}, grad "
+              f"accumulation x{getattr(trainer, '_accum', 1)})",
+              file=sys.stderr)
         return out
 
 

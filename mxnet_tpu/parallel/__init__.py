@@ -17,6 +17,7 @@ from .pipeline import (pipeline_apply, pipeline_shard_map,
 from .distributed import init_distributed, is_distributed
 from .elastic import AutoCheckpoint, resize_trainer
 from . import reshard
+from . import zero
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .moe import moe_apply, moe_ffn
 
@@ -30,4 +31,4 @@ __all__ = ["make_mesh", "MeshPlan", "current_mesh", "set_mesh", "named_sharding"
            "init_distributed",
            "is_distributed", "ulysses_attention", "ulysses_self_attention",
            "moe_apply", "moe_ffn", "AutoCheckpoint", "resize_trainer",
-           "reshard"]
+           "reshard", "zero"]

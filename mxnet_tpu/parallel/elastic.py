@@ -110,26 +110,47 @@ def resize_trainer(trainer, mesh=None, devices=None, **axis_sizes):
               for _, p in trainer._grad_params]
     aux_shard = [_specs.replicated(mesh) for _ in trainer._aux_params]
 
+    # mx.zero: re-plan the optimizer-state sharding for the NEW mesh (a
+    # 4-way shard redistributes to a 2-way shard; a shrink to data
+    # extent 1 drops back to the unsharded layout)
+    from . import zero as _zero
+    zero_flat = zero_specs = None
+    zero_on = bool(getattr(trainer, "_zero", False))
+    if zero_on:
+        if trainer._fused:
+            zero_flat = _zero.flat_spec(trainer._fl, mesh)
+            zero_on = zero_flat is not None
+        else:
+            zero_specs = _zero.plan_state(trainer.params, pshard,
+                                          trainer.opt_state, mesh)
+            zero_on = any(s is not None for s in zero_specs)
+            if not zero_on:
+                zero_specs = None
+
     sess = _reshard.Session()
     if trainer._fused:
-        # the flat f32 master + moments are replicated by construction
-        # (fused LAMB exists only in replicate mode) — the move is a
-        # replicated→replicated re-placement onto the new device set
-        trainer.params = sess.redistribute(trainer.params, rep)
+        # the flat f32 master + moments replicate by construction (fused
+        # LAMB exists only in replicate mode) — or, zero'd, shard over
+        # the new mesh's data axes
+        fspec = zero_flat if zero_on else rep
+        trainer.params = sess.redistribute(trainer.params, fspec)
         trainer.opt_state = tuple(
-            sess.redistribute(z, rep) for z in trainer.opt_state)
+            sess.redistribute(z, fspec) for z in trainer.opt_state)
     else:
         trainer.params = [sess.redistribute(a, s)
                           for a, s in zip(trainer.params, pshard)]
+        zs_l = zero_specs or [None] * len(pshard)
         trainer.opt_state = [
-            tuple(sess.redistribute(z, s) for z in st)
-            for st, s in zip(trainer.opt_state, pshard)]
+            tuple(sess.redistribute(z, zs or s) for z in st)
+            for st, zs, s in zip(trainer.opt_state, zs_l, pshard)]
     trainer.aux = [sess.redistribute(a, s)
                    for a, s in zip(trainer.aux, aux_shard)]
 
     trainer.mesh = mesh
     trainer._pshard, trainer._aux_shard, trainer._rep = \
         pshard, aux_shard, rep
+    trainer._zero, trainer._zero_specs, trainer._zero_flat = \
+        zero_on, zero_specs, zero_flat
     # executables bake the old mesh/shardings in: every cached step is
     # stale. The device counter re-places small enough to skip the session
     trainer._t_dev = jax.device_put(

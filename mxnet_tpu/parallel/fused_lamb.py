@@ -87,6 +87,19 @@ class FusedLamb:
                 for off, n, shape in zip(self.offsets[:-1], self.sizes,
                                          self.shapes)]
 
+    def shardable_rows(self, extent):
+        """True when the flat (n_rows, CHUNK) layout splits into WHOLE
+        rows across `extent` devices — the divisibility mx.zero's flat
+        master/moment sharding requires. Each device then owns complete
+        512-lane rows, so apply_flat's row-wise math (per-row moment/
+        update passes, the (R, 1) broadcasts) partitions without any
+        cross-shard reads; only the tiny per-segment norm scatter-adds
+        reduce across shards. A non-divisible layout falls back to the
+        replicated master (parallel/zero.flat_spec returns None)."""
+        extent = int(extent)
+        return extent >= 1 and self.n_rows >= extent \
+            and self.n_rows % extent == 0
+
     # -- the fused step --------------------------------------------------
     def apply_flat(self, w, g, m, v, t, lr):
         """w/m/v: flat f32 state (padded layout); g: flat f32 grads.

@@ -15,7 +15,11 @@ failure: preemption on a shrinking pod is a reshape. Grounding:
     parameter, so it must reshard ALONGSIDE params (including the
     fused-LAMB flat-master layout, which checkpoints in the canonical
     per-tensor form exactly so this module never sees a layout that only
-    one topology can express).
+    one topology can express). mx.zero (parallel/zero.py) rides this
+    end to end: a zero'd trainer's manifests record the per-shard
+    opt-state layouts, and a restore replans them bit-exactly onto a
+    different mesh, onto the unsharded layout, or off it — zero on/off
+    is a reshardable fingerprint key, not a mismatch.
 
 Three surfaces:
 

@@ -34,6 +34,7 @@ from .. import trace as _trace
 from ..gluon.block import functional_call
 from ..ndarray import NDArray
 from . import specs as _specs
+from . import zero as _zero
 from .functional_opt import FunctionalOptimizer
 from .mesh import current_mesh
 
@@ -51,10 +52,12 @@ _M_COMPILE_SECONDS = _telemetry.histogram("compile_seconds")
 _M_STEP_SECONDS = _telemetry.histogram("trainer_step_seconds")
 _M_COLL_CALLS = _telemetry.counter(
     "collective_calls_total", "XLA collectives issued per jitted train step "
-    "(host-side accounting: one gradient psum per step on the data axes)")
+    "(host-side accounting: the gradient psum on the data axes — or, on a "
+    "mx.zero'd trainer, the gradient reduce-scatter + updated-param "
+    "all-gather pair)")
 _M_COLL_BYTES = _telemetry.counter(
     "collective_bytes_total", "payload bytes moved by the counted "
-    "collectives (gradient bytes per reducing step)")
+    "collectives (gradient/param bytes per reducing step, labeled by op)")
 
 
 def call_loss(loss_fn, rng, outs, labels):
@@ -97,7 +100,11 @@ class ShardedTrainer:
         self._ready = False
         self._tele_sig = None
         self._tele_reduce_bytes = 0
+        self._tele_coll = {}
         self._coll_est = {}
+        self._zero = False
+        self._zero_specs = None
+        self._zero_flat = None
         # gradient-accumulation factor (mx.memsafe degradation ladder /
         # set_grad_accum): the jitted step splits the global batch into
         # this many microbatches, accumulating grads — loss/grad parity
@@ -148,6 +155,15 @@ class ShardedTrainer:
         self._fused = (
             self.fopt.kind == "lamb" and self.param_mode == "replicate"
             and config.get("fused_lamb"))
+        # mx.zero: shard optimizer state (fused-LAMB masters included)
+        # across the data axes per the `zero` knob. With the knob off
+        # (default) this whole region is one module-bool check — no call
+        # into the zero module at all (ci/run.sh sanity asserts it)
+        self._zero = False
+        self._zero_specs = None       # per-param opt-state shardings
+        self._zero_flat = None        # fused flat master/moment sharding
+        _zero.maybe_enable()
+        zero_want = _zero._enabled and _config.get("zero") != "off"
         if self._fused:
             from .fused_lamb import FusedLamb
             o = self.fopt.opt
@@ -159,19 +175,41 @@ class ShardedTrainer:
                 o.rescale_grad, o.clip_gradient or -1.0,
                 o.lower_bound or -1.0, o.upper_bound or -1.0,
                 moments_dtype=config.get("lamb_moments_dtype"))
+            if zero_want:
+                self._zero_flat = _zero.flat_spec(self._fl, self.mesh)
+                self._zero = self._zero_flat is not None
             master = self._fl.flatten(datas)
-            self.params = jax.device_put(master, rep)
+            pspec = self._zero_flat if self._zero else rep
+            self.params = jax.device_put(master, pspec)
             mdt = self._fl.moments_dtype
             self.opt_state = (
-                jax.device_put(jnp.zeros(master.shape, mdt), rep),
-                jax.device_put(jnp.zeros(master.shape, mdt), rep))
+                jax.device_put(jnp.zeros(master.shape, mdt), pspec),
+                jax.device_put(jnp.zeros(master.shape, mdt), pspec))
         else:
             self.params = [jax.device_put(p.data()._data, s)
                            for (_, p), s in zip(self._grad_params, self._pshard)]
-            # optimizer state shards like its parameter (weight-update sharding)
+            # optimizer state shards like its parameter (weight-update
+            # sharding) — under mx.zero, additionally across the free
+            # data axes (reduce-scatter/all-gather weight update)
+            states = self.fopt.init(self.params)
+            if zero_want:
+                self._zero_specs = _zero.plan_state(
+                    self.params, self._pshard, states, self.mesh)
+                self._zero = any(s is not None for s in self._zero_specs)
+                if not self._zero:
+                    self._zero_specs = None
             self.opt_state = [
-                tuple(jax.device_put(z, s) for z in st)
-                for st, s in zip(self.fopt.init(self.params), self._pshard)]
+                tuple(jax.device_put(z, zs or s) for z in st)
+                for st, zs, s in zip(
+                    states,
+                    self._zero_specs or [None] * len(states),
+                    self._pshard)]
+        if zero_want and not self._zero and _config.get("zero") == "on":
+            raise ValueError(
+                "zero='on' but nothing can shard: the mesh's data axes "
+                f"span {_zero.data_extent(self.mesh)} device(s) and/or no "
+                "optimizer-state buffer clears zero_min_size with a "
+                "divisible dim. Use zero='auto' to no-op silently.")
         self.aux = [jax.device_put(p.data()._data, s)
                     for (_, p), s in zip(self._aux_params, self._aux_shard)]
         # the step counter lives ON DEVICE, incremented inside the jitted
@@ -193,31 +231,41 @@ class ShardedTrainer:
         """Mesh-derived accounting for the CURRENT mesh + shardings:
         gradient-reduction payload for the collective counters and the
         mx.inspect per-collective traffic estimate. Called from _setup
-        and again after an elastic resize changes the mesh."""
+        and again after an elastic resize or set_zero changes the
+        layout."""
         # gradient-reduction payload per step, for the collective counters:
-        # XLA psums grads over the data axes iff they span >1 device
+        # XLA psums grads over the data axes iff they span >1 device; a
+        # mx.zero'd param instead reduce-scatters its gradient and
+        # all-gathers its updated value (same payload, different ops)
         reduce_degree = self.mesh.shape.get("dp", 1) * \
             self.mesh.shape.get("fsdp", 1)
-        if reduce_degree > 1:
-            if self._fused:
-                self._tele_reduce_bytes = int(
-                    self.params.size * self.params.dtype.itemsize)
-            else:
-                self._tele_reduce_bytes = int(sum(
-                    p.size * p.dtype.itemsize for p in self.params))
-        else:
-            self._tele_reduce_bytes = 0
-        # per-collective traffic estimate (mx.inspect): bytes each step's
-        # gradient reduction / fsdp gather-scatter moves, from the specs
-        # just chosen + mesh shape. One-time host arithmetic at setup
         if self._fused:
-            sized = [(self._tele_reduce_bytes or
-                      int(self.params.size * self.params.dtype.itemsize),
-                      self._rep)]
+            nbytes = int(self.params.size * self.params.dtype.itemsize)
+            entries = [(nbytes, self._rep, self._zero)]
         else:
-            sized = [(int(p.size * p.dtype.itemsize), s)
-                     for p, s in zip(self.params, self._pshard)]
-        self._coll_est = _inspect.estimate_collectives(self.mesh, sized)
+            zflags = self._zero_specs or [None] * len(self.params)
+            entries = [(int(p.size * p.dtype.itemsize), s, zs is not None)
+                       for p, s, zs in zip(self.params, self._pshard,
+                                           zflags)]
+        psum_b = rs_b = ag_b = 0
+        if reduce_degree > 1:
+            for nbytes, _s, z in entries:
+                if z:
+                    rs_b += nbytes
+                    ag_b += nbytes
+                else:
+                    psum_b += nbytes
+        self._tele_reduce_bytes = psum_b + rs_b
+        self._tele_coll = {op: n for op, n in (
+            ("psum_grad", psum_b), ("reduce_scatter_grad", rs_b),
+            ("all_gather_param", ag_b)) if n}
+        # per-collective traffic estimate (mx.inspect): bytes each step's
+        # gradient reduction / fsdp gather-scatter / zero reduce-scatter+
+        # all-gather moves, from the specs just chosen + mesh shape.
+        # One-time host arithmetic at setup
+        self._coll_est = _inspect.estimate_collectives(
+            self.mesh, [(n, s) for n, s, _z in entries],
+            zero=[z for _n, _s, z in entries])
 
     # ------------------------------------------------------------------
     def _build_step(self, n_data, n_label, batch_shapes):
@@ -226,6 +274,14 @@ class ShardedTrainer:
         fopt = self.fopt
         fused = self._fused
         fl = self._fl if fused else None
+        # mx.zero: the sharded-update wiring is baked into the step at
+        # build time (set_zero clears the step cache); with zero off all
+        # three stay None/empty and the step body is byte-identical to
+        # the classic path
+        zflat = self._zero_flat if (self._zero and fused) else None
+        zspecs = self._zero_specs if (self._zero and not fused) else None
+        pshard_l = self._pshard if not fused else None
+        rep_sh = self._rep
         accum = int(self._accum)
         if accum > 1:
             for shape in batch_shapes:
@@ -262,9 +318,19 @@ class ShardedTrainer:
                 loss = call_loss(loss_fn, rng, outs, labels)
                 return loss, new_aux
 
+            fwd_params = params
+            if zflat is not None:
+                # zero'd fused LAMB: the RESIDENT master is sharded; the
+                # forward needs the whole vector, so gather it once here
+                # (in-jit — XLA overlaps the all-gather with whatever
+                # else is ready). Gradients are taken wrt this gathered
+                # value, then reduce-SCATTERED below instead of psum'd.
+                fwd_params = _zero.constrain(params, rep_sh)
+
             if accum <= 1:
                 (loss, new_aux), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(params, aux, data, labels, rng)
+                    loss_of, has_aux=True)(fwd_params, aux, data, labels,
+                                           rng)
             else:
                 # gradient-accumulation microbatching (mx.memsafe
                 # degradation ladder): lax.scan over `accum` equal slices
@@ -284,21 +350,43 @@ class ShardedTrainer:
                     i, mb = xs[0], list(xs[1:])
                     (l, na), g = jax.value_and_grad(
                         loss_of, has_aux=True)(
-                            params, aux_c, mb[:n_data], mb[n_data:],
+                            fwd_params, aux_c, mb[:n_data], mb[n_data:],
                             jax.random.fold_in(rng, i))
                     g_acc = jax.tree.map(jnp.add, g_acc, g)
                     return (g_acc, l_acc + l, na), None
 
-                g0 = jax.tree.map(jnp.zeros_like, params)
+                g0 = jax.tree.map(jnp.zeros_like, fwd_params)
                 (g_sum, l_sum, new_aux), _ = jax.lax.scan(
                     micro, (g0, jnp.zeros((), jnp.float32), list(aux)),
                     (jnp.arange(accum),) + tuple(split))
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 loss = l_sum / accum
             if fused:
+                if zflat is not None:
+                    # reduce-scatter the flat gradient: each device lands
+                    # the shard matching its resident master/moments
+                    grads = _zero.constrain(grads, zflat)
                 new_params, new_m, new_v = fl.apply_flat(
                     params, grads, opt_state[0], opt_state[1], tf, lr)
                 new_opt = (new_m, new_v)
+            elif zspecs is not None:
+                # mx.zero weight-update sharding (arxiv 2004.13336):
+                # reduce-scatter each zero'd gradient, slice the matching
+                # param shard (free — a sharding constraint, no movement),
+                # run the optimizer on 1/D of the elements, then
+                # all-gather the updated param back to its resident
+                # layout. XLA emits the collectives from the constraints
+                # and can overlap the all-gather with the tail of
+                # backward; non-zero'd params (tiny state) keep the psum
+                grads = [g if zs is None else _zero.constrain(g, zs)
+                         for g, zs in zip(grads, zspecs)]
+                w_upd = [p if zs is None else _zero.constrain(p, zs)
+                         for p, zs in zip(params, zspecs)]
+                new_params, new_opt = fopt.apply(w_upd, grads, opt_state,
+                                                 tf, lr)
+                new_params = [w if zs is None else _zero.constrain(w, ps)
+                              for w, zs, ps in zip(new_params, zspecs,
+                                                   pshard_l)]
             else:
                 new_params, new_opt = fopt.apply(params, grads, opt_state,
                                                  tf, lr)
@@ -306,12 +394,17 @@ class ShardedTrainer:
 
         donate = (0, 1, 2, 3) if self._donate else (3,)
         if fused:
-            pshard = self._rep
-            oshard = (self._rep, self._rep)
+            pshard = zflat if zflat is not None else self._rep
+            oshard = (pshard, pshard)
         else:
             pshard = self._pshard
-            oshard = [tuple(s for _ in st)
-                      for st, s in zip(self.opt_state, self._pshard)]
+            # zero'd opt state goes in AND comes out in its sharded
+            # layout — identical avals + shardings, so donation aliases
+            # cleanly (no double-buffering; mx.check stays quiet)
+            zs_l = zspecs or [None] * len(self.opt_state)
+            oshard = [tuple((zs or s) for _ in st)
+                      for st, zs, s in zip(self.opt_state, zs_l,
+                                           self._pshard)]
         scalar_in = () if lr_fn is not None else (self._rep,)
         in_shardings = (
             pshard, self._aux_shard, oshard, self._rep,
@@ -368,6 +461,67 @@ class ShardedTrainer:
                              f"got {accum}")
         self._accum = accum
         self._step_cache.clear()
+        return self
+
+    def set_zero(self, on=True):
+        """Toggle mx.zero optimizer-state sharding on a LIVE trainer:
+        the resident moments (and fused-LAMB flat master) re-place into
+        the sharded layout across the mesh's free data axes, and the
+        next step re-jits with the reduce-scatter -> per-shard update ->
+        all-gather wiring (off: everything moves back to the parameter's
+        own sharding and the classic psum step). Values are bit-identical
+        either way — only the layout moves. The mx.memsafe
+        oom_recover=auto ladder drives this as the rung between
+        remat='full' and gradient accumulation; zero='auto'/'on' does it
+        at construction. Raises ValueError when nothing can shard."""
+        if not self._ready:
+            raise RuntimeError(
+                "set_zero needs materialized parameters — run one step "
+                "(or construct with explicit shapes) first")
+        on = bool(on)
+        if on == bool(self._zero):
+            return self
+        if on:
+            _zero.enable()     # arm the module for the re-jitted step
+            if self._fused:
+                spec = _zero.flat_spec(self._fl, self.mesh)
+                if spec is None:
+                    raise ValueError(
+                        "mx.zero: the fused-LAMB flat layout cannot "
+                        "shard on this mesh (no data axis spans >1 "
+                        "device, or rows do not divide)")
+                self._zero_flat = spec
+                self.params = jax.device_put(self.params, spec)
+                self.opt_state = tuple(jax.device_put(z, spec)
+                                       for z in self.opt_state)
+            else:
+                specs = _zero.plan_state(self.params, self._pshard,
+                                         self.opt_state, self.mesh)
+                if not any(s is not None for s in specs):
+                    raise ValueError(
+                        "mx.zero: no optimizer-state buffer can shard on "
+                        "this mesh (no free data axis spans >1 device, "
+                        "or everything is under zero_min_size)")
+                self._zero_specs = specs
+                self.opt_state = [
+                    tuple(jax.device_put(z, zs or s) for z in st)
+                    for st, zs, s in zip(self.opt_state, specs,
+                                         self._pshard)]
+            self._zero = True
+        else:
+            if self._fused:
+                self.params = jax.device_put(self.params, self._rep)
+                self.opt_state = tuple(jax.device_put(z, self._rep)
+                                       for z in self.opt_state)
+                self._zero_flat = None
+            else:
+                self.opt_state = [
+                    tuple(jax.device_put(z, s) for z in st)
+                    for st, s in zip(self.opt_state, self._pshard)]
+                self._zero_specs = None
+            self._zero = False
+        self._step_cache.clear()
+        self._refresh_comm_estimates()
         return self
 
     def _lr_cache_key(self):
@@ -712,9 +866,9 @@ class ShardedTrainer:
                 kind, block=f"ShardedTrainer({type(self.block).__name__})",
                 compile_time_s=round(dt, 6), causes=causes, changed=changed,
                 signature=sig)
-        if self._tele_reduce_bytes:
-            _M_COLL_CALLS.labels(op="psum_grad").inc()
-            _M_COLL_BYTES.labels(op="psum_grad").inc(self._tele_reduce_bytes)
+        for op, nbytes in self._tele_coll.items():
+            _M_COLL_CALLS.labels(op=op).inc()
+            _M_COLL_BYTES.labels(op=op).inc(nbytes)
 
     # ------------------------------------------------------------------
     def sync_to_block(self):
@@ -767,14 +921,17 @@ class ShardedTrainer:
         redistribute, 'off' raises MeshMismatchError on any mismatch."""
         state = _ckpt_restore(self, directory, reshard)
         if self._fused:
+            # a zero'd trainer re-flattens into its SHARDED resident
+            # layout (checkpoints stay canonical per-tensor either way)
+            pspec = self._zero_flat if self._zero else self._rep
             self.params = jax.device_put(
-                self._fl.flatten(state["params"]), self._rep)
+                self._fl.flatten(state["params"]), pspec)
             mdt = self._fl.moments_dtype
             self.opt_state = (
                 jax.device_put(self._fl.flatten(
-                    [st[0] for st in state["opt_state"]], mdt), self._rep),
+                    [st[0] for st in state["opt_state"]], mdt), pspec),
                 jax.device_put(self._fl.flatten(
-                    [st[1] for st in state["opt_state"]], mdt), self._rep))
+                    [st[1] for st in state["opt_state"]], mdt), pspec))
         else:
             self.params = list(state["params"])
             self.opt_state = [tuple(st) for st in state["opt_state"]]

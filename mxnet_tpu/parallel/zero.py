@@ -1,0 +1,205 @@
+"""mx.zero — cross-replica optimizer-state sharding.
+
+Every data-parallel replica of a ShardedTrainer holds a full copy of the
+optimizer moments (and, on the fused-LAMB path, the fp32 flat master) —
+the single largest avoidable slice of device memory, and the one
+mx.check's degenerate-sharding rule flags. Grounding (PAPERS.md):
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arxiv 2004.13336) — replace the gradient all-reduce +
+replicated weight update with
+
+    reduce-scatter(grad)  ->  per-shard weight update  ->  all-gather(w')
+
+Each replica then updates only 1/D of the parameters (D = the data-axis
+extent) and KEEPS only 1/D of the optimizer state resident. Collective
+payload is unchanged — a ring all-reduce moves 2(D-1)/D of the gradient,
+the reduce-scatter + all-gather pair moves (D-1)/D each — but the
+update's FLOPs/HBM traffic drop by D and the resident optimizer bytes by
+(D-1)/D. With Adam (8 bytes/param of moments) at D=8 that is 7 bytes/
+param back; with fused LAMB (4 master + 8 moment bytes/param, all
+sharded here) it is 10.5 bytes/param.
+
+Everything is expressed INSIDE the trainer's single jitted step as
+sharding annotations (in/out shardings on the optimizer state plus
+`with_sharding_constraint` on the gradient / updated param), so XLA's
+SPMD partitioner emits the reduce-scatter/all-gather itself and its
+latency-hiding scheduler can overlap the all-gather with the tail of
+backward. Donation is preserved: the sharded state is donated with the
+same sharding it returns with, so mx.check's donation lint stays quiet
+on a zero'd step.
+
+The `zero` knob: 'off' (default) is the zero-overhead fast path — the
+trainer makes no call into this module beyond one construction-time
+config read (ci/run.sh sanity asserts it). 'auto' shards at trainer
+construction whenever the mesh's data axes span more than one device
+(a no-op otherwise). 'on' insists: construction raises when nothing can
+be sharded (no data axis > 1, or no optimizer state clears
+`zero_min_size`). Independent of the knob, the mx.memsafe
+oom_recover=auto ladder may enable sharding on a live trainer
+(`trainer.set_zero(True)`) as the recovery rung between remat=full and
+gradient accumulation.
+
+Sharding rules (see `zero_spec`): the optimizer state of a parameter
+shards over the data axes NOT already present in the parameter's own
+sharding — all of (dp, fsdp) in replicate mode, the dp remainder for an
+fsdp-sharded parameter. The fused-LAMB flat master/moment vectors shard
+on their single dimension whenever the (rows, chunk) layout divides.
+Parameters whose state cannot shard (no divisible dim, or smaller than
+`zero_min_size` elements) keep the classic psum path — the step mixes
+both per parameter.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import config as _config
+from . import specs as _specs
+
+__all__ = [
+    "enable", "disable", "enabled", "maybe_enable",
+    "data_extent", "zero_axes", "zero_spec", "flat_spec", "plan_state",
+    "eligible", "constrain",
+]
+
+_enabled = False              # the fast-path bool; hook sites read it directly
+
+
+def enabled():
+    """True when mx.zero is armed (the trainer reads the module global
+    `_enabled` directly — this accessor is the public spelling)."""
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def maybe_enable():
+    """Arm iff the `zero` knob asks ('auto' or 'on'). Called at trainer
+    construction — one config read, never on the step hot path."""
+    if _enabled:
+        return True
+    if _config.get("zero") != "off":
+        enable()
+    return _enabled
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def data_extent(mesh):
+    """Product of the data-axis sizes — the D in the (D-1)/D memory win."""
+    return int(mesh.shape.get("dp", 1)) * int(mesh.shape.get("fsdp", 1))
+
+
+def _spec_entries(sharding, ndim):
+    """The PartitionSpec entries of a sharding, padded to ndim."""
+    spec = getattr(sharding, "spec", sharding)
+    entries = list(tuple(spec or ()))
+    return entries + [None] * (ndim - len(entries))
+
+
+def zero_axes(mesh, sharding, ndim):
+    """Data axes (size > 1) NOT already used by `sharding` — the axes the
+    optimizer state can additionally shard over. Replicated params yield
+    all sharded data axes; an fsdp-sharded param yields the dp remainder."""
+    used = set()
+    for entry in _spec_entries(sharding, ndim):
+        if entry is None:
+            continue
+        used.update(entry if isinstance(entry, tuple) else (entry,))
+    return tuple(a for a in _specs.DATA_AXES
+                 if a not in used and int(mesh.shape.get(a, 1)) > 1)
+
+
+def _min_size():
+    return int(_config.get("zero_min_size"))
+
+
+def zero_spec(shape, base_sharding, mesh):
+    """The zero sharding for one parameter's optimizer state (and the
+    per-shard view of its weight update): the parameter's own sharding
+    plus the free data axes on the largest still-unsharded dim that
+    divides by their extent. None when nothing shards — no free data
+    axis, no divisible dim, or fewer than `zero_min_size` elements (tiny
+    LayerNorm/bias state is not worth the reshard churn, same argument
+    as fsdp_min_size)."""
+    shape = tuple(shape)
+    if not shape or int(np.prod(shape)) < _min_size():
+        return None
+    axes = zero_axes(mesh, base_sharding, len(shape))
+    if not axes:
+        return None
+    extent = int(np.prod([mesh.shape[a] for a in axes]))
+    entries = _spec_entries(base_sharding, len(shape))
+    for dim in sorted(range(len(shape)), key=lambda i: -shape[i]):
+        if entries[dim] is not None:
+            continue
+        if shape[dim] % extent == 0 and shape[dim] >= extent:
+            entries[dim] = axes if len(axes) > 1 else axes[0]
+            return NamedSharding(mesh, PartitionSpec(*entries))
+    return None
+
+
+def flat_spec(fl, mesh):
+    """The zero sharding for the fused-LAMB flat master/moment vectors,
+    or None. The flat layout is (n_rows, CHUNK) underneath — the vector
+    shards on dim 0 only when whole rows land on each device (n_rows
+    divisible by the data extent), so the row-wise trust-ratio math in
+    FusedLamb.apply_flat partitions cleanly."""
+    axes = zero_axes(mesh, _specs.replicated(mesh), 1)
+    if not axes:
+        return None
+    extent = int(np.prod([mesh.shape[a] for a in axes]))
+    if fl.total < _min_size() or not fl.shardable_rows(extent):
+        return None
+    return NamedSharding(mesh, PartitionSpec(axes if len(axes) > 1
+                                             else axes[0]))
+
+
+def plan_state(params, pshards, states, mesh):
+    """Per-parameter zero shardings for a trainer's optimizer state:
+    one entry per param — a NamedSharding, or None for params that keep
+    the classic psum path (no state to shard, too small, or no divisible
+    dim). Aligned with `params`/`pshards`."""
+    return [zero_spec(p.shape, s, mesh) if st else None
+            for p, s, st in zip(params, pshards, states)]
+
+
+def eligible(trainer):
+    """True when `trainer` COULD shard optimizer state on its current
+    mesh — what the mx.memsafe ladder checks before proposing the
+    'enable mx.zero' rung. Requires a ready ShardedTrainer with a data
+    axis spanning >1 device and at least one shardable state buffer."""
+    if not getattr(trainer, "_ready", False) \
+            or not hasattr(trainer, "set_zero"):
+        return False
+    mesh = getattr(trainer, "mesh", None)
+    if mesh is None or data_extent(mesh) <= 1:
+        return False
+    if getattr(trainer, "_fused", False):
+        return flat_spec(trainer._fl, mesh) is not None
+    return any(s is not None for s in plan_state(
+        trainer.params, trainer._pshard, trainer.opt_state, mesh))
+
+
+# ---------------------------------------------------------------------------
+# the in-step hook
+# ---------------------------------------------------------------------------
+
+def constrain(x, sharding):
+    """`with_sharding_constraint` under a monkeypatchable name: the
+    trainer's zero'd step routes every gradient reduce-scatter, per-shard
+    slice and updated-param all-gather through here, so ci/run.sh sanity
+    can assert the zero=off fast path makes ZERO of these calls."""
+    import jax
+    return jax.lax.with_sharding_constraint(x, sharding)

@@ -544,8 +544,11 @@ def verify_checkpoint(directory):
 
 
 #: fingerprint keys a planned redistribution can bridge — anything else
-#: differing (e.g. the trainer class) is structural, not topological
-RESHARDABLE_KEYS = frozenset({"mesh_shape", "param_mode"})
+#: differing (e.g. the trainer class) is structural, not topological.
+#: "zero" (mx.zero optimizer-state sharding on/off) is a pure layout
+#: change: a zero'd checkpoint restores onto an unsharded trainer and
+#: vice versa, bit-exactly, via the same planned-reshard path
+RESHARDABLE_KEYS = frozenset({"mesh_shape", "param_mode", "zero"})
 
 
 def check_fingerprint(manifest, expected, directory=""):
@@ -788,6 +791,10 @@ def trainer_fingerprint(trainer):
     mode = getattr(trainer, "param_mode", None)
     if mode is not None:
         fp["param_mode"] = mode
+    if hasattr(trainer, "_zero"):
+        # mx.zero layout identity: restores across the zero'd/unsharded
+        # boundary are planned redistributions, not mismatches
+        fp["zero"] = bool(trainer._zero)
     return fp
 
 

@@ -1,0 +1,819 @@
+"""mx.zero tests: optimizer-state sharding planning, bit-exact parity of
+the zero'd (reduce-scatter -> per-shard update -> all-gather) step vs the
+classic psum step for SGD/Adam/fused-LAMB in replicate and fsdp modes,
+the (D-1)/D per-device resident accounting through memsafe and
+predict_step_bytes, collective estimates + telemetry attribution,
+checkpoint round-trips on/off the sharded layout and across topologies,
+the live set_zero toggle + elastic resize replan, the mx.memsafe ladder
+rung, the mx.check degenerate-sharding suppression, the zero=off
+fast path, and the kill-shrink elastic acceptance smoke (ci/run.sh
+dist)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import check, config, diagnostics, memsafe, nd, parallel
+from mxnet_tpu import resilience, telemetry
+from mxnet_tpu import inspect as mxinspect
+from mxnet_tpu.parallel import zero
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    zero.disable()
+    memsafe.disable()
+    memsafe.reset()
+    check.disable()
+    check.reset()
+    mxinspect.disable()
+    mxinspect.reset()
+    resilience.uninstall()
+    diagnostics.uninstall()
+    diagnostics.reset()
+    telemetry.reset()
+    telemetry.disable()
+    config.reset()
+    parallel.set_mesh(None)
+
+
+def _xy(batch=16, in_units=64, out_units=64, seed=0):
+    rs = np.random.RandomState(seed)
+    return (nd.array(rs.randn(batch, in_units).astype(np.float32)),
+            nd.array(rs.randn(batch, out_units).astype(np.float32)))
+
+
+def _trainer(optimizer, opt_params, mode="replicate", mesh_kw=None,
+             seed=0, bias=True, in_units=64, out_units=64):
+    mesh_kw = mesh_kw or {"dp": -1}
+    n = [v for v in mesh_kw.values() if v != -1]
+    devs = jax.devices() if -1 in mesh_kw.values() \
+        else jax.devices()[:int(np.prod(n))]
+    parallel.make_mesh(devices=devs, **mesh_kw)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(out_units, in_units=in_units, use_bias=bias),
+            nn.Dense(out_units, in_units=out_units, use_bias=bias))
+    net.initialize()
+    lfn = gloss.L2Loss()
+    return parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), optimizer,
+                                   opt_params, param_mode=mode), net
+
+
+def _params_np(tr):
+    if tr._fused:
+        return [np.asarray(p) for p in tr._fl.unflatten_master(tr.params)]
+    return [np.asarray(p) for p in tr.params]
+
+
+def _opt_np(tr):
+    if tr._fused:
+        return [np.asarray(z) for z in tr.opt_state]
+    return [np.asarray(z) for st in tr.opt_state for z in st]
+
+
+def _opt_nbytes_unsharded(tr):
+    """Global (unsharded) optimizer-state bytes — the zero=off resident."""
+    import jax.tree_util as jtu
+    return sum(int(z.nbytes) for z in jtu.tree_leaves(tr.opt_state))
+
+
+# ---------------------------------------------------------------------------
+# planning
+# ---------------------------------------------------------------------------
+
+def test_zero_spec_planning_rules():
+    mesh = parallel.make_mesh(dp=2, fsdp=4)
+    rep = parallel.specs.replicated(mesh)
+    config.set("zero_min_size", 1)
+    # replicated 2D param: both data axes land on the largest divisible dim
+    s = zero.zero_spec((64, 8), rep, mesh)
+    assert s is not None and s.spec == parallel.PartitionSpec(
+        ("dp", "fsdp"), None)
+    # fsdp-sharded param: only the free dp axis is added
+    base = parallel.specs.fsdp_spec((128, 16), mesh)
+    assert "fsdp" in str(base.spec)
+    s = zero.zero_spec((128, 16), base, mesh)
+    assert s is not None
+    assert "dp" in str(s.spec) and "fsdp" in str(s.spec)
+    # nothing divides -> None (falls back to the psum path)
+    assert zero.zero_spec((7, 3), rep, mesh) is None
+    # under zero_min_size -> None
+    config.set("zero_min_size", 10**6)
+    assert zero.zero_spec((64, 8), rep, mesh) is None
+
+
+def test_zero_auto_noop_and_on_raises_on_1_device_mesh():
+    config.set("zero", "auto")
+    config.set("zero_min_size", 1)
+    parallel.make_mesh(dp=1, devices=jax.devices()[:1])
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "adam",
+                                 {"learning_rate": 0.01})
+    assert tr._zero is False          # auto: silently nothing to shard
+    config.set("zero", "on")
+    net2 = nn.Dense(4, in_units=8)
+    net2.initialize()
+    with pytest.raises(ValueError, match="zero='on'"):
+        parallel.ShardedTrainer(net2, lambda o, l: lfn(o, l), "adam",
+                                {"learning_rate": 0.01})
+
+
+# ---------------------------------------------------------------------------
+# parity: zero'd vs unsharded (the tentpole correctness bar)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_parity_replicate_bit_exact(optimizer, opt_params):
+    """SGD-momentum and Adam on the 8-device dp mesh: the zero'd step's
+    params AND moments after 6 steps are BIT-EXACT vs the unsharded
+    trainer — the per-shard update computes the same floats, and no
+    reduction order changes (the reduce-scatter sums the same per-replica
+    partials the psum did)."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer(optimizer, opt_params)
+    for _ in range(6):
+        l0 = tr0.step(x, y)
+    config.set("zero", "auto")
+    tr1, _ = _trainer(optimizer, opt_params)
+    assert tr1._zero and any(s is not None for s in tr1._zero_specs)
+    # every moment buffer with a spec is actually placed sharded
+    for st, zs in zip(tr1.opt_state, tr1._zero_specs):
+        for z in st:
+            if zs is not None:
+                assert z.sharding == zs
+    for _ in range(6):
+        l1 = tr1.step(x, y)
+    assert float(l0.asscalar()) == float(l1.asscalar())
+    for a, b in zip(_params_np(tr0), _params_np(tr1)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_opt_np(tr0), _opt_np(tr1)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_parity_fused_lamb_flat_master():
+    """Fused LAMB (flat fp32 master + moments, all sharded): parity up to
+    float reduction order — the segment trust-ratio norms and the
+    reduce-scatter reduce in a different order than the replicated psum
+    step."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    # bias-free 64x64 layers: 8 rows of 512 each -> n_rows % 8 == 0
+    tr0, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                      bias=False)
+    assert tr0._fused and not tr0._zero
+    for _ in range(6):
+        l0 = tr0.step(x, y)
+    config.set("zero", "auto")
+    tr1, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                      bias=False)
+    assert tr1._fused and tr1._zero
+    # master AND both moment vectors live sharded over dp
+    assert "dp" in str(tr1.params.sharding.spec)
+    for z in tr1.opt_state:
+        assert "dp" in str(z.sharding.spec)
+    for _ in range(6):
+        l1 = tr1.step(x, y)
+    np.testing.assert_allclose(float(l0.asscalar()), float(l1.asscalar()),
+                               rtol=1e-6)
+    for a, b in zip(_params_np(tr0), _params_np(tr1)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    for a, b in zip(_opt_np(tr0), _opt_np(tr1)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_parity_fsdp_mode():
+    """fsdp param mode: params already shard over fsdp; zero adds the dp
+    remainder to the optimizer state and the dp reduction becomes
+    reduce-scatter + all-gather. Parity up to reduction order."""
+    config.set("zero_min_size", 1)
+    config.set("fsdp_min_size", 1)
+    x, y = _xy(in_units=16, out_units=8)
+
+    def build():
+        parallel.make_mesh(dp=2, fsdp=4)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(128, in_units=16), nn.Dense(8, in_units=128))
+        net.initialize()
+        lfn = gloss.L2Loss()
+        return parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "adam",
+                                       {"learning_rate": 0.01},
+                                       param_mode="fsdp")
+
+    tr0 = build()
+    for _ in range(6):
+        tr0.step(x, y)
+    config.set("zero", "auto")
+    tr1 = build()
+    assert tr1._zero
+    # at least one zero spec carries BOTH dp (added) and fsdp (inherited)
+    assert any(zs is not None and "dp" in str(zs.spec)
+               and "fsdp" in str(zs.spec) for zs in tr1._zero_specs)
+    for _ in range(6):
+        tr1.step(x, y)
+    for a, b in zip(_params_np(tr0), _params_np(tr1)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_set_zero_live_toggle_bit_exact():
+    """set_zero is a pure layout move: toggling mid-run changes no value,
+    and the continued zero'd trajectory equals the never-toggled one."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer("adam", {"learning_rate": 0.01})
+    for _ in range(6):
+        tr0.step(x, y)
+    tr1, _ = _trainer("adam", {"learning_rate": 0.01})
+    for _ in range(3):
+        tr1.step(x, y)
+    before = _opt_np(tr1)
+    tr1.set_zero(True)
+    assert tr1._zero
+    for a, b in zip(before, _opt_np(tr1)):
+        np.testing.assert_array_equal(a, b)     # layout moved, values not
+    for _ in range(3):
+        tr1.step(x, y)
+    for a, b in zip(_params_np(tr0), _params_np(tr1)):
+        np.testing.assert_array_equal(a, b)
+    # and back off: values still identical, layout unsharded again
+    tr1.set_zero(False)
+    assert not tr1._zero and tr1._zero_specs is None
+    for a, b in zip(_params_np(tr0), _params_np(tr1)):
+        np.testing.assert_array_equal(a, b)
+    for st, s in zip(tr1.opt_state, tr1._pshard):
+        for z in st:
+            assert z.sharding == s
+
+
+# ---------------------------------------------------------------------------
+# accounting: the (D-1)/D memory win, measured
+# ---------------------------------------------------------------------------
+
+def test_opt_state_resident_bytes_drop_by_data_extent():
+    """The acceptance accounting, measured on the 8-way dryrun mesh:
+    per-device resident opt-state bytes drop to exactly 1/8 of the
+    unsharded bytes (every buffer shards here), predict_step_bytes sees
+    the same drop, and mx.inspect reports the step executable's
+    peak_device_bytes for both configurations (the real number the bench
+    row surfaces)."""
+    config.set("zero_min_size", 1)
+    mxinspect.enable()
+    x, y = _xy()
+    tr0, net0 = _trainer("adam", {"learning_rate": 0.01})
+    tr0.step(x, y)
+    full = memsafe.resident_bytes((tr0.opt_state,))
+    assert full == _opt_nbytes_unsharded(tr0)   # replicated: global count
+    p0 = tr0.predict_step_bytes([x], [y])
+    rec0 = mxinspect.get(f"ShardedTrainer({type(net0).__name__})")
+    peak0 = rec0.peak_bytes if rec0 is not None else None
+    mxinspect.reset()
+    mxinspect.enable()
+
+    config.set("zero", "auto")
+    tr1, net1 = _trainer("adam", {"learning_rate": 0.01})
+    tr1.step(x, y)
+    assert all(s is not None for s in tr1._zero_specs)
+    sharded = memsafe.resident_bytes((tr1.opt_state,))
+    D = zero.data_extent(tr1.mesh)
+    assert D == 8
+    assert sharded * D == full, (sharded, full)
+    p1 = tr1.predict_step_bytes([x], [y])
+    drop = p0["resident_bytes"] - p1["resident_bytes"]
+    assert drop == full - sharded, (drop, full, sharded)
+    rec1 = mxinspect.get(f"ShardedTrainer({type(net1).__name__})")
+    peak1 = rec1.peak_bytes if rec1 is not None else None
+    print(f"# mx.zero accounting at D={D}: opt-state {full} -> {sharded} "
+          f"bytes/device; predict_step_bytes resident "
+          f"{p0['resident_bytes']} -> {p1['resident_bytes']}; "
+          f"inspect peak_device_bytes {peak0} -> {peak1}")
+
+
+def test_collective_estimates_and_telemetry_ops():
+    """The zero'd step's estimated traffic moves from psum to the
+    reduce-scatter + all-gather pair at the SAME total ring bytes, and
+    the per-step telemetry counters attribute the new ops."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer("adam", {"learning_rate": 0.01})
+    est0 = dict(tr0._coll_est)
+    assert set(est0) == {"psum"}
+    config.set("zero", "auto")
+    telemetry.enable()
+    tr1, _ = _trainer("adam", {"learning_rate": 0.01})
+    est1 = dict(tr1._coll_est)
+    assert "psum" not in est1       # every param zero'd on this model
+    assert est1["reduce_scatter"] > 0 and est1["all_gather"] > 0
+    assert abs(sum(est1.values()) - est0["psum"]) <= 2  # int rounding
+    tr1.step(x, y)
+    calls = telemetry.counter("collective_calls_total")
+    bts = telemetry.counter("collective_bytes_total")
+    assert calls.labels(op="reduce_scatter_grad").value == 1
+    assert calls.labels(op="all_gather_param").value == 1
+    assert calls.labels(op="psum_grad").value == 0
+    pbytes = sum(int(p.nbytes) for p in tr1.params)
+    assert bts.labels(op="reduce_scatter_grad").value == pbytes
+    assert bts.labels(op="all_gather_param").value == pbytes
+
+
+def test_inspect_records_zero_collectives():
+    """mx.inspect's per-executable record carries the zero step's
+    reduce_scatter/all_gather estimate (collective_bytes_est feed)."""
+    config.set("zero", "auto")
+    config.set("zero_min_size", 1)
+    mxinspect.enable()
+    x, y = _xy()
+    tr, net = _trainer("adam", {"learning_rate": 0.01})
+    tr.step(x, y)
+    rec = mxinspect.get(f"ShardedTrainer({type(net).__name__})")
+    assert rec is not None
+    assert rec.collectives.get("reduce_scatter", 0) > 0
+    assert rec.collectives.get("all_gather", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# donation + graph lint: the zero'd step stays clean
+# ---------------------------------------------------------------------------
+
+def test_zero_step_donation_lint_quiet():
+    check.enable("warn")
+    config.set("zero", "auto")
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01})
+    assert tr._zero
+    tr.step(x, y)
+    assert check.findings("donation-miss") == []
+
+
+def test_check_degenerate_sharding_quiet_when_zeroed():
+    """The finding mx.zero was named the remediation for goes quiet on a
+    zero'd trainer — and still fires (naming the now-real zero=auto knob)
+    on the unsharded one (the negative test)."""
+    check.enable("warn")
+    config.set("check_replicated_min_bytes", 64)
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer("adam", {"learning_rate": 0.01})
+    tr0.step(x, y)
+    fired = [f for f in check.findings("degenerate-sharding")
+             if "params" in f["message"]]
+    assert len(fired) == 1
+    assert "zero='auto'" in fired[0]["remediation"]
+    assert "mx.zero" in fired[0]["remediation"]
+    check.reset()
+    config.set("zero", "auto")
+    tr1, _ = _trainer("adam", {"learning_rate": 0.01})
+    assert tr1._zero
+    tr1.step(x, y)
+    assert not any("params" in f["message"]
+                   for f in check.findings("degenerate-sharding"))
+
+
+def test_zero_off_fast_path_no_module_calls():
+    """zero=off (default): trainer construction + steps call NOTHING in
+    the zero module (the ci sanity assert, kept close to the code)."""
+    calls = {"plan": 0, "flat": 0, "spec": 0, "constrain": 0}
+    real = (zero.plan_state, zero.flat_spec, zero.zero_spec, zero.constrain)
+    zero.plan_state = lambda *a, **k: (
+        calls.__setitem__("plan", calls["plan"] + 1), real[0](*a, **k))[1]
+    zero.flat_spec = lambda *a, **k: (
+        calls.__setitem__("flat", calls["flat"] + 1), real[1](*a, **k))[1]
+    zero.zero_spec = lambda *a, **k: (
+        calls.__setitem__("spec", calls["spec"] + 1), real[2](*a, **k))[1]
+    zero.constrain = lambda *a, **k: (
+        calls.__setitem__("constrain", calls["constrain"] + 1),
+        real[3](*a, **k))[1]
+    try:
+        x, y = _xy()
+        tr, _ = _trainer("adam", {"learning_rate": 0.01})
+        for _ in range(3):
+            tr.step(x, y)
+    finally:
+        zero.plan_state, zero.flat_spec, zero.zero_spec, zero.constrain = \
+            real
+    assert calls == {"plan": 0, "flat": 0, "spec": 0, "constrain": 0}, calls
+    assert tr._zero is False and tr._zero_specs is None \
+        and tr._zero_flat is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trips (bit-exact, including RNG + device step counter)
+# ---------------------------------------------------------------------------
+
+def _assert_state_equal(ta, tb):
+    for a, b in zip(_params_np(ta), _params_np(tb)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_opt_np(ta), _opt_np(tb)):
+        np.testing.assert_array_equal(a, b)
+    assert ta.num_update == tb.num_update
+    assert int(ta._t_dev) == int(tb._t_dev)
+
+
+def test_checkpoint_zeroed_save_unsharded_restore(tmp_path):
+    config.set("zero_min_size", 1)
+    config.set("zero", "auto")
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01})
+    assert tr._zero
+    for _ in range(3):
+        tr.step(x, y)
+    saved_key = np.asarray(jax.random.key_data(mx.random.get_state()))
+    tr.save_states(str(tmp_path / "ck"))
+    config.set("zero", "off")
+    zero.disable()
+    tr2, _ = _trainer("adam", {"learning_rate": 0.01}, seed=1)
+    assert not tr2._zero
+    tr2.load_states(str(tmp_path / "ck"))
+    _assert_state_equal(tr, tr2)
+    # the global RNG stream restored to its at-save value
+    np.testing.assert_array_equal(
+        saved_key, np.asarray(jax.random.key_data(mx.random.get_state())))
+    # both continue identically (adam/replicate, dropout-free: bit-exact)
+    la = tr.step(x, y)
+    lb = tr2.step(x, y)
+    assert float(la.asscalar()) == float(lb.asscalar())
+
+
+def test_checkpoint_unsharded_save_zeroed_restore(tmp_path):
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01})
+    for _ in range(3):
+        tr.step(x, y)
+    tr.save_states(str(tmp_path / "ck"))
+    config.set("zero", "auto")
+    tr2, _ = _trainer("adam", {"learning_rate": 0.01}, seed=1)
+    assert tr2._zero
+    tr2.load_states(str(tmp_path / "ck"))
+    _assert_state_equal(tr, tr2)
+    # the restored state is SHARDED on device
+    for st, zs in zip(tr2.opt_state, tr2._zero_specs):
+        for z in st:
+            if zs is not None:
+                assert z.sharding == zs
+
+
+def test_checkpoint_fused_lamb_zero_roundtrip(tmp_path):
+    """Fused-LAMB flat masters: zero'd save -> unsharded restore and back
+    — canonical per-tensor checkpoint layout keeps both directions
+    bit-exact (no arithmetic on either path)."""
+    config.set("zero_min_size", 1)
+    config.set("zero", "auto")
+    x, y = _xy()
+    tr, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                     bias=False)
+    assert tr._fused and tr._zero
+    for _ in range(3):
+        tr.step(x, y)
+    tr.save_states(str(tmp_path / "ck"))
+    config.set("zero", "off")
+    zero.disable()
+    tr2, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                      bias=False, seed=1)
+    assert tr2._fused and not tr2._zero
+    tr2.load_states(str(tmp_path / "ck"))
+    _assert_state_equal(tr, tr2)
+    tr2.save_states(str(tmp_path / "ck2"))
+    config.set("zero", "auto")
+    zero.enable()
+    tr3, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                      bias=False, seed=2)
+    assert tr3._zero
+    tr3.load_states(str(tmp_path / "ck2"))
+    _assert_state_equal(tr, tr3)
+
+
+def test_checkpoint_cross_topology_4_to_2_with_manifest(tmp_path):
+    """Zero'd 4-way save -> zero'd 2-way restore through the verified-
+    manifest reshard path: the manifest records the sharded per-array
+    layouts (and the zero fingerprint), the restore replans, and the
+    state lands bit-exactly in the 2-way shard layout."""
+    config.set("zero_min_size", 1)
+    config.set("zero", "auto")
+    resilience.enable()
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01},
+                     mesh_kw={"dp": 4})
+    assert tr._zero
+    for _ in range(3):
+        tr.step(x, y)
+    ref_p = _params_np(tr)
+    ref_o = _opt_np(tr)
+    n_up = tr.num_update
+    tr.save_states(str(tmp_path / "ck"))
+    manifest = json.load(open(tmp_path / "ck" / "manifest.json"))
+    assert manifest["fingerprint"]["zero"] is True
+    # sharded opt-state layouts are recorded per array
+    specs = {e["name"]: e["spec"] for e in manifest["shardings"]}
+    assert any(name.startswith("opt_state") and spec and
+               any(spec_entry for spec_entry in spec)
+               for name, spec in specs.items())
+
+    tr2, _ = _trainer("adam", {"learning_rate": 0.01},
+                      mesh_kw={"dp": 2}, seed=1)
+    assert tr2._zero and zero.data_extent(tr2.mesh) == 2
+    tr2.load_states(str(tmp_path / "ck"))
+    for a, b in zip(ref_p, _params_np(tr2)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(ref_o, _opt_np(tr2)):
+        np.testing.assert_array_equal(a, b)
+    assert tr2.num_update == n_up and int(tr2._t_dev) == n_up
+    # and the restored buffers are sharded for the NEW mesh
+    for st, zs in zip(tr2.opt_state, tr2._zero_specs):
+        for z in st:
+            if zs is not None:
+                assert z.sharding == zs
+
+
+def test_checkpoint_zero_mismatch_respects_reshard_off(tmp_path):
+    """zero on/off is a reshardable fingerprint difference: with the
+    reshard knob off, the layout mismatch raises MeshMismatchError like
+    any other topology change."""
+    config.set("zero_min_size", 1)
+    config.set("zero", "auto")
+    resilience.enable()
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01})
+    tr.step(x, y)
+    tr.save_states(str(tmp_path / "ck"))
+    config.set("zero", "off")
+    zero.disable()
+    tr2, _ = _trainer("adam", {"learning_rate": 0.01}, seed=1)
+    with pytest.raises(resilience.MeshMismatchError, match="zero"):
+        tr2.load_states(str(tmp_path / "ck"), reshard="off")
+    tr2.load_states(str(tmp_path / "ck"), reshard="auto")
+    _assert_state_equal(tr, tr2)
+
+
+# ---------------------------------------------------------------------------
+# elastic: live resize replans the shard
+# ---------------------------------------------------------------------------
+
+def test_resize_trainer_replans_zero_shard():
+    config.set("zero_min_size", 1)
+    config.set("zero", "auto")
+    x, y = _xy()
+    tr, _ = _trainer("adam", {"learning_rate": 0.01}, mesh_kw={"dp": 4})
+    for _ in range(3):
+        tr.step(x, y)
+    ref_p, ref_o = _params_np(tr), _opt_np(tr)
+    parallel.resize_trainer(tr, dp=2, devices=jax.devices()[:2])
+    assert tr._zero and zero.data_extent(tr.mesh) == 2
+    for st, zs in zip(tr.opt_state, tr._zero_specs):
+        for z in st:
+            if zs is not None:
+                assert z.sharding == zs
+    for a, b in zip(ref_p, _params_np(tr)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(ref_o, _opt_np(tr)):
+        np.testing.assert_array_equal(a, b)
+    # shrinking to a 1-device mesh drops zero entirely (nothing to shard)
+    parallel.resize_trainer(tr, dp=1, devices=jax.devices()[:1])
+    assert not tr._zero and tr._zero_specs is None
+    for a, b in zip(ref_p, _params_np(tr)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# guard composition: the SDC digest vote and sharded state
+# ---------------------------------------------------------------------------
+
+def test_guard_sdc_vote_composes_with_zero(tmp_path):
+    """The SDC digest vote needs bit-identical replicas: a zero'd
+    PER-PARAMETER trainer still qualifies (params stay replicated, only
+    the moments shard — unanimous vote), while a zero'd FUSED trainer's
+    sharded flat master makes per-device digests incomparable, so the
+    vote skips instead of reading shard differences as corruption."""
+    from mxnet_tpu import guard
+    config.set("zero", "auto")
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    try:
+        tr, _ = _trainer("adam", {"learning_rate": 0.01})
+        assert tr._zero and not tr._fused
+        guard.enable(guard_dir=str(tmp_path), rank=0)
+        tr.step(x, y)
+        v = guard.sdc_check(tr, 1)
+        assert v is not None and v["ok"], v
+        trf, _ = _trainer("lamb", {"learning_rate": 0.01, "wd": 0.01},
+                          bias=False)
+        assert trf._zero and trf._fused
+        trf.step(x, y)
+        assert guard.sdc_check(trf, 1) is None    # skipped, not corrupt
+    finally:
+        guard.disable()
+
+
+# ---------------------------------------------------------------------------
+# the memsafe ladder rung
+# ---------------------------------------------------------------------------
+
+def test_memsafe_ladder_inserts_zero_rung(tmp_path):
+    """Under oom_recover=auto, repeated synthetic OOMs walk remat to
+    'full', then enable mx.zero (the new rung), then start halving the
+    batch — with loss parity against the undegraded run, and the zero
+    transition recorded like every other rung."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer("adam", {"learning_rate": 0.01})
+    ref = [float(tr0.step(x, y).asscalar()) for _ in range(3)]
+
+    telemetry.enable()
+    diagnostics.install(diagnostics_dir=str(tmp_path))
+    config.set("oom_recover", "auto")
+    config.set("fault_inject", ",".join(["oom@step:1"] * 5))
+    resilience.enable()
+    tr, net = _trainer("adam", {"learning_rate": 0.01})
+    assert not tr._zero               # knob off: starts unsharded
+    losses = [float(tr.step(x, y).asscalar()) for _ in range(3)]
+    assert np.allclose(ref, losses, rtol=1e-5), (ref, losses)
+    walked = [(t["kind"], t["value"]) for t in memsafe.transitions()]
+    assert walked == [("remat", "dots_saveable"), ("remat", "layers"),
+                      ("remat", "full"), ("zero", True), ("accum", 2)], \
+        walked
+    assert tr._zero is True
+    zt = [t for t in memsafe.transitions() if t["kind"] == "zero"][0]
+    assert zt["zero"] is True
+    # the post-mortem memsafe section tells the same story
+    pm_path = diagnostics.dump(reason="test")
+    with open(pm_path) as f:
+        pm = json.load(f)
+    assert [(t["kind"], t["value"]) for t in pm["memsafe"]["transitions"]] \
+        == walked
+
+
+def test_memsafe_budget_rejection_recovers_via_zero():
+    """A simulated capacity that admits the SHARDED opt state but not the
+    replicated one: the pre-flight check rejects, the ladder lands on the
+    zero rung, and training proceeds with the predicted resident
+    reflecting the sharded footprint."""
+    config.set("zero_min_size", 1)
+    x, y = _xy()
+    tr0, _ = _trainer("adam", {"learning_rate": 0.01})
+    tr0.step(x, y)
+    p_full = tr0.predict_step_bytes([x], [y])
+    config.set("zero", "auto")
+    tr1, _ = _trainer("adam", {"learning_rate": 0.01}, seed=1)
+    tr1.step(x, y)
+    p_zero = tr1.predict_step_bytes([x], [y])
+    assert p_zero["resident_bytes"] < p_full["resident_bytes"]
+    config.reset("zero")
+    zero.disable()
+
+    # capacity between the two predictions: only the zero'd layout fits.
+    # remat rungs barely move a Dense model's prediction, so the ladder
+    # must reach the zero rung to get under the limit
+    limit = (p_full["predicted_bytes"] + p_zero["predicted_bytes"]) // 2
+    assert p_zero["predicted_bytes"] < limit < p_full["predicted_bytes"]
+    config.set("device_bytes_limit", limit)
+    config.set("oom_recover", "auto")
+    tr, _ = _trainer("adam", {"learning_rate": 0.01})
+    for _ in range(3):
+        tr.step(x, y)
+    assert tr._zero is True
+    assert ("zero", True) in [(t["kind"], t["value"])
+                              for t in memsafe.transitions()]
+    assert tr.num_update == 3
+    assert tr.predict_step_bytes([x], [y])["fits"] is True
+
+
+# ---------------------------------------------------------------------------
+# acceptance smoke: 4-way zero'd -> kill -> 2-way elastic resume
+# ---------------------------------------------------------------------------
+
+_ZERO_WORKER = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + \
+        " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, {root!r})
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, resilience, config, memsafe
+from mxnet_tpu.gluon import nn, loss as gloss
+
+rank = int(os.environ.get("JAX_PROCESS_ID", "0"))
+world = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+base, total = sys.argv[1], int(sys.argv[2])
+config.set("zero_min_size", 1)
+config.set("checkpoint_dir", os.path.join(base, "ck", str(rank)))
+config.set("checkpoint_every_n_steps", 1)
+config.set("resume", "auto")
+resilience.install()
+
+dp = 2 * world          # gen 0 (2 workers): 4-way mesh; after the kill
+#                         (1 worker): 2-way — the zero'd state reshards
+parallel.make_mesh(dp=dp, devices=jax.devices()[:dp])
+mx.random.seed(0)
+net = nn.Dense(64, in_units=64)
+net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "adam",
+                             {{"learning_rate": 0.01}})
+print(f"ZERO {{tr._zero}} OPTBYTES "
+      f"{{memsafe.resident_bytes((tr.opt_state,))}} DP {{dp}}", flush=True)
+rs = np.random.RandomState(42)
+batches = [(rs.randn(16, 64).astype(np.float32),
+            rs.randn(16, 64).astype(np.float32)) for _ in range(total)]
+while tr.num_update < total:
+    xb, yb = batches[tr.num_update]
+    loss = tr.step(nd.array(xb), nd.array(yb))
+    print(f"LOSS {{float(loss.asscalar())!r}} STEP {{tr.num_update}} "
+          f"DP {{dp}}", flush=True)
+print(f"rank {{rank}} done at step {{tr.num_update}} (dp={{dp}}, "
+      f"zero={{tr._zero}})", flush=True)
+"""
+
+
+@pytest.mark.slow  # several subprocess jax sessions; ci/run.sh dist runs it
+def test_zero_elastic_kill_shrink_acceptance(tmp_path):
+    """Acceptance (ISSUE 13): 4-way ZERO'D training matches the unsharded
+    reference loss trajectory step for step; every rank is SIGKILLed at
+    step 3 and the elastic supervisor relaunches one worker on a 2-way
+    mesh, which restores the sharded optimizer state bit-exactly (the
+    resumed trajectory continues on the reference) — and the worker logs
+    the measured per-device opt-state bytes at both extents."""
+    import re
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ZERO_WORKER.format(root=ROOT))
+    total = 6
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PROCESS_ID", "MXNET_TPU_FAULT_INJECT",
+                        "MXNET_TPU_ZERO")}
+    # unsharded 4-way reference (zero off, uninterrupted)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    env_ref = dict(env)
+    env_ref["JAX_NUM_PROCESSES"] = "2"
+    r = subprocess.run(
+        [sys.executable, str(worker), str(ref_dir), str(total)],
+        capture_output=True, text=True, timeout=300, env=env_ref)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ZERO False" in r.stdout
+    ref_losses = [float(v) for v in
+                  re.findall(r"LOSS (\S+) STEP", r.stdout)]
+    assert len(ref_losses) == total
+    ref_opt = int(re.findall(r"OPTBYTES (\d+)", r.stdout)[0])
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    env = dict(env)
+    env["MXNET_TPU_ZERO"] = "auto"
+    env["MXNET_TPU_FAULT_INJECT"] = "kill@step:3"
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "2", "--restart-backoff", "0.1", "--elastic",
+         "--min-workers", "1", "--diagnostics-dir", str(run_dir / "diag"),
+         sys.executable, str(worker), str(run_dir), str(total)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    log0 = open(run_dir / "diag" / "0" / "worker.log").read()
+    assert "resumed from" in log0
+    assert "mx.reshard: restore across topologies" in log0
+    got = [(float(v), int(s), int(d)) for v, s, d in
+           re.findall(r"LOSS (\S+) STEP (\d+) DP (\d+)", log0)]
+    dp4 = [s for _, s, d in got if d == 4]
+    dp2 = [s for _, s, d in got if d == 2]
+    assert dp4 and max(dp4) <= 3, got
+    assert dp2 and dp2[-1] == total, got
+    assert min(dp2) > min(dp4), got
+    # zero'd at BOTH extents, with the measured per-device opt-state drop:
+    # 1/4 of the reference bytes on the 4-way mesh, 1/2 on the 2-way
+    zl = re.findall(r"ZERO (\S+) OPTBYTES (\d+) DP (\d+)", log0)
+    assert all(z == "True" for z, _, _ in zl), zl
+    by_dp = {int(d): int(b) for _, b, d in zl}
+    assert by_dp[4] * 4 == ref_opt and by_dp[2] * 2 == ref_opt, \
+        (by_dp, ref_opt)
+    # 4-way zero'd matches the unsharded reference; the 2-way resume
+    # continues it (modulo the reshaped mesh's reduction order)
+    for v, s, _d in got:
+        np.testing.assert_allclose(v, ref_losses[s - 1], rtol=1e-5,
+                                   err_msg=f"step {s}")
+    print(f"# mx.zero acceptance: opt-state/device {ref_opt} -> "
+          f"{by_dp[4]} (4-way) -> {by_dp[2]} (2-way resume)")
