@@ -467,6 +467,61 @@ for d in rows:
     assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
 print('bench_generate provenance OK')
 "
+    # scope must be disabled by default: the trainer hook site makes zero
+    # on_step calls (one module-bool check), no introspection state or
+    # HTTP thread is allocated, and nothing listens on scope_port — the
+    # zero-thread/zero-allocation fast path
+    JAX_PLATFORMS=cpu python -c "
+import socket, threading
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, scope, config
+from mxnet_tpu.gluon import nn, loss as gloss
+assert not scope.enabled(), 'scope must default to off'
+# probe against a port WE pick (free a moment ago): asserting on the
+# global default 8917 would fail spuriously whenever an unrelated
+# process on the host holds it
+probe = socket.socket(); probe.bind(('127.0.0.1', 0))
+free_port = probe.getsockname()[1]; probe.close()
+config.set('scope_port', free_port)
+calls = {'on_step': 0}
+real = scope.on_step
+scope.on_step = lambda *a, **k: (calls.__setitem__('on_step', calls['on_step'] + 1), real(*a, **k))[1]
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+scope.on_step = real
+assert calls == {'on_step': 0}, calls
+assert scope._state is None and scope._server is None, \
+    'scope state allocated while disabled'
+assert not any(t.name == 'mx-scope-server'
+               for t in threading.enumerate()), 'scope thread exists'
+s = socket.socket()
+try:
+    rc = s.connect_ex(('127.0.0.1', free_port))
+finally:
+    s.close()
+assert rc != 0, 'something listens on scope_port while scope is off'
+print('scope disabled fast path OK (no hook calls, no thread, no socket)')
+"
+    # scope acceptance smokes: (a) a 2-rank --scope-port gang serves
+    # /healthz + /metrics on BOTH rank ports while training, the
+    # aggregator /statusz names both ranks at (nearly) the same step,
+    # and ONE aggregator /profilez?steps=2 captures a non-empty device
+    # trace dir on every rank; (b) under an injected hang@step on
+    # rank 1, the healthy rank's /statusz and the aggregator still
+    # answer within their timeouts and the gang view names rank 1 as
+    # stale — a wedged peer never blocks the introspection plane
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_scope.py::test_two_rank_scope_smoke \
+        tests/unittest/test_scope.py::test_hang_statusz_stays_live_names_stale_rank \
+        -q -p no:cacheprovider
     # diagnostics must be disabled by default: no ring-buffer allocation,
     # no recorded entries, and no watchdog thread on the disabled fast path
     JAX_PLATFORMS=cpu python -c "
@@ -503,8 +558,19 @@ static_stage() {
         tests/unittest/test_telemetry.py tests/unittest/test_check.py \
         tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
         tests/unittest/test_trace.py tests/unittest/test_guard.py \
-        tests/unittest/test_serve.py \
+        tests/unittest/test_serve.py tests/unittest/test_scope.py \
         -q -m 'not slow' -p no:cacheprovider
+    # the heavier scope acceptance tests ride here instead of the tier-1
+    # sweep (the PR 5 slow-marking pattern): the bit-identical-loss gate
+    # for /profilez on a live trainer, the blocking-wait capture, the
+    # black-hole fan-out bound, and the scope_top CLI round trips
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_scope.py::test_scope_on_loss_trajectory_bit_identical \
+        tests/unittest/test_scope.py::test_profilez_blocking_wait_returns_200 \
+        tests/unittest/test_scope.py::test_aggregator_not_wedged_by_silent_rank \
+        tests/unittest/test_scope.py::test_scope_top_renders_once \
+        tests/unittest/test_scope.py::test_scope_top_unreachable_aggregator_exits_nonzero \
+        -q -p no:cacheprovider
 }
 
 unittest_stage() {

@@ -58,6 +58,7 @@ _LAZY = {
     "memsafe": ".memsafe",
     "check": ".check",
     "guard": ".guard",
+    "scope": ".scope",
     "serve": ".serve",
     "trace": ".trace",
     "inspect": ".inspect",

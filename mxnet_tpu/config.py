@@ -604,6 +604,28 @@ register_option(
     "model's max_length — either way a stream of novel request lengths "
     "compiles at most one step executable per bucket.")
 register_option(
+    "scope", "off", choices=("off", "on"),
+    doc="mx.scope live introspection. 'off' (default) is the "
+        "zero-overhead fast path: the trainer step hook reduces to one "
+        "module-bool check — no HTTP thread, no listening socket, no "
+        "allocations (asserted by ci/run.sh sanity). 'on' serves the "
+        "per-rank introspection endpoints on scope_port: /healthz "
+        "(liveness + heartbeat age), /metrics (Prometheus text from the "
+        "mx.telemetry registry, torn-read-free), /statusz (step + rate, "
+        "flight-ring tail, memsafe headroom, active remat/zero/grad-"
+        "accum rungs, serve stats, trace skew verdict, restart "
+        "generation), /tracez (recent mx.trace spans), and "
+        "/profilez?steps=N (on-demand XLA device capture around the "
+        "next N trainer steps; concurrent requests get 409). "
+        "tools/launch.py --scope-port arms every rank and serves the "
+        "gang aggregator (tools/scope_top.py renders it live).")
+register_option(
+    "scope_port", 8917,
+    "TCP port the mx.scope per-rank introspection server binds "
+    "(127.0.0.1). 0 picks an ephemeral port (tests read it back via "
+    "mx.scope.port()). Under tools/launch.py --scope-port P, rank R "
+    "serves on P+1+R and the launcher's gang aggregator on P itself.")
+register_option(
     "nan_sentinel", False,
     "Opt-in NaN/Inf sentinel: trainers host-fetch and finiteness-check "
     "the loss (ShardedTrainer/estimator DiagnosticsHandler) or global "

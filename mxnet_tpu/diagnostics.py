@@ -51,7 +51,8 @@ from . import telemetry as _telemetry
 
 __all__ = [
     "enable", "disable", "enabled", "reset", "install", "uninstall",
-    "record_step", "record_event", "annotate_step", "records", "scope",
+    "record_step", "record_event", "annotate_step", "records",
+    "ring_tail", "scope",
     "Watchdog", "arm_watchdog", "disarm_watchdog", "notify_progress",
     "suspend_watchdog",
     "NonFiniteError", "sentinel_check", "grad_global_norm",
@@ -189,6 +190,27 @@ def records(kind=None):
     with _lock:
         evs = list(_ring) if _ring is not None else []
     return [e for e in evs if kind is None or e.get("kind") == kind]
+
+
+def ring_tail(n=8):
+    """The newest `n` flight-ring records, oldest first ([] while the
+    recorder is off) — the bounded slice mx.scope's /statusz serves.
+    Records are COPIED under the lock (and only the requested tail, not
+    the whole ring): annotate_step() mutates the newest live record,
+    and handing a reference to an HTTP thread's json.dumps would race
+    that update (torn record, or RuntimeError mid-iteration)."""
+    n = int(n)
+    if n <= 0:
+        return []
+    out = []
+    with _lock:
+        if _ring is not None:
+            for rec in reversed(_ring):
+                out.append(dict(rec))
+                if len(out) >= n:
+                    break
+    out.reverse()
+    return out
 
 
 # ---------------------------------------------------------------------------

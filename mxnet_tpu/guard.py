@@ -67,7 +67,8 @@ from . import telemetry as _telemetry
 
 __all__ = [
     "enable", "disable", "enabled", "reset", "maybe_enable",
-    "heartbeat", "last_heartbeat", "heartbeat_path", "read_heartbeats",
+    "heartbeat", "last_heartbeat", "heartbeat_age_s", "heartbeat_path",
+    "read_heartbeats",
     "arm_deadline", "disarm_deadline", "suspect_peer",
     "step_begin", "on_step", "sdc_check", "param_digests",
     "snapshot", "EXIT_PEER_LOST", "HEARTBEAT_FILE",
@@ -377,6 +378,18 @@ def last_heartbeat():
     """This process's most recent beat (None before any)."""
     with _lock:
         return dict(_beat) if _beat else None
+
+
+def heartbeat_age_s():
+    """Seconds since this process's last in-memory heartbeat (None
+    before any) — the rank-local spelling of the staleness the
+    supervisor poll computes from the heartbeat FILE, served live by
+    mx.scope's /healthz endpoint."""
+    with _lock:
+        beat = dict(_beat) if _beat else None
+    if not beat:
+        return None
+    return round(max(0.0, _wall() - float(beat.get("ts", 0.0))), 3)
 
 
 def read_heartbeats(base_dir=None):

@@ -29,6 +29,7 @@ from .. import guard as _guard
 from .. import inspect as _inspect
 from .. import memsafe as _memsafe
 from .. import resilience as _resilience
+from .. import scope as _scope
 from .. import telemetry as _telemetry
 from .. import trace as _trace
 from ..gluon.block import functional_call
@@ -117,6 +118,7 @@ class ShardedTrainer:
         _memsafe.maybe_enable()
         _check.maybe_enable()
         _guard.maybe_enable()
+        _scope.maybe_enable()
         # persistent XLA compilation cache (compile_cache_dir knob): wired
         # once, at first trainer construction, before anything compiles
         from .. import dataflow as _dataflow
@@ -773,6 +775,12 @@ class ShardedTrainer:
             # resilience so a just-injected corrupt_grad is caught by
             # the vote this same boundary
             _guard.on_step(self, step_no)
+        if _scope._enabled:
+            # mx.scope live introspection: stamp the completed step for
+            # /healthz + /statusz and drive an armed /profilez device
+            # capture at this boundary, on this thread — the capture
+            # start/stop must never race a dispatching step
+            _scope.on_step(self, step_no)
         return NDArray(loss)
 
     def _trace_record_step(self, step_no, t_build, t_step, t_disp, t_done):

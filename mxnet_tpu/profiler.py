@@ -24,6 +24,7 @@ __all__ = [
     "dump", "dumps", "get_summary", "Domain", "Scope", "scope", "Task",
     "Frame",
     "Event", "Counter", "Marker", "start_jax_trace", "stop_jax_trace",
+    "jax_trace_dir",
 ]
 
 _lock = _locklint.make_lock("profiler.records")
@@ -277,13 +278,46 @@ class Marker:
 
 # --- device-level tracing: delegate to jax.profiler -------------------------
 
+# jax.profiler holds ONE global trace session per process; this module
+# tracks its target dir so callers (mx.scope's on-demand /profilez
+# capture) can refuse a second concurrent start instead of corrupting
+# the live session
+_jax_trace_dir = None
+
+
 def start_jax_trace(logdir):
     """Start an XLA device trace (TensorBoard/Perfetto). The TPU-native
-    replacement for the reference's engine-integrated device timelines."""
+    replacement for the reference's engine-integrated device timelines.
+    Raises RuntimeError when a trace session is already live — the slot
+    is RESERVED under the module lock before the (slow) start call, so
+    two racing callers can never both reach jax's single global
+    session."""
+    global _jax_trace_dir
     import jax
-    jax.profiler.start_trace(logdir)
+    with _lock:
+        if _jax_trace_dir is not None:
+            raise RuntimeError(
+                f"a jax trace is already recording to {_jax_trace_dir!r}")
+        _jax_trace_dir = str(logdir)
+    try:
+        jax.profiler.start_trace(str(logdir))
+    except BaseException:
+        with _lock:
+            _jax_trace_dir = None
+        raise
 
 
 def stop_jax_trace():
+    global _jax_trace_dir
     import jax
-    jax.profiler.stop_trace()
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        with _lock:
+            _jax_trace_dir = None
+
+
+def jax_trace_dir():
+    """Target directory of the live jax trace session (None when no
+    device trace is recording)."""
+    return _jax_trace_dir

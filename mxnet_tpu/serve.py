@@ -75,6 +75,7 @@ import queue as _pyqueue
 import sys
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -89,6 +90,7 @@ from . import trace as _trace
 
 __all__ = [
     "Server", "Request", "enable", "disable", "enabled", "note_dispatch",
+    "servers",
     "QUEUED", "RUNNING", "DONE", "REJECTED", "SHED", "EXPIRED",
     "CANCELLED", "FAILED", "TERMINAL",
 ]
@@ -107,6 +109,15 @@ TERMINAL = frozenset({DONE, REJECTED, SHED, EXPIRED, CANCELLED, FAILED})
 _lock = _locklint.make_lock("serve.module")
 _enabled = False          # the fast-path bool; the decode hook reads it
 _dispatches = 0           # decode dispatches seen at the shared hook site
+# live Server objects (weak: a dropped server must not be pinned by the
+# registry) — mx.scope's /statusz surfaces each one's stats()
+_servers = weakref.WeakSet()
+
+
+def servers():
+    """The live Server objects of this process (construction registers
+    them; garbage collection removes them)."""
+    return list(_servers)
 
 _M_REQUESTS = _telemetry.counter(
     "serve_requests_total", "serving requests by terminal outcome "
@@ -392,6 +403,7 @@ class Server:
         self._wake = threading.Event()
         self._error = None
         self._stopped = False
+        _servers.add(self)
 
     # -- construction helpers -------------------------------------------
     def _parse_buckets(self, buckets):

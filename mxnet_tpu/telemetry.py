@@ -8,7 +8,10 @@ compute-bound, how many bytes moved through collectives. This module is the
 aggregation layer for those: named Counters / Gauges / Histograms with
 labels, plus a structured JSONL event stream (compile/recompile/step
 events), exported as Prometheus text or JSONL and mirrored into the
-chrome-trace profiler as Counter series.
+chrome-trace profiler as Counter series. With mx.scope enabled, the same
+Prometheus renderer backs the live `/metrics` pull endpoint — rendered
+under the registry lock, so an HTTP scrape mid-`Histogram.observe` can
+never see a torn bucket set.
 
 Cost model: DISABLED (the default) is the production fast path — every
 instrumentation site checks one module-level bool and falls through; no
@@ -57,7 +60,12 @@ __all__ = [
     "counter", "gauge", "histogram", "get",
     "event", "events", "signature", "diff_signature",
     "snapshot", "dump_jsonl", "dump_prometheus", "flush",
+    "PROM_CONTENT_TYPE",
 ]
+
+# the Prometheus text exposition content type mx.scope's /metrics
+# endpoint serves dump_prometheus() under
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # RLock: exporters render whole metric trees (children, percentiles) under
 # the lock, and percentile() itself locks — hot-path updates still take it

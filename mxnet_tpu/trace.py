@@ -61,7 +61,7 @@ __all__ = [
     "enable", "disable", "enabled", "reset",
     "sampled", "record_span", "annotate", "skew_tick",
     "flush", "trace_path", "spans", "skews", "snapshot",
-    "skew_p99_ms", "critical_path",
+    "skew_p99_ms", "skew_verdict", "critical_path",
 ]
 
 _lock = _locklint.make_lock("trace.recorder")
@@ -450,10 +450,25 @@ def _safe_flush():
                           "spans stay buffered (warning once)")
 
 
-def spans():
-    """Buffered (not yet flushed) span records, oldest first."""
+def spans(tail=None):
+    """Buffered (not yet flushed) span records, oldest first. `tail`
+    bounds the work to the newest N spans — the scrape path (mx.scope
+    /tracez) must not copy a 100k-record buffer under the same lock the
+    step hot path's record_span takes, just to return 64 of them."""
     with _lock:
-        return [dict(r) for r in (_buf or ()) if r.get("kind") == "span"]
+        if not _buf:
+            return []
+        if tail is None:
+            return [dict(r) for r in _buf if r.get("kind") == "span"]
+        out = []
+        if tail > 0:
+            for r in reversed(_buf):
+                if r.get("kind") == "span":
+                    out.append(dict(r))
+                    if len(out) >= tail:
+                        break
+            out.reverse()
+        return out
 
 
 def skews():
@@ -492,6 +507,27 @@ def skew_p99_ms():
         return None
     idx = min(len(spreads) - 1, int(round(0.99 * (len(spreads) - 1))))
     return round(spreads[idx] * 1e3, 3)
+
+
+def skew_verdict():
+    """Live gang-skew summary for mx.scope's /statusz (the offline
+    report in tools/trace_report.py stays the authoritative verdict —
+    this is what a live scrape can know from THIS rank's probes): the
+    last measured arrival spread, the suspected straggler rank, and the
+    p99 across probes. None before any probe ran."""
+    with _lock:
+        last = dict(_skews[-1]) if _skews else None
+        probes = len(_skews)
+    if last is None:
+        return None
+    return {
+        "probes": probes,
+        "step": last.get("step"),
+        "participants": last.get("participants", 1),
+        "spread_ms": round(last.get("spread_s", 0.0) * 1e3, 3),
+        "straggler_rank": last.get("straggler_rank"),
+        "skew_p99_ms": skew_p99_ms(),
+    }
 
 
 def critical_path():

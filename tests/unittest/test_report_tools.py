@@ -1,0 +1,250 @@
+"""Reporting-tool satellites: the telemetry_report "serve:" section
+(the PR 12 serve_* series are recorded but the CLI never showed them)
+and tools/bench_diff.py (provenance-guarded BENCH_*.json comparison —
+the ROADMAP caveat where CPU smoke-fallback runs silently read as a
+perf collapse vs the TPU run)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import config, telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+TELEMETRY_REPORT = os.path.join(ROOT, "tools", "telemetry_report.py")
+BENCH_DIFF = os.path.join(ROOT, "tools", "bench_diff.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    config.reset()
+
+
+def _run(args):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report "serve:" section
+# ---------------------------------------------------------------------------
+
+def _serve_jsonl(tmp_path):
+    telemetry.enable()
+    c = telemetry.counter("serve_requests_total")
+    c.labels(outcome="done").inc(10)
+    c.labels(outcome="shed").inc(2)
+    c.labels(outcome="expired").inc(1)
+    telemetry.counter("serve_tokens_total").inc(320)
+    h = telemetry.histogram("serve_ttft_seconds")
+    for v in (0.010, 0.020, 0.050):
+        h.observe(v)
+    telemetry.histogram("serve_queue_wait_seconds").observe(0.004)
+    telemetry.counter("serve_deadline_missed_total").inc(1)
+    telemetry.counter("serve_degraded_total").inc(3)
+    telemetry.event("step", dur_s=0.01)
+    path = tmp_path / "serve_run.jsonl"
+    telemetry.dump_jsonl(str(path))
+    return str(path)
+
+
+def test_report_renders_serve_section(tmp_path):
+    path = _serve_jsonl(tmp_path)
+    r = _run([TELEMETRY_REPORT, path])
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "serve:" in out
+    assert "requests:   13" in out
+    assert "done 10" in out and "shed 2" in out and "expired 1" in out
+    assert "tokens:     320" in out
+    assert "ttft:       p50 20.0 ms  p99 50.0 ms" in out
+    assert "queue wait: p50 4.0 ms" in out
+    assert "shed 2, rejected 0, deadline-missed 1, degradations 3" in out
+
+
+def test_report_omits_serve_section_when_never_served(tmp_path):
+    telemetry.enable()
+    telemetry.event("step", dur_s=0.01)
+    path = tmp_path / "train_run.jsonl"
+    telemetry.dump_jsonl(str(path))
+    r = _run([TELEMETRY_REPORT, str(path)])
+    assert r.returncode == 0, r.stderr
+    assert "serve:" not in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_diff.py
+# ---------------------------------------------------------------------------
+
+def _row(**kw):
+    base = {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "value": 100000.0, "unit": "tokens/s/chip",
+            "platform": "tpu", "devices": 4, "smoke_mode": False}
+    base.update(kw)
+    return base
+
+
+def _write_rows(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def _write_driver_artifact(path, rows, **extra):
+    doc = {"n": 1, "rc": 0,
+           "tail": "# noise line\n" + "".join(
+               json.dumps(r) + "\n" for r in rows)}
+    doc.update(extra)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def test_diff_refuses_mismatched_provenance(tmp_path):
+    a = _write_rows(tmp_path / "a.jsonl", [_row()])
+    b = _write_rows(tmp_path / "b.jsonl",
+                    [_row(value=20000.0, platform="cpu", smoke_mode=True)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "REFUSED" in r.stdout
+    # the 5x "collapse" must never be printed as a comparison
+    assert "REGRESSION" not in r.stdout
+
+
+def test_diff_refuses_known_vs_unknown(tmp_path):
+    legacy = {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+              "value": 130000.0, "unit": "tokens/s/chip"}
+    a = _write_rows(tmp_path / "a.jsonl", [legacy])
+    b = _write_rows(tmp_path / "b.jsonl", [_row()])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 2
+    assert "REFUSED" in r.stdout
+
+
+def test_diff_classifies_legacy_smoke_rows_from_error(tmp_path):
+    """Pre-PR-11 CPU fallback rows carry only the error annotation; the
+    diff must classify them as cpu/smoke and compare them with each
+    other."""
+    legacy = {"metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+              "value": 19449.79,
+              "error": "tpu backend unavailable; CPU smoke-mode number"}
+    legacy2 = dict(legacy, value=21397.35)
+    a = _write_rows(tmp_path / "a.jsonl", [legacy])
+    b = _write_rows(tmp_path / "b.jsonl", [legacy2])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "platform=cpu smoke_mode=True" in r.stdout
+    assert "no regressions" in r.stdout
+
+
+def test_diff_flags_regressions_by_direction(tmp_path):
+    a = _write_rows(tmp_path / "a.jsonl",
+                    [_row(step_p99_ms=10.0, recompile_count=0, mfu=0.3)])
+    b = _write_rows(tmp_path / "b.jsonl",
+                    [_row(value=90000.0,        # -10% throughput: worse
+                          step_p99_ms=12.0,     # +20% latency: worse
+                          recompile_count=3,    # 0 -> 3: worse
+                          mfu=0.31)])           # +3%: inside threshold
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 1, r.stdout + r.stderr
+    out = r.stdout
+    assert out.count("[REGRESSION]") == 3, out
+    assert "3 regression(s)" in out
+    assert "mfu: 0.3 -> 0.31" in out and "[ok]" in out
+
+
+def test_diff_improvement_and_threshold(tmp_path):
+    a = _write_rows(tmp_path / "a.jsonl", [_row(value=100000.0)])
+    b = _write_rows(tmp_path / "b.jsonl", [_row(value=110000.0)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 0
+    assert "[improved]" in r.stdout
+    # a tighter threshold turns a -4% drift into a regression
+    b2 = _write_rows(tmp_path / "b2.jsonl", [_row(value=96000.0)])
+    assert _run([BENCH_DIFF, a, b2]).returncode == 0
+    r = _run([BENCH_DIFF, "--threshold", "0.03", a, b2])
+    assert r.returncode == 1
+
+
+def test_diff_reads_driver_artifacts(tmp_path):
+    """The repo's BENCH_*.json shape: rows embedded in the recorded
+    stdout tail (with non-JSON noise lines), `parsed` as fallback."""
+    a = _write_driver_artifact(tmp_path / "BENCH_a.json",
+                               [_row(value=100000.0)])
+    b = _write_driver_artifact(tmp_path / "BENCH_b.json",
+                               [_row(value=99000.0)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 pair(s) compared" in r.stdout
+    # tail with no JSON rows falls back to the parsed row
+    c_path = tmp_path / "BENCH_c.json"
+    with open(c_path, "w") as f:
+        json.dump({"n": 3, "tail": "# only noise\n",
+                   "parsed": _row(value=98000.0)}, f)
+    r = _run([BENCH_DIFF, a, str(c_path)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 pair(s) compared" in r.stdout
+
+
+def test_diff_partial_provenance_refused(tmp_path):
+    """platform recorded but smoke_mode missing is still unknown
+    provenance: a smoke-vs-real pair sharing a platform string must not
+    silently diff to a false collapse."""
+    partial = {"metric": "m1", "platform": "cpu", "tokens_per_sec": 100.0}
+    a = _write_rows(tmp_path / "a.jsonl", [partial])
+    b = _write_rows(tmp_path / "b.jsonl",
+                    [dict(partial, tokens_per_sec=20.0)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "REFUSED" in r.stdout and "incomplete" in r.stdout
+    assert "REGRESSION" not in r.stdout
+    r = _run([BENCH_DIFF, "--allow-unknown", a, b])
+    assert r.returncode == 1    # compared loudly, regression flagged
+
+
+def test_diff_unknown_vs_unknown_needs_allow_flag(tmp_path):
+    legacy = {"metric": "m", "value": 10.0}
+    a = _write_rows(tmp_path / "a.jsonl", [legacy])
+    b = _write_rows(tmp_path / "b.jsonl", [dict(legacy, value=11.0)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 2
+    assert "allow-unknown" in r.stdout
+    r = _run([BENCH_DIFF, "--allow-unknown", a, b])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "comparing" in r.stdout
+
+
+def test_diff_reports_surplus_unnamed_and_duplicate_rows(tmp_path):
+    """Every row lands in a pair or the unpaired report: a baseline with
+    3 metric-less rows against a candidate with 1 (a crashed benchmark)
+    must name the two orphans, and a duplicate metric name must not
+    vanish."""
+    unnamed = {"value": 5.0, "platform": "cpu", "smoke_mode": True}
+    a = _write_rows(tmp_path / "a.jsonl",
+                    [unnamed, dict(unnamed, value=6.0),
+                     dict(unnamed, value=7.0), _row(), _row(value=1.0)])
+    b = _write_rows(tmp_path / "b.jsonl", [unnamed, _row()])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "row[1]: only in" in r.stdout
+    assert "row[2]: only in" in r.stdout
+    # the duplicate-metric baseline row is reported, not dropped
+    assert r.stdout.count("only in") == 3, r.stdout
+
+
+def test_diff_reports_unpaired_rows(tmp_path):
+    a = _write_rows(tmp_path / "a.jsonl",
+                    [_row(), _row(metric="only_in_a", value=1.0)])
+    b = _write_rows(tmp_path / "b.jsonl",
+                    [_row(), _row(metric="only_in_b", value=2.0)])
+    r = _run([BENCH_DIFF, a, b])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "only_in_a: only in" in r.stdout
+    assert "only_in_b: only in" in r.stdout

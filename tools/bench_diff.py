@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Compare two bench runs with provenance guards.
+
+    python tools/bench_diff.py BENCH_r02.json BENCH_r05.json
+    python tools/bench_diff.py old_rows.jsonl new_rows.jsonl
+    python tools/bench_diff.py --threshold 0.03 a.json b.json
+
+Accepts either the driver's `BENCH_*.json` artifacts (the JSON rows are
+parsed out of the recorded stdout `tail`, falling back to the `parsed`
+row) or raw `bench.py` / `benchmarks/*.py` output (one JSON row per
+line). Rows pair up by their `metric` name (rows without one pair by
+position).
+
+Provenance guard — the reason this tool exists: BENCH runs 3–5 were CPU
+smoke-mode fallbacks after the environment lost its TPU, and diffing
+them against the TPU run 2 read as a 6x perf collapse that never
+happened. A row pair whose `platform` or `smoke_mode` differ is REFUSED
+(exit 2), never silently diffed; rows predating the provenance fields
+(pre-PR-11) are classified from their recorded "CPU smoke-mode" error
+annotation where possible and refused as unknown-vs-known otherwise
+(`--allow-unknown` compares unknown-vs-unknown pairs anyway, loudly).
+
+Comparable pairs diff every shared numeric field with a known direction
+(higher-better: value, tokens_per_sec, mfu, ...; lower-better:
+step_p99_ms, ttft_p99_ms, recompile_count, ...) and flag any move
+beyond --threshold (default 5%) against the field's direction as a
+REGRESSION. Exit codes: 0 clean, 1 regressions found, 2 nothing
+comparable (provenance refusals / no pairable rows).
+
+Reads only the stdlib (no jax import).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: numeric fields where a bigger number is a better run
+HIGHER_BETTER = (
+    "value", "tokens_per_sec", "requests_per_sec", "mfu",
+    "achieved_tflops", "vs_baseline", "compile_cache_hit",
+    "memory_headroom_bytes", "completed",
+)
+#: numeric fields where a bigger number is a worse run
+LOWER_BETTER = (
+    "step_p99_ms", "compile_time_s", "recompile_count",
+    "input_stall_fraction", "peak_host_rss_mb", "ttft_p50_ms",
+    "ttft_p99_ms", "step_skew_p99_ms", "deadline_missed", "shed",
+    "rejected", "oom_recoveries", "check_findings", "requeues",
+    "degraded",
+)
+#: provenance fields that must MATCH for two rows to be comparable
+PROVENANCE = ("platform", "smoke_mode")
+
+
+def _rows_from_text(text):
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def load_rows(path):
+    """Bench rows from `path`: a driver BENCH_*.json (rows embedded in
+    its stdout `tail`, `parsed` as fallback), a JSON object (one row),
+    or JSONL (one row per line)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
+        rows = _rows_from_text(doc.get("tail", ""))
+        if not rows and isinstance(doc.get("parsed"), dict):
+            rows = [doc["parsed"]]
+        return rows
+    if isinstance(doc, dict):
+        return [doc]
+    if isinstance(doc, list):
+        return [r for r in doc if isinstance(r, dict)]
+    return _rows_from_text(text)
+
+
+def provenance(row):
+    """(platform, smoke_mode) with None for unknown. Rows predating the
+    explicit fields (pre-PR-11) are classified from the recorded
+    "CPU smoke-mode" error annotation when it is present."""
+    platform = row.get("platform")
+    smoke = row.get("smoke_mode")
+    if platform is None and smoke is None:
+        err = str(row.get("error", ""))
+        if "CPU smoke-mode" in err or "cpu smoke" in err.lower():
+            return "cpu", True
+    return platform, smoke
+
+
+def pair_rows(a_rows, b_rows):
+    """[(key, row_a, row_b)]: rows pair by `metric` name; rows without
+    one pair by position among the unnamed. EVERY row lands either in a
+    pair or in an unpaired list (duplicate metric names and surplus
+    unnamed rows included) — the caller reports unpaired rows, never
+    silently drops them."""
+    pairs, used_b, unpaired_a = [], set(), []
+    b_by_metric = {}
+    for i, r in enumerate(b_rows):
+        m = r.get("metric")
+        if m is not None and m not in b_by_metric:
+            b_by_metric[m] = i
+    b_unnamed = [i for i, r in enumerate(b_rows) if r.get("metric") is None]
+    a_unnamed = 0
+    for r in a_rows:
+        m = r.get("metric")
+        if m is not None:
+            j = b_by_metric.get(m)
+            if j is not None and j not in used_b:
+                used_b.add(j)
+                pairs.append((m, r, b_rows[j]))
+            else:
+                # no counterpart, or a duplicate metric name whose
+                # counterpart is already taken
+                unpaired_a.append(m)
+            continue
+        if a_unnamed < len(b_unnamed):
+            j = b_unnamed[a_unnamed]
+            used_b.add(j)
+            pairs.append((f"row[{a_unnamed}]", r, b_rows[j]))
+        else:
+            unpaired_a.append(f"row[{a_unnamed}]")
+        a_unnamed += 1
+    unpaired_b = [r.get("metric") or f"row[{i}]"
+                  for i, r in enumerate(b_rows) if i not in used_b]
+    return pairs, unpaired_a, unpaired_b
+
+
+def diff_pair(key, a, b, threshold):
+    """One paired comparison. Returns (lines, regressions, refused)."""
+    pa, pb = provenance(a), provenance(b)
+    if pa != pb:
+        why = "unknown provenance" if None in pa or None in pb else \
+            f"platform/smoke_mode {pa[0]}/{pa[1]} vs {pb[0]}/{pb[1]}"
+        return ([f"{key}: REFUSED — {why} (a CPU smoke-mode fallback "
+                 "must never read as a perf collapse vs a TPU run)"],
+                [], True)
+    lines = [f"{key}: platform={pa[0]} smoke_mode={pa[1]}"
+             if None not in pa else
+             f"{key}: provenance unknown on BOTH sides — comparing "
+             "anyway (--allow-unknown)"]
+    regressions = []
+    for field in HIGHER_BETTER + LOWER_BETTER:
+        va, vb = a.get(field), b.get(field)
+        if not isinstance(va, (int, float)) \
+                or not isinstance(vb, (int, float)) \
+                or isinstance(va, bool) or isinstance(vb, bool):
+            continue
+        lower_better = field in LOWER_BETTER
+        if va == 0:
+            # no percentage base: a lower-better count appearing from
+            # zero (0 -> 3 recompiles) is still a regression
+            if vb != 0:
+                tag = "REGRESSION" if (lower_better and vb > 0) else "ok"
+                lines.append(f"  {field}: {va} -> {vb}  [{tag}]")
+                if tag == "REGRESSION":
+                    regressions.append((key, field, va, vb))
+            continue
+        delta = (vb - va) / abs(va)
+        worse = -delta if not lower_better else delta
+        tag = "REGRESSION" if worse > threshold else (
+            "improved" if worse < -threshold else "ok")
+        lines.append(f"  {field}: {va:g} -> {vb:g}  "
+                     f"({delta:+.1%})  [{tag}]")
+        if tag == "REGRESSION":
+            regressions.append((key, field, va, vb))
+    if len(lines) == 1:
+        lines.append("  (no shared numeric fields with a known direction)")
+    return lines, regressions, False
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("old", help="baseline run (BENCH_*.json or JSONL)")
+    p.add_argument("new", help="candidate run")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="relative move against a field's direction "
+                        "flagged as a regression (default 0.05 = 5%%)")
+    p.add_argument("--allow-unknown", action="store_true",
+                   help="compare row pairs whose provenance is unknown "
+                        "on BOTH sides (still refuses known-vs-unknown "
+                        "and mismatched pairs)")
+    args = p.parse_args(argv)
+
+    a_rows, b_rows = load_rows(args.old), load_rows(args.new)
+    if not a_rows or not b_rows:
+        print(f"bench_diff: no JSON rows found in "
+              f"{args.old if not a_rows else args.new}", file=sys.stderr)
+        return 2
+    pairs, unpaired_a, unpaired_b = pair_rows(a_rows, b_rows)
+    if not pairs:
+        print("bench_diff: no pairable rows (metric names disjoint)",
+              file=sys.stderr)
+        return 2
+
+    print(f"bench diff: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:.0%})")
+    regressions, refused, compared = [], 0, 0
+    for key, a, b in pairs:
+        pa, pb = provenance(a), provenance(b)
+        # BOTH fields must be known on both sides: a row that records
+        # its platform but not smoke_mode can still be the smoke-vs-real
+        # false collapse this tool exists to refuse
+        if pa == pb and None in pa and not args.allow_unknown:
+            print(f"{key}: REFUSED — provenance incomplete on both "
+                  f"sides (platform={pa[0]}, smoke_mode={pa[1]}; rerun "
+                  "with --allow-unknown to compare anyway)")
+            refused += 1
+            continue
+        lines, regs, was_refused = diff_pair(key, a, b, args.threshold)
+        print("\n".join(lines))
+        if was_refused:
+            refused += 1
+        else:
+            compared += 1
+            regressions.extend(regs)
+    for m in unpaired_a:
+        print(f"{m}: only in {args.old} (not diffed)")
+    for m in unpaired_b:
+        print(f"{m}: only in {args.new} (not diffed)")
+
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) "
+              f">{args.threshold:.0%}:")
+        for key, field, va, vb in regressions:
+            print(f"  {key}.{field}: {va:g} -> {vb:g}")
+        return 1
+    if compared == 0:
+        print(f"\nnothing comparable ({refused} pair(s) refused on "
+              "provenance)")
+        return 2
+    print(f"\nno regressions beyond {args.threshold:.0%} "
+          f"({compared} pair(s) compared, {refused} refused)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -39,6 +39,15 @@ XLA collectives replace the parameter server. So this launcher:
     toward `-n`; workers resuming with mx.resilience reshard='auto'
     redistribute the checkpoint onto the new topology
     (`tools/postmortem_report.py` renders the reshape history),
+  * with `--scope-port P` arms mx.scope live introspection in every
+    worker — rank R serves /healthz /metrics /statusz /tracez /profilez
+    on port P+1+R — and runs a gang AGGREGATOR on the base port P that
+    fans out to the per-rank endpoints with short timeouts (a wedged
+    rank can never wedge the aggregator), merges `/statusz` into one
+    gang view naming stale/unreachable ranks, and proxies
+    `/profilez?steps=N` to every rank at once for a gang-wide device
+    capture (`tools/scope_top.py` polls it and renders a live one-screen
+    summary),
   * with `--heartbeat-timeout S` arms mx.guard liveness in every worker
     and polls the per-rank heartbeat files: a rank whose beat goes stale
     (stuck host, wedged collective — alive but making no progress) is
@@ -66,6 +75,10 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 # the launcher must stay import-light (no jax, no mxnet_tpu package
 # import), but its locks ride the same mx.check tsan-lite analysis as the
@@ -118,7 +131,7 @@ ELASTIC_SETTLE_S = 3.0
 
 def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
               restart_count=0, trace_dir=None, trace_epoch_ns=None,
-              heartbeat_timeout=None):
+              heartbeat_timeout=None, scope_port=0):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -159,6 +172,12 @@ def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
         # supervisor's staleness poll ages against this same timeout
         env["MXNET_TPU_GUARD"] = "1"
         env["MXNET_TPU_HEARTBEAT_TIMEOUT_S"] = str(heartbeat_timeout)
+    if scope_port:
+        # arm mx.scope in every worker: rank R serves its introspection
+        # endpoints on base+1+R (the base port is the launcher-side gang
+        # aggregator's)
+        env["MXNET_TPU_SCOPE"] = "on"
+        env["MXNET_TPU_SCOPE_PORT"] = str(int(scope_port) + 1 + rank)
     return env
 
 
@@ -392,6 +411,326 @@ class _HeartbeatMonitor:
         self._thread.join(timeout=5.0)
 
 
+# per-rank fetch budget for the aggregator's /healthz and /statusz
+# fan-out: short and hard — a wedged rank costs one timeout, never the
+# aggregator's liveness (/profilez uses its own wait_s + margin instead,
+# a capture legitimately spans several steps)
+SCOPE_FANOUT_TIMEOUT_S = 2.0
+# a rank whose last completed step is older than this reads as STALE in
+# the merged gang view (override per request with ?stale_after=S)
+SCOPE_STALE_AFTER_S = 5.0
+
+
+class _ScopeAggregator:
+    """Gang introspection aggregator (--scope-port): one HTTP server on
+    the base port that fans out to the per-rank mx.scope servers
+    (base+1+rank) and merges the answers.
+
+      /healthz   — per-rank liveness, unreachable/failing ranks named
+      /statusz   — the merged gang view: per-rank step/rate/headroom,
+                   stale ranks named by last-step / heartbeat age
+                   (default threshold scales with the gang's step
+                   cadence; an explicit ?stale_after=S is used exactly)
+      /metrics   — gang-level Prometheus gauges derived from the fan-out
+                   (per-rank step/age/reachability; scrape the per-rank
+                   ports directly for the full telemetry registries —
+                   identical metric names from N ranks cannot legally
+                   merge into one exposition page)
+      /profilez  — proxied to EVERY rank at once (query passed through):
+                   one request arms a gang-wide device capture
+
+    Every fan-out runs one thread per rank with a hard per-rank timeout,
+    so a wedged or dead rank degrades to an 'unreachable' entry — it can
+    never wedge the aggregator (the acceptance gate under an injected
+    hang). Stdlib-only, jax-free, like the rest of this launcher."""
+
+    def __init__(self, base_port, world, generation, host="127.0.0.1"):
+        self.host = host
+        self.base_port = int(base_port)
+        self.world = int(world)
+        self.generation = int(generation)
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, self.base_port), handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="launch-scope-aggregator", daemon=True)
+        self._thread.start()
+        print(f"launch: mx.scope gang aggregator on http://{host}:"
+              f"{self.base_port} (ranks on "
+              f"{self.base_port + 1}..{self.base_port + self.world})",
+              file=sys.stderr)
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    # -- fan-out ---------------------------------------------------------
+    def rank_url(self, rank, path):
+        return f"http://{self.host}:{self.base_port + 1 + rank}{path}"
+
+    def _fetch(self, rank, path, timeout):
+        try:
+            with urllib.request.urlopen(self.rank_url(rank, path),
+                                        timeout=timeout) as r:
+                return json.load(r), None
+        except urllib.error.HTTPError as e:
+            # the rank ANSWERED: a 409 (capture busy) or 500 is a
+            # verdict with a JSON body, not a dead peer — pass it
+            # through annotated instead of smearing it into
+            # 'unreachable' (the operator must see 'busy', not 'dead')
+            try:
+                body = json.load(e)
+            except Exception:
+                body = None
+            if isinstance(body, dict):
+                body.setdefault("http_status", e.code)
+                return body, None
+            return None, f"HTTP {e.code}"
+        except Exception as e:  # noqa: BLE001 - any failure = unreachable
+            return None, f"{type(e).__name__}: {e}"
+
+    def fan_out(self, path, timeout=SCOPE_FANOUT_TIMEOUT_S):
+        """{rank: (payload|None, error|None)} — one thread per rank, each
+        joined against the shared deadline; a thread still running past
+        it is reported as a timeout and LEFT BEHIND (daemon), so the
+        slowest rank bounds the response time, never blocks it."""
+        results = {}
+        threads = []
+        for rank in range(self.world):
+            t = threading.Thread(
+                target=lambda r=rank: results.__setitem__(
+                    r, self._fetch(r, path, timeout)),
+                daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + timeout + 1.0
+        for t in threads:
+            t.join(timeout=max(0.05, deadline - time.monotonic()))
+        return {r: results.get(r, (None, f"timeout after {timeout}s"))
+                for r in range(self.world)}
+
+    # -- merged views ----------------------------------------------------
+    def merged_healthz(self):
+        out = {"ok": True, "world_size": self.world,
+               "generation": self.generation, "ts": time.time(),
+               "aggregator": True, "ranks": {}, "unreachable_ranks": [],
+               "failing_ranks": []}
+        for rank, (payload, err) in sorted(self.fan_out("/healthz").items()):
+            if payload is None:
+                out["ranks"][str(rank)] = {"error": err}
+                out["unreachable_ranks"].append(rank)
+                out["ok"] = False
+            elif payload.get("http_status", 0) >= 400:
+                # the rank answered, but with an ERROR verdict (older
+                # build without the endpoint, persistent 500): reachable
+                # yet broken — it must still fail the gang health
+                out["ranks"][str(rank)] = payload
+                out["failing_ranks"].append(rank)
+                out["ok"] = False
+            else:
+                out["ranks"][str(rank)] = payload
+        return out
+
+    def merged_statusz(self, stale_after=None):
+        """The merged gang view. `stale_after=None` (the default) uses
+        the SCOPE_STALE_AFTER_S floor scaled by the gang's fastest
+        reported step rate — a healthy 6 s/step gang must not read
+        all-STALE between boundaries, while ~5 step intervals of
+        silence is suspicious at any cadence (a gang-wide wedge freezes
+        each rank's rate window at its healthy positive value, so the
+        scaled threshold stays honest there too). An EXPLICIT value
+        (?stale_after=S) is used exactly as given — an operator's
+        threshold is never silently out-scaled."""
+        explicit = stale_after is not None
+        floor = float(stale_after) if explicit else SCOPE_STALE_AFTER_S
+        out = {"world_size": self.world, "generation": self.generation,
+               "ts": time.time(), "aggregator": True,
+               "stale_after_s": floor, "ranks": {}, "stale_ranks": [],
+               "unreachable_ranks": [], "failing_ranks": []}
+        fetched = sorted(self.fan_out("/statusz").items())
+        effective = floor
+        if not explicit:
+            rates = [p["steps_per_s"] for _r, (p, _e) in fetched
+                     if p and isinstance(p.get("steps_per_s"),
+                                         (int, float))
+                     and p["steps_per_s"] > 0]
+            if rates:
+                effective = max(floor, 5.0 / max(rates))
+        out["stale_after_effective_s"] = round(effective, 3)
+        steps = []
+        for rank, (payload, err) in fetched:
+            if payload is None:
+                out["ranks"][str(rank)] = {"error": err}
+                out["unreachable_ranks"].append(rank)
+                continue
+            out["ranks"][str(rank)] = payload
+            if payload.get("http_status", 0) >= 400:
+                # answered with an error verdict: reachable but broken
+                out["failing_ranks"].append(rank)
+                continue
+            if payload.get("step") is not None:
+                steps.append(int(payload["step"]))
+            # a rank that answers but stopped completing steps (wedged
+            # collective, dead input) is STALE: the hung main thread
+            # cannot advance `step`, while the scope server thread —
+            # like the hung rank's heartbeat file — keeps answering
+            ages = [a for a in (payload.get("last_step_age_s"),
+                                payload.get("heartbeat_age_s"))
+                    if isinstance(a, (int, float))]
+            if ages and max(ages) > effective:
+                out["stale_ranks"].append(rank)
+        if steps:
+            out["max_step"] = max(steps)
+            out["min_step"] = min(steps)
+            out["step_spread"] = max(steps) - min(steps)
+        return out
+
+    def merged_metrics(self):
+        """Gang-level exposition the base port can serve without merging
+        N identical per-rank registries: reachability, last step, and
+        ages, one labeled sample per rank."""
+        status = self.merged_statusz()
+        lines = [
+            "# HELP scope_rank_reachable per-rank mx.scope endpoint "
+            "answered the aggregator fan-out",
+            "# TYPE scope_rank_reachable gauge",
+        ]
+        for rank in range(self.world):
+            reachable = rank not in status["unreachable_ranks"]
+            lines.append(f'scope_rank_reachable{{rank="{rank}"}} '
+                         f"{int(reachable)}")
+        lines += ["# TYPE scope_rank_step gauge",
+                  "# TYPE scope_rank_step_age_seconds gauge"]
+        for rank in range(self.world):
+            p = status["ranks"].get(str(rank)) or {}
+            if isinstance(p.get("step"), int):
+                lines.append(f'scope_rank_step{{rank="{rank}"}} '
+                             f"{p['step']}")
+            if isinstance(p.get("last_step_age_s"), (int, float)):
+                lines.append(
+                    f'scope_rank_step_age_seconds{{rank="{rank}"}} '
+                    f"{p['last_step_age_s']}")
+        lines.append(f"scope_gang_stale_ranks {len(status['stale_ranks'])}")
+        lines.append("scope_gang_unreachable_ranks "
+                     f"{len(status['unreachable_ranks'])}")
+        lines.append("scope_gang_failing_ranks "
+                     f"{len(status['failing_ranks'])}")
+        return "\n".join(lines) + "\n"
+
+    def proxy_profilez(self, query):
+        """Arm a device capture on EVERY rank at once. The per-rank wait
+        budget follows the request's wait_s (a capture legitimately
+        spans steps) plus a margin; each rank still answers 202
+        immediately when wait_s=0."""
+        q = parse_qs(query)
+        try:
+            wait_s = float(q.get("wait_s", ["60"])[0])
+            if "steps" in q:
+                int(q["steps"][0])
+        except ValueError:
+            # fail the whole request up front: fanning a malformed query
+            # out would collect N per-rank 400s under an aggregator 200,
+            # and a script gating on status would believe a gang capture
+            # started (the handler maps this to HTTP 400)
+            raise ValueError(
+                "malformed profilez query: steps/wait_s must be numeric")
+        path = "/profilez" + (f"?{query}" if query else "")
+        results = self.fan_out(path, timeout=max(wait_s, 1.0) + 5.0)
+        out = {"world_size": self.world, "aggregator": True,
+               "ranks": {}, "unreachable_ranks": []}
+        for rank, (payload, err) in sorted(results.items()):
+            if payload is None:
+                out["ranks"][str(rank)] = {"error": err}
+                out["unreachable_ranks"].append(rank)
+            else:
+                out["ranks"][str(rank)] = payload
+        return out
+
+    # -- http ------------------------------------------------------------
+    def _make_handler(self):
+        agg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, payload,
+                      content_type="application/json"):
+                body = payload if isinstance(payload, bytes) else \
+                    json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = urlsplit(self.path)
+                route = parts.path.rstrip("/") or "/"
+                q = parse_qs(parts.query)
+                try:
+                    if route == "/healthz":
+                        self._send(200, agg.merged_healthz())
+                    elif route == "/statusz":
+                        stale = q.get("stale_after")
+                        self._send(200, agg.merged_statusz(
+                            float(stale[0]) if stale else None))
+                    elif route == "/metrics":
+                        self._send(200, agg.merged_metrics().encode(),
+                                   content_type="text/plain; "
+                                   "version=0.0.4; charset=utf-8")
+                    elif route == "/profilez":
+                        self._send(200, agg.proxy_profilez(parts.query))
+                    elif route == "/":
+                        self._send(200, {
+                            "aggregator": True,
+                            "world_size": agg.world,
+                            "rank_ports": {
+                                r: agg.base_port + 1 + r
+                                for r in range(agg.world)},
+                            "endpoints": ["/healthz", "/statusz",
+                                          "/metrics",
+                                          "/profilez?steps=N"]})
+                    else:
+                        self._send(404, {
+                            "error": f"no such endpoint {route!r}"})
+                except BrokenPipeError:
+                    pass
+                except ValueError as e:
+                    # malformed query values (stale_after=abc): client
+                    # error, not an aggregator fault
+                    try:
+                        self._send(400, {"error": str(e)})
+                    except OSError:
+                        pass
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._send(500, {
+                            "error": f"{type(e).__name__}: {e}"})
+                    except OSError:
+                        pass
+
+        return Handler
+
+
+def _start_scope_aggregator(scope_port, world, generation):
+    """Best-effort aggregator construction: introspection must never
+    kill the gang it observes (a taken base port degrades to per-rank
+    scraping with a warning)."""
+    if not scope_port:
+        return None
+    try:
+        return _ScopeAggregator(scope_port, world, generation)
+    except OSError as e:
+        print(f"launch: cannot start the mx.scope aggregator on port "
+              f"{scope_port}: {e} — per-rank endpoints "
+              f"({scope_port + 1}..{scope_port + world}) still serve",
+              file=sys.stderr)
+        return None
+
+
 def _plan_world(world, codes, elastic, min_workers, max_world):
     """Decide the next generation's world size from one failed
     generation's exit-code snapshot (taken BEFORE teardown, so a rank's
@@ -427,7 +766,8 @@ def _plan_world(world, codes, elastic, min_workers, max_world):
 
 def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
                  max_restarts=0, restart_backoff=3.0, elastic=False,
-                 min_workers=1, trace_dir=None, heartbeat_timeout=0.0):
+                 min_workers=1, trace_dir=None, heartbeat_timeout=0.0,
+                 scope_port=0):
     """Run the gang; with --max-restarts, supervise it: when any rank
     dies (crash, SIGKILL rank death, or a preemption save), tear down the
     peer ranks, back off exponentially (with jitter), and relaunch the
@@ -460,11 +800,16 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             env = build_env(rank, world, coordinator, diagnostics_dir,
                             restart_count=attempt, trace_dir=trace_dir,
                             trace_epoch_ns=trace_epoch_ns,
-                            heartbeat_timeout=heartbeat_timeout)
+                            heartbeat_timeout=heartbeat_timeout,
+                            scope_port=scope_port)
             proc, pump = _spawn(command, env, rank, diagnostics_dir,
                                 restart_count=attempt)
             procs.append(proc)
             pumps.append(pump)
+        # gang introspection aggregator for THIS generation (the world
+        # size can change across elastic relaunches, so it is rebuilt
+        # per generation like the heartbeat monitor)
+        aggregator = _start_scope_aggregator(scope_port, world, attempt)
         monitor = None
         if heartbeat_timeout and diagnostics_dir:
             # liveness poll for THIS generation: a rank whose mx.guard
@@ -498,6 +843,8 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             _terminate_gang(procs, pumps)
         if monitor is not None:
             monitor.stop()
+        if aggregator is not None:
+            aggregator.stop()
         if code == 0 or attempt >= max_restarts:
             return code
         new_world, surviving, lost = _plan_world(
@@ -618,6 +965,16 @@ def main(argv=None):
                         "WORKER-side staleness knob (this flag exports "
                         "it), and its presence alone must not arm "
                         "supervisor kills.")
+    p.add_argument("--scope-port", type=int, default=0,
+                   help="arm mx.scope live introspection in every worker "
+                        "(MXNET_TPU_SCOPE=on): rank R serves /healthz "
+                        "/metrics /statusz /tracez /profilez on port "
+                        "P+1+R, and the launcher runs a gang aggregator "
+                        "on the base port P that merges /statusz into "
+                        "one gang view (stale/unreachable ranks named) "
+                        "and proxies /profilez to every rank at once — "
+                        "watch it live with tools/scope_top.py. 0 "
+                        "(default) disables.")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="supervised relaunch (local launcher): when any "
                         "rank exits nonzero, tear down the peers, back "
@@ -670,6 +1027,11 @@ def main(argv=None):
             print("warning: --heartbeat-timeout is local-launcher only "
                   "(remote heartbeat files are not visible here)",
                   file=sys.stderr)
+        if args.scope_port:
+            print("warning: --scope-port is local-launcher only (the "
+                  "aggregator fans out to 127.0.0.1 rank ports; arm "
+                  "remote workers with MXNET_TPU_SCOPE=on and scrape "
+                  "them directly)", file=sys.stderr)
         with open(args.hostfile) as f:
             hosts = [line.strip() for line in f if line.strip()]
         return launch_ssh(hosts, args.num_workers, args.command,
@@ -682,7 +1044,8 @@ def main(argv=None):
                         elastic=args.elastic,
                         min_workers=args.min_workers,
                         trace_dir=args.trace_dir,
-                        heartbeat_timeout=args.heartbeat_timeout)
+                        heartbeat_timeout=args.heartbeat_timeout,
+                        scope_port=args.scope_port)
 
 
 if __name__ == "__main__":

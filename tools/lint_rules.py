@@ -61,6 +61,7 @@ INSTRUMENTED = (
     os.path.join("mxnet_tpu", "check.py"),
     os.path.join("mxnet_tpu", "trace.py"),
     os.path.join("mxnet_tpu", "serve.py"),
+    os.path.join("mxnet_tpu", "scope.py"),
     os.path.join("tools", "launch.py"),
 )
 

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Live one-screen gang summary from the mx.scope aggregator
+(`tools/launch.py --scope-port P` serves it on port P).
+
+    python tools/scope_top.py                      # 127.0.0.1:8917
+    python tools/scope_top.py --port 9000 --interval 1
+    python tools/scope_top.py --url http://host:9000 --once
+
+Polls the aggregator's merged `/statusz` and renders, per rank: the
+current step, steps/s (the rank's own rate window, falling back to the
+poll-to-poll delta), heartbeat / last-step age, mx.memsafe headroom, and
+live serve stats (active requests, TTFT p50) — plus the gang footer:
+step spread, stale/unreachable ranks, and the mx.trace skew verdict
+naming the suspected straggler. `--once` prints a single snapshot (no
+screen clearing) — the scriptable spelling; the default loop refreshes
+in place until Ctrl-C.
+
+Reads only the stdlib so it runs anywhere with network reach to the
+aggregator (no jax, no mxnet_tpu import).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+def fmt_bytes(n):
+    if not isinstance(n, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def _age(v):
+    return f"{v:.1f}s" if isinstance(v, (int, float)) else "-"
+
+
+def _rate(payload, prev, rank, now):
+    """steps/s: the rank's own window when it reports one, else the
+    delta against the previous poll."""
+    r = payload.get("steps_per_s")
+    if isinstance(r, (int, float)):
+        return f"{r:.2f}"
+    if prev and rank in prev["steps"] and payload.get("step") is not None:
+        pt, ps = prev["ts"], prev["steps"][rank]
+        if now > pt and isinstance(ps, int):
+            return f"{(payload['step'] - ps) / (now - pt):.2f}"
+    return "-"
+
+
+def _serve_cell(payload):
+    sv = payload.get("serve")
+    if not sv or not sv.get("servers"):
+        return "-"
+    s = sv["servers"][0]
+    cell = f"{s.get('running', 0)}run/{s.get('queued', 0)}q"
+    if isinstance(sv.get("ttft_p50_ms"), (int, float)):
+        cell += f" {sv['ttft_p50_ms']:.0f}ms"
+    return cell
+
+
+def render(status, prev, url):
+    now = time.time()
+    lines = [
+        f"mx.scope gang view @ {url}  gen {status.get('generation')}  "
+        f"world {status.get('world_size')}  "
+        f"{time.strftime('%H:%M:%S')}",
+        f"{'rank':<5}{'step':>8}{'steps/s':>9}{'hb_age':>8}"
+        f"{'step_age':>9}{'headroom':>11}{'serve':>14}  state",
+    ]
+    stale = set(status.get("stale_ranks") or [])
+    unreachable = set(status.get("unreachable_ranks") or [])
+    failing = set(status.get("failing_ranks") or [])
+    steps_now = {}
+    for rank_s, payload in sorted(status.get("ranks", {}).items(),
+                                  key=lambda kv: int(kv[0])):
+        rank = int(rank_s)
+        if rank in unreachable or (rank not in failing
+                                   and "error" in payload
+                                   and "step" not in payload):
+            lines.append(f"{rank:<5}{'-':>8}{'-':>9}{'-':>8}{'-':>9}"
+                         f"{'-':>11}{'-':>14}  UNREACHABLE "
+                         f"({payload.get('error', '?')})")
+            continue
+        if rank in failing:
+            lines.append(f"{rank:<5}{'-':>8}{'-':>9}{'-':>8}{'-':>9}"
+                         f"{'-':>11}{'-':>14}  FAILING "
+                         f"(HTTP {payload.get('http_status', '?')})")
+            continue
+        steps_now[rank] = payload.get("step")
+        ms = payload.get("memsafe") or {}
+        state = "STALE" if rank in stale else "ok"
+        lines.append(
+            f"{rank:<5}"
+            f"{payload.get('step') if payload.get('step') is not None else '-':>8}"
+            f"{_rate(payload, prev, rank, now):>9}"
+            f"{_age(payload.get('heartbeat_age_s')):>8}"
+            f"{_age(payload.get('last_step_age_s')):>9}"
+            f"{fmt_bytes(ms.get('headroom_bytes')):>11}"
+            f"{_serve_cell(payload):>14}  {state}")
+    foot = []
+    if status.get("step_spread") is not None:
+        foot.append(f"step spread {status['step_spread']} "
+                    f"(min {status['min_step']} / max {status['max_step']})")
+    if stale:
+        foot.append(f"stale: {sorted(stale)}")
+    if unreachable:
+        foot.append(f"unreachable: {sorted(unreachable)}")
+    if failing:
+        foot.append(f"failing: {sorted(failing)}")
+    for payload in status.get("ranks", {}).values():
+        tv = payload.get("trace") if isinstance(payload, dict) else None
+        if tv and tv.get("participants", 1) > 1:
+            foot.append(f"straggler: rank {tv.get('straggler_rank')} "
+                        f"(skew {tv.get('spread_ms')}ms last, "
+                        f"p99 {tv.get('skew_p99_ms')}ms)")
+            break
+    lines.append("  ".join(foot) if foot else "gang healthy")
+    return "\n".join(lines), {"ts": now, "steps": steps_now}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--url", default=None,
+                   help="aggregator base URL (overrides --host/--port)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8917,
+                   help="aggregator base port (the --scope-port value)")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--stale-after", type=float, default=None,
+                   help="seconds without a completed step/heartbeat "
+                        "before a rank renders STALE, used exactly as "
+                        "given; default lets the aggregator scale its "
+                        "floor with the gang's step cadence")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (no screen clear)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-poll HTTP timeout")
+    args = p.parse_args(argv)
+    base = args.url or f"http://{args.host}:{args.port}"
+    url = f"{base}/statusz"
+    if args.stale_after is not None:
+        url += f"?stale_after={args.stale_after}"
+    prev = None
+    while True:
+        try:
+            status = fetch(url, timeout=args.timeout)
+        except Exception as e:  # noqa: BLE001 - keep polling through blips
+            if args.once:
+                print(f"scope_top: cannot reach {base}: {e}",
+                      file=sys.stderr)
+                return 1
+            sys.stdout.write(CLEAR + f"scope_top: cannot reach {base}: "
+                             f"{e} (retrying)\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+            continue
+        text, prev = render(status, prev, base)
+        if args.once:
+            print(text)
+            return 0
+        sys.stdout.write(CLEAR + text + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(0)

@@ -15,11 +15,13 @@ Per file prints: recompile count with per-event causes, step-time p50/p99,
 a "cost & efficiency" section when mx.inspect cost events are present (top
 executables by device memory, flops / arithmetic intensity / roofline, MFU
 against the recorded per-chip peak, estimated collective-traffic share,
-and a one-line input/comm/compute-bound verdict), collective/kvstore bytes
-moved, and the input-stall fraction (time blocked on the input pipeline as
-a share of run time) — the triage order for a slow TPU training run:
-recompiling? input-bound? comms-bound? only then look at the kernels
-(mx.profiler / jax.profiler).
+and a one-line input/comm/compute-bound verdict), a "serve:" section when
+the run served traffic (requests by outcome, token throughput, TTFT and
+queue-wait p50/p99, shed/deadline-miss/degradation counts), collective/
+kvstore bytes moved, and the input-stall fraction (time blocked on the
+input pipeline as a share of run time) — the triage order for a slow TPU
+training run: recompiling? input-bound? comms-bound? only then look at
+the kernels (mx.profiler / jax.profiler).
 
 Reads only the stdlib so it runs anywhere the JSONL lands (no jax import);
 malformed lines and records with missing fields are skipped, not fatal.
@@ -162,6 +164,63 @@ def _cost_efficiency(events, step_p50):
     return lines, mfu, comm_share
 
 
+def _metric_percentiles(snapshot, name):
+    """(p50, p99, count) of a snapshot histogram (None-safe)."""
+    m = snapshot.get(name) or {}
+    return m.get("p50"), m.get("p99"), m.get("count") or 0
+
+
+def _serve_section(events, snapshot):
+    """The "serve:" lines (PR 12 recorded the serve_* series; this
+    renders them): requests by terminal outcome, token throughput, TTFT
+    and queue-wait percentiles, and the overload counters (shed /
+    deadline-miss / degradations). Empty when the run never served."""
+    outcomes = _label_values(snapshot, "serve_requests_total")
+    tokens = _metric_sum(snapshot, "serve_tokens_total")
+    ttft_p50, ttft_p99, ttft_n = _metric_percentiles(
+        snapshot, "serve_ttft_seconds")
+    total = sum(outcomes.values())
+    # gate on recorded VALUES, not registered series: importing mx.serve
+    # registers zero-valued children, and a training run's report must
+    # not grow a phantom all-zero serving section from that
+    if not total and not tokens and not ttft_n:
+        return []
+    lines = ["serve:"]
+    by_outcome = ", ".join(
+        f"{k.split('=')[-1].strip(chr(34) + '{}')} {int(v)}"
+        for k, v in sorted(outcomes.items())) or "none"
+    lines.append(f"  requests:   {int(total)} ({by_outcome})")
+    tok_line = f"  tokens:     {int(tokens)}"
+    # throughput needs a wall span: the serve events (degradations) and
+    # step/compile events all carry ts — use the run's event span when
+    # it is meaningful, else report the total alone
+    stamps = [e["ts"] for e in events
+              if isinstance(e.get("ts"), (int, float))]
+    if tokens and len(stamps) >= 2 and max(stamps) - min(stamps) > 0.1:
+        tok_line += (f", {tokens / (max(stamps) - min(stamps)):.1f}"
+                     " tokens/s over the event span")
+    lines.append(tok_line)
+    if ttft_n:
+        lines.append(
+            f"  ttft:       p50 {(ttft_p50 or 0) * 1e3:.1f} ms  "
+            f"p99 {(ttft_p99 or 0) * 1e3:.1f} ms  ({int(ttft_n)} first "
+            "tokens)")
+    qw_p50, qw_p99, qw_n = _metric_percentiles(
+        snapshot, "serve_queue_wait_seconds")
+    if qw_n:
+        lines.append(f"  queue wait: p50 {(qw_p50 or 0) * 1e3:.1f} ms  "
+                     f"p99 {(qw_p99 or 0) * 1e3:.1f} ms")
+    shed = outcomes.get('{outcome="shed"}', 0)
+    rejected = outcomes.get('{outcome="rejected"}', 0)
+    missed = _metric_sum(snapshot, "serve_deadline_missed_total")
+    degraded = _metric_sum(snapshot, "serve_degraded_total")
+    if shed or rejected or missed or degraded:
+        lines.append(f"  overload:   shed {int(shed)}, rejected "
+                     f"{int(rejected)}, deadline-missed {int(missed)}, "
+                     f"degradations {int(degraded)}")
+    return lines
+
+
 def report(path, label=None, data=None):
     events, snapshot = data if data is not None else load(path)
     title = f"telemetry report: {path}" if label is None \
@@ -210,6 +269,9 @@ def report(path, label=None, data=None):
         snapshot.get("trainer_step_seconds", {}).get("p50")
     cost_lines, mfu, comm_share = _cost_efficiency(events, step_p50)
     lines.extend(cost_lines)
+
+    # -- serving (mx.serve serve_* series) --------------------------------
+    lines.extend(_serve_section(events, snapshot))
 
     # -- comms ------------------------------------------------------------
     coll = _label_values(snapshot, "collective_bytes_total")
