@@ -16,7 +16,14 @@ ci/run.sh sanity. tokens_per_sec counts GENERATED tokens over the
 span from first submit to last completion; ttft is submit-to-first-
 token. Knobs via env: MXNET_TPU_BENCH_SERVE_REQUESTS / _RATE (req/s) /
 _DEADLINE_MS. CPU smoke mode (tiny model) when no TPU; GPT-2 117m bf16
-on the chip. Rides the persistent compile cache like every bench."""
+on the chip. Rides the persistent compile cache like every bench.
+
+`--int8` (or MXNET_TPU_BENCH_SERVE_INT8=1) additionally drives the SAME
+offered load through an int8-quantized copy of the model
+(contrib.quantization.quantize_block -> the pallas_ops.int8_matmul
+decode path) and reports int8_tokens_per_sec / int8_ttft_p99_ms in the
+same row, so tools/bench_diff.py can compare the fp and int8 paths
+(both fields are registered direction-aware there)."""
 import json
 import os
 import sys
@@ -75,68 +82,99 @@ def main():
     model.initialize()
     rng = np.random.RandomState(0)
 
-    srv = serve.Server(model, slots=slots)
-    # warm the common bucket so the measured window is steady-state, not
-    # the one-off jit compile (the persistent cache makes re-runs warm)
-    warm = srv.submit(rng.randint(0, cfg["vocab_size"], (lp_range[1],))
-                      .astype(np.int32), max_new_tokens=new_range[1])
-    srv.drain()
-    assert warm.state == serve.DONE
-
-    # open loop: Poisson interarrivals, pre-drawn so the offered load is
-    # independent of how the server keeps up
+    # pre-drawn offered load, shared by the fp and int8 passes: Poisson
+    # interarrivals so arrivals are independent of how the server keeps up
     arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
     prompts = [rng.randint(0, cfg["vocab_size"],
                            (rng.randint(*lp_range),)).astype(np.int32)
                for _ in range(n_requests)]
     news = [int(rng.randint(*new_range)) for _ in range(n_requests)]
 
-    srv.start()
-    reqs = []
-    t0 = time.perf_counter()
-    for i in range(n_requests):
-        delay = arrivals[i] - (time.perf_counter() - t0)
-        if delay > 0:
-            time.sleep(delay)
-        reqs.append(srv.submit(prompts[i], max_new_tokens=news[i],
-                               deadline_ms=deadline_ms))
-    # a consumer per request: streams drain concurrently (and honor any
-    # injected slow_client fault) without blocking the scheduler
-    threads = [threading.Thread(target=lambda r=r: list(r.stream()))
-               for r in reqs]
-    for th in threads:
-        th.start()
-    for r in reqs:
-        r.result(timeout=600)
-    wall = time.perf_counter() - t0
-    for th in threads:
-        th.join(timeout=60)
-    srv.stop()
+    def run_load(mdl):
+        srv = serve.Server(mdl, slots=slots)
+        # warm the common bucket so the measured window is steady-state,
+        # not the one-off jit compile (the persistent cache makes
+        # re-runs warm)
+        warm = srv.submit(rng.randint(0, cfg["vocab_size"],
+                                      (lp_range[1],)).astype(np.int32),
+                          max_new_tokens=new_range[1])
+        srv.drain()
+        assert warm.state == serve.DONE
 
-    st = srv.stats()
-    ttfts = sorted(r.ttft_s * 1e3 for r in reqs if r.ttft_s is not None)
-    done = [r for r in reqs if r.state == serve.DONE]
-    tokens = sum(len(r.tokens) for r in reqs)
-    row = {
-        "tokens_per_sec": round(tokens / wall, 1),
-        "requests_per_sec": round(len(done) / wall, 2),
-        "ttft_p50_ms": round(_percentile(ttfts, 50), 2) if ttfts else None,
-        "ttft_p99_ms": round(_percentile(ttfts, 99), 2) if ttfts else None,
+        srv.start()
+        reqs = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            delay = arrivals[i] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(srv.submit(prompts[i], max_new_tokens=news[i],
+                                   deadline_ms=deadline_ms))
+        # a consumer per request: streams drain concurrently (and honor
+        # any injected slow_client fault) without blocking the scheduler
+        threads = [threading.Thread(target=lambda r=r: list(r.stream()))
+                   for r in reqs]
+        for th in threads:
+            th.start()
+        for r in reqs:
+            r.result(timeout=600)
+        wall = time.perf_counter() - t0
+        for th in threads:
+            th.join(timeout=60)
+        srv.stop()
+
+        st = srv.stats()
+        ttfts = sorted(r.ttft_s * 1e3 for r in reqs
+                       if r.ttft_s is not None)
+        done = [r for r in reqs if r.state == serve.DONE]
+        tokens = sum(len(r.tokens) for r in reqs)
+        return srv, {
+            "tokens_per_sec": round(tokens / wall, 1),
+            "requests_per_sec": round(len(done) / wall, 2),
+            "ttft_p50_ms": round(_percentile(ttfts, 50), 2)
+            if ttfts else None,
+            "ttft_p99_ms": round(_percentile(ttfts, 99), 2)
+            if ttfts else None,
+            "completed": len(done),
+            "rejected": st["rejected"],
+            "shed": st["shed"],
+            "deadline_missed": st["expired"],
+            "cancelled": st["cancelled"],
+            "degraded": st["degraded"],
+            "requeues": st["requeues"],
+        }
+
+    srv, stats = run_load(model)
+    row = dict(stats)
+    row.update({
         "requests": n_requests,
-        "completed": len(done),
-        "rejected": st["rejected"],
-        "shed": st["shed"],
-        "deadline_missed": st["expired"],
-        "cancelled": st["cancelled"],
-        "degraded": st["degraded"],
-        "requeues": st["requeues"],
         "slots": slots,
         "queue_depth": srv._queue_depth,
         "offered_rps": round(rate, 2),
         "platform": jax.default_backend(),
         "devices": len(jax.devices()),
         "smoke_mode": not on_tpu,
-    }
+    })
+
+    int8 = "--int8" in sys.argv[1:] \
+        or os.environ.get("MXNET_TPU_BENCH_SERVE_INT8") == "1"
+    if int8:
+        # the quantized decode path (pallas_ops.int8_matmul via
+        # QuantizedDense) under the SAME pre-drawn offered load, so
+        # fp-vs-int8 tokens/s is an apples-to-apples pairing in one row
+        from mxnet_tpu.contrib import quantization as _quant
+        qmodel = gpt_mod.GPTForCausalLM(cfg)
+        mx.random.seed(0)
+        qmodel.initialize()
+        _quant.quantize_block(qmodel)
+        _, qstats = run_load(qmodel)
+        row.update({
+            "int8_tokens_per_sec": qstats["tokens_per_sec"],
+            "int8_requests_per_sec": qstats["requests_per_sec"],
+            "int8_ttft_p50_ms": qstats["ttft_p50_ms"],
+            "int8_ttft_p99_ms": qstats["ttft_p99_ms"],
+            "int8_completed": qstats["completed"],
+        })
     print(json.dumps(row), flush=True)
 
 

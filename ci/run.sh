@@ -267,6 +267,54 @@ assert tr._zero is False and tr._zero_specs is None \
     and tr._zero_flat is None, 'zero state armed while disabled'
 print('zero disabled fast path OK (no planning, no constraints)')
 "
+    # mx.kernels fast path: a kernels=off run must keep the trainer hot
+    # loop entirely pallas-free — no jax.experimental.pallas import (the
+    # adam step and the QuantizedDense int8 forward route through their
+    # XLA-native fallbacks), and the kernels=auto default on a CPU
+    # backend behaves identically (backend probe first, no import)
+    JAX_PLATFORMS=cpu python -c "
+import sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, config
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.contrib import quantization as Q
+config.set('kernels', 'off')
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'adam',
+                             {'learning_rate': 0.01})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for _ in range(3):
+    tr.step(x, y)
+d = nn.Dense(4, in_units=8); d.initialize()
+Q.QuantizedDense(d)(nd.array(np.ones((2, 8), np.float32)))
+assert 'jax.experimental.pallas' not in sys.modules, \
+    'kernels=off hot loop imported pallas'
+# CPU backend under kernels=auto must behave identically — and the
+# assert must see a FRESH trace (a cached executable would never
+# re-consult the knob): new net+trainer and a new quantized forward
+config.set('kernels', 'auto')
+net2 = nn.Dense(4, in_units=8); net2.initialize()
+tr2 = parallel.ShardedTrainer(net2, lambda o, l: lfn(o, l), 'adam',
+                              {'learning_rate': 0.01})
+for _ in range(2):
+    tr2.step(x, y)
+d2 = nn.Dense(4, in_units=8); d2.initialize()
+Q.QuantizedDense(d2)(nd.array(np.ones((2, 8), np.float32)))
+assert 'jax.experimental.pallas' not in sys.modules, \
+    'kernels=auto on CPU imported pallas'
+print('kernels=off fast path OK (no pallas import on the hot loop)')
+"
+    # interpret-mode kernel suite: the kernel CODE (not the jnp
+    # fallback) for all three new kernels — int8 matmul, fused update,
+    # MoE dispatch/combine — parity-tested through the Pallas
+    # interpreter on CPU (the same pattern as test_flash_interpret)
+    MXNET_TPU_PALLAS_INTERPRET=1 JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_kernels.py -q \
+        -p no:cacheprovider
     # resilience must be disabled by default: no signal handlers installed,
     # the trainer step hook reduces to one module-bool check (zero on_step
     # calls), and save/restore do no manifest hashing (zero _file_crc
@@ -450,6 +498,31 @@ assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
 print('bench_serve contract OK:', {k: d[k] for k in
       ('tokens_per_sec', 'ttft_p50_ms', 'ttft_p99_ms',
        'requests_per_sec', 'deadline_missed')})
+"
+    # bench_kernels row contract: one row per pallas_ops kernel with
+    # pallas-vs-XLA timing and the roofline verdicts; the CPU smoke runs
+    # the kernels through the interpreter and must be marked smoke_mode
+    # (bench_diff refuses to compare it against TPU rows)
+    JAX_PLATFORMS=cpu MXNET_TPU_BENCH_FORCE_CPU=1 \
+        python benchmarks/bench_kernels.py \
+        > /tmp/_bench_kernels.out 2>/dev/null
+    python -c "
+import json
+rows = [json.loads(l) for l in open('/tmp/_bench_kernels.out')
+        if l.strip().startswith('{')]
+names = {r.get('metric') for r in rows}
+assert names == {'kernel_int8_matmul', 'kernel_fused_adam',
+                 'kernel_moe_dispatch_combine'}, names
+for d in rows:
+    for k in ('pallas_ms', 'xla_ms', 'speedup', 'roofline_xla',
+              'roofline_pallas', 'shape', 'platform', 'devices',
+              'smoke_mode'):
+        assert k in d, f'bench_kernels row missing {k}: {sorted(d)}'
+    assert d['pallas_ms'] > 0 and d['xla_ms'] > 0, d
+    assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
+    assert d['roofline_xla'] is None, 'CPU must report null roofline'
+print('bench_kernels contract OK:',
+      {d['metric']: d['speedup'] for d in rows})
 "
     # bench_generate rows carry platform provenance like every bench row
     # since PR 11 (smoke_mode=true CPU rows never compare against TPU)

@@ -131,6 +131,29 @@ register_option(
     "before declaring the workers deadlocked (a jax/XLA call inside a "
     "forked worker). 0 disables the watchdog.")
 register_option(
+    "kernels", "auto", choices=("off", "auto", "on"),
+    doc="mx.kernels Pallas library gate (pallas_ops/: int8 serving "
+        "matmul with fused per-channel rescale, fused optimizer "
+        "updates, fused MoE dispatch/combine). 'off': every call site "
+        "runs its bit-exact XLA-native fallback and nothing imports "
+        "jax.experimental.pallas (the trainer hot loop stays "
+        "pallas-free — asserted by ci/run.sh sanity). 'auto' "
+        "(default): a kernel engages when it can win — a TPU backend "
+        "(or MXNET_TPU_PALLAS_INTERPRET=1, the interpreter path "
+        "tier-1 tests ride), shape eligibility, and for the "
+        "fused-update kernels a single-device step (pallas_call has "
+        "no GSPMD rule; the MoE kernels run inside shard_map and "
+        "engage on any mesh). 'on' raises instead of silently falling "
+        "back when Pallas cannot run. Decided at trace time: 'off' "
+        "executables are byte-identical to a build without the "
+        "library.")
+register_option(
+    "kernels_min_elements", 1 << 16,
+    "Smallest buffer (elements) the fused optimizer-update kernels "
+    "engage on; below it the XLA lowering is kept (kernel launch "
+    "overhead beats one fused pass over tiny LayerNorm/bias state — "
+    "same argument as fsdp_min_size / zero_min_size).")
+register_option(
     "pallas_bwd_min_len", 512,
     "KV length at or above which flash-attention backward uses the "
     "blockwise Pallas kernels instead of XLA's fused LxL formulation "

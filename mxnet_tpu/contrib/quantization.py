@@ -61,33 +61,62 @@ def _per_channel_scales(w2d, mode, percentile=99.99):
 
 
 def _int8_matmul(x_q, w_q_t, x_scale, w_scale):
-    """int8 × int8 → int32 on the MXU, one fused rescale to f32."""
-    acc = jax.lax.dot_general(
-        x_q, w_q_t, (((x_q.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    return acc.astype(jnp.float32) * (x_scale * w_scale)
+    """int8 × int8 → int32 on the MXU, one fused rescale to f32.
+
+    Routed through the mx.kernels library: kernels=off (or no TPU/
+    interpreter) runs `pallas_ops.int8_matmul_reference` — the exact
+    expression that always lived here — and the engaged path runs the
+    Pallas kernel with the rescale fused into the accumulator tile."""
+    from ..pallas_ops.int8_matmul import int8_matmul as _k_int8_matmul
+    return _k_int8_matmul(x_q, w_q_t, x_scale, w_scale)
 
 
 class QuantizedDense(HybridBlock):
     """Int8-weight Dense for inference (reference: quantized_fully_connected).
 
     Activation is quantized on the fly with a calibrated static scale when
-    available, else a dynamic per-batch scale.
+    available, else a dynamic per-batch scale. Weight scales are
+    per-OUTPUT-CHANNEL (`_per_channel_scales` — the shared helper, so the
+    serve path cannot drift to per-tensor; per-tensor loses ~1% top-1 on
+    nets whose row norms vary widely, pinned by the accuracy-delta
+    assertion in tests/unittest/test_contrib.py).
+
+    The int8 weight, per-channel scales, and bias are registered
+    `Constant` parameters — under the decode path's `functional_call`
+    (mx.serve / `models/_decode.jit_flat_step`) they become jit
+    ARGUMENTS, not closure constants, so the traced form carries no
+    baked weights (mx.check's large-constant rule stays quiet) and the
+    serving matmul runs `pallas_ops.int8_matmul` with the per-channel
+    rescale fused.
+
+    `simulate=True` keeps the SAME quantized weights but dequantizes and
+    runs the fp matmul — the "dequantized reference" oracle the serve
+    int8 path's token-identity test compares against.
     """
 
-    def __init__(self, dense, act_scale=None, mode="naive", **kwargs):
+    def __init__(self, dense, act_scale=None, mode="naive", simulate=False,
+                 **kwargs):
         super().__init__(**kwargs)
+        from ..gluon.parameter import Constant
+
         w = np.asarray(dense.weight.data().asnumpy(), np.float32)  # (O, I)
-        # per-OUTPUT-CHANNEL scales (reference channel-wise quantization):
-        # per-tensor loses ~1% top-1 on nets whose row norms vary widely
         w_scale = _per_channel_scales(w, mode)
         w_q = np.clip(np.round(w / w_scale[:, None]), -127, 127
                       ).astype(np.int8)
-        self._w_q = jnp.asarray(w_q.T)  # pre-transposed for dot_general
-        self._w_scale = jnp.asarray(w_scale)                    # (O,)
-        self._bias = (dense.bias.data()._data
-                      if getattr(dense, "bias", None) is not None else None)
+        # pre-transposed for dot_general; Constants register as params
+        self.weight_q = Constant("weight_q", w_q.T)
+        self.weight_scale = Constant("weight_scale",
+                                     w_scale.astype(np.float32))
+        self.weight_q.initialize()
+        self.weight_scale.initialize()
+        if getattr(dense, "bias", None) is not None:
+            self.bias = Constant("bias", np.asarray(
+                dense.bias.data().asnumpy(), np.float32))
+            self.bias.initialize()
+        else:
+            self.bias = None
         self._act_scale = act_scale  # None -> dynamic
+        self._simulate = bool(simulate)
         self._units = dense._units if hasattr(dense, "_units") else w_q.shape[0]
         act = getattr(dense, "act", None)
         act = getattr(act, "_act_type", act)   # nn.Activation block or str
@@ -97,6 +126,15 @@ class QuantizedDense(HybridBlock):
                 "(relu only)")
         self._act = act
 
+    # legacy views (pre-Constant attribute names)
+    @property
+    def _w_q(self):
+        return self.weight_q.data()._data
+
+    @property
+    def _w_scale(self):
+        return self.weight_scale.data()._data
+
     def forward(self, x):
         data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         out = self._forward_jax(data)
@@ -105,17 +143,28 @@ class QuantizedDense(HybridBlock):
     __call__ = forward
 
     def _forward_jax(self, data):
+        w_q = self.weight_q.data()._data
+        w_scale = self.weight_scale.data()._data
+        bias = self.bias.data()._data if self.bias is not None else None
+        if self._simulate:
+            # dequantize-then-fp-matmul: same int8 weights, fp math —
+            # what the int8 path is measured against for token identity
+            w = w_q.astype(jnp.float32) * w_scale[None, :]
+            out = data.astype(jnp.float32) @ w
+            if bias is not None:
+                out = out + bias
+            if self._act == "relu":
+                out = jnp.maximum(out, 0.0)
+            return out.astype(data.dtype)
         if self._act_scale is not None:
             s_x = jnp.float32(self._act_scale)
         else:
             s_x = jnp.maximum(jnp.abs(data).max(), 1e-8) / INT8_MAX
         x_q = jnp.clip(jnp.round(data / s_x), -127, 127).astype(jnp.int8)
-        out = _int8_matmul(x_q, self._w_q, s_x, self._w_scale)
-        if self._bias is not None:
-            out = out + self._bias
-        if self._act == "relu":
-            out = jnp.maximum(out, 0.0)
-        return out
+        from ..pallas_ops.int8_matmul import int8_matmul as _k_int8_matmul
+        out = _k_int8_matmul(x_q, w_q, s_x, w_scale, bias=bias,
+                             relu=self._act == "relu")
+        return out.astype(data.dtype)
 
 
 class QuantizedConv2D(HybridBlock):
@@ -211,12 +260,16 @@ def _walk(block, prefix=""):
         yield from _walk(child, f"{prefix}{name}.")
 
 
-def quantize_block(block, calib_data=None, mode="naive"):
+def quantize_block(block, calib_data=None, mode="naive", simulate=False):
     """Replace every Dense/Conv2D descendant with its int8 twin, calibrating
     activation scales on `calib_data` batches when provided (reference:
     quantize_net flow). Calibration hooks the layers' own forwards and runs
     the block's REAL forward, so residual/branchy graphs (ResNet) calibrate
-    correctly — not just sequential chains."""
+    correctly — not just sequential chains.
+
+    `simulate=True` swaps in dequantize-then-fp QuantizedDense twins
+    (same int8 weights, fp matmul) — the reference model for the serve
+    int8 token-identity gate."""
     if hasattr(block, "hybridize"):
         # calibration hooks and the swapped int8 children need eager
         # dispatch; a live jit cache would silently keep the float graph
@@ -239,11 +292,11 @@ def quantize_block(block, calib_data=None, mode="naive"):
         finally:
             for child in hooked:
                 child._forward_pre_hooks.pop()
-    _swap_quantizable(block, collector, mode)
+    _swap_quantizable(block, collector, mode, simulate=simulate)
     return block
 
 
-def _swap_quantizable(block, collector, mode, prefix=""):
+def _swap_quantizable(block, collector, mode, prefix="", simulate=False):
     for name, child in list(getattr(block, "_children", {}).items()):
         if isinstance(child, _nn.Conv2D):
             q = QuantizedConv2D(
@@ -252,9 +305,10 @@ def _swap_quantizable(block, collector, mode, prefix=""):
         elif isinstance(child, _nn.Dense):
             q = QuantizedDense(
                 child, act_scale=collector.scale(f"{prefix}{name}"),
-                mode=mode)
+                mode=mode, simulate=simulate)
         else:
-            _swap_quantizable(child, collector, mode, f"{prefix}{name}.")
+            _swap_quantizable(child, collector, mode, f"{prefix}{name}.",
+                              simulate=simulate)
             continue
         block._children[name] = q
         if hasattr(block, name):

@@ -85,6 +85,34 @@ _PEAK_BW_TABLE = (
     ("v5", 2765e9), ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
 )
 
+# memory-bound remediation hints: the applicable mx.kernels entry by
+# executable-name fragment, most specific first (mirrors how mx.check's
+# degenerate-sharding rule names mx.zero — a verdict should carry the
+# fix that exists in-tree, not just the diagnosis). Surfaced in
+# as_dict()/tools/inspect_report.py whenever roofline says memory-bound.
+_KERNEL_HINTS = (
+    ("moe", "pallas_ops.moe_kernels (kernels=auto): fused MoE "
+            "dispatch/combine without the (N,E,C) one-hot tensor"),
+    ("decode", "pallas_ops.int8_matmul via "
+               "contrib.quantization.quantize_block (kernels=auto): "
+               "int8 decode matmuls with the per-channel rescale fused"),
+    ("serve", "pallas_ops.int8_matmul via "
+              "contrib.quantization.quantize_block (kernels=auto): "
+              "int8 decode matmuls with the per-channel rescale fused"),
+    ("generate", "pallas_ops.int8_matmul via "
+                 "contrib.quantization.quantize_block (kernels=auto): "
+                 "int8 decode matmuls with the per-channel rescale "
+                 "fused"),
+    ("step", "pallas_ops.fused_update (kernels=auto): one-VMEM-pass "
+             "optimizer update instead of the elementwise HLO chain"),
+    ("train", "pallas_ops.fused_update (kernels=auto): one-VMEM-pass "
+              "optimizer update instead of the elementwise HLO chain"),
+)
+_KERNEL_HINT_DEFAULT = (
+    "mx.kernels (pallas_ops/): a hand-scheduled Pallas kernel can beat "
+    "the generic lowering where the roofline says memory-bound — see "
+    "README 'Kernel library'")
+
 # telemetry series (get-or-create; updates are no-ops while telemetry is
 # disabled, so inspect-without-telemetry costs nothing here)
 _M_EXEC_FLOPS = _telemetry.gauge(
@@ -308,6 +336,18 @@ class CostRecord:
     def comm_bytes_per_step(self):
         return sum(self.collectives.values()) if self.collectives else None
 
+    def kernel_hint(self):
+        """The mx.kernels remediation for a memory-bound executable:
+        which pallas_ops kernel applies, matched on the executable name
+        (None unless the roofline verdict is memory-bound)."""
+        if self.roofline() != "memory-bound":
+            return None
+        name = (self.name or "").lower()
+        for frag, hint in _KERNEL_HINTS:
+            if frag in name:
+                return hint
+        return _KERNEL_HINT_DEFAULT
+
     def as_dict(self):
         d = {
             "name": self.name, "key": self.key, "created": self.created,
@@ -328,6 +368,7 @@ class CostRecord:
             "mfu": self.mfu(),
             "arithmetic_intensity": self.arithmetic_intensity(),
             "roofline": self.roofline(),
+            "kernel_hint": self.kernel_hint(),
         }
         if self.analysis_error:
             d["analysis_error"] = self.analysis_error

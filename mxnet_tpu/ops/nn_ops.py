@@ -555,20 +555,15 @@ def _quantize_act(data, act_scale):
 @register("_contrib_quantized_dense")
 def quantized_dense(data, weight_q, weight_scale, bias=None, act_scale=-1.0,
                     num_hidden=0, flatten=False, relu=False):
+    # routed through mx.kernels: the Pallas int8 matmul with fused
+    # per-channel rescale when engaged, the exact XLA lowering otherwise
+    from ..pallas_ops.int8_matmul import int8_matmul as _int8_matmul
     data = data.astype(jnp.float32)
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
     x_q, s_x = _quantize_act(data, act_scale)
-    acc = lax.dot_general(
-        x_q, weight_q.astype(jnp.int8).T,
-        (((data.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    out = acc.astype(jnp.float32) * (s_x * weight_scale)
-    if bias is not None:
-        out = out + bias
-    if relu:
-        out = jnp.maximum(out, 0.0)
-    return out
+    return _int8_matmul(x_q, weight_q.astype(jnp.int8).T, s_x,
+                        weight_scale, bias=bias, relu=relu)
 
 
 @register("_contrib_quantized_conv2d")

@@ -57,18 +57,38 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-try:  # pallas import is deferred so CPU-only environments still import us
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-    # jax 0.4.x spells it TPUCompilerParams; newer jax renamed it to
-    # CompilerParams. A module-LOCAL alias keeps the kernels on the new
-    # name without mutating jax's namespace (other libraries in the same
-    # process may feature-detect the rename via hasattr).
-    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
-        or pltpu.TPUCompilerParams
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
+# pallas binds LAZILY at first use (mx.kernels hygiene: this module is
+# reachable from hot paths via the pallas_ops package, and a kernels=off
+# / CPU process must keep jax.experimental.pallas out of sys.modules —
+# ci/run.sh sanity asserts it). `has_pallas()` resolves the import once;
+# the legacy `_HAS_PALLAS` module global keeps its meaning after that.
+pl = None
+pltpu = None
+_CompilerParams = None
+_HAS_PALLAS = None
+
+
+def has_pallas():
+    """Resolve (once) whether pallas imports here. Replaces the old
+    import-time `_HAS_PALLAS` probe; callers that read the module global
+    directly must call this first (ring_attention does)."""
+    global pl, pltpu, _CompilerParams, _HAS_PALLAS
+    if _HAS_PALLAS is None:
+        try:
+            from jax.experimental import pallas as _pl
+            from jax.experimental.pallas import tpu as _pltpu
+            pl, pltpu = _pl, _pltpu
+            # jax 0.4.x spells it TPUCompilerParams; newer jax renamed
+            # it to CompilerParams. A module-LOCAL alias keeps the
+            # kernels on the new name without mutating jax's namespace
+            # (other libraries in the same process may feature-detect
+            # the rename via hasattr).
+            _CompilerParams = getattr(_pltpu, "CompilerParams", None) \
+                or _pltpu.TPUCompilerParams
+            _HAS_PALLAS = True
+        except Exception:  # pragma: no cover
+            _HAS_PALLAS = False
+    return _HAS_PALLAS
 
 
 def _interpret():
@@ -495,8 +515,10 @@ def flash_attention(q, k, v, mask=None, causal=False, sm_scale=None,
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
 
-    use_pallas = _HAS_PALLAS and (
-        jax.default_backend() == "tpu" or _interpret())
+    # backend test FIRST: a CPU backend without the interpreter never
+    # triggers the pallas import at all (mx.kernels hygiene)
+    use_pallas = (jax.default_backend() == "tpu" or _interpret()) \
+        and has_pallas()
     if not use_pallas:
         bias = None
         if mask is not None:

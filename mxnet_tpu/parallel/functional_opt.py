@@ -71,11 +71,17 @@ class FunctionalOptimizer:
             elif self.kind in ("adam", "adamw"):
                 # bias-corrected lr (matches the stateful Adam.update)
                 lr_t = lr * jnp.sqrt(1 - o.beta2 ** t) / (1 - o.beta1 ** t)
-                op = "adam_update" if self.kind == "adam" else "adamw_update"
-                w, m, v = _ops.OPS[op](
+                # mx.kernels: one fused VMEM pass over w/g/m/v instead of
+                # the elementwise HLO chain (pallas_ops/fused_update.py;
+                # adam_update falls back to the exact _ops lowering
+                # unless the kernel is engaged — trace-time decision, so
+                # kernels=off steps are byte-identical)
+                from ..pallas_ops import fused_update as _fu
+                w, m, v = _fu.adam_update(
                     p, g, s[0], s[1], lr_t, beta1=o.beta1, beta2=o.beta2,
-                    epsilon=o.epsilon, wd=wd, rescale_grad=o.rescale_grad,
-                    clip_gradient=clip)
+                    epsilon=o.epsilon, wd=wd,
+                    rescale_grad=o.rescale_grad, clip_gradient=clip,
+                    decoupled_wd=self.kind == "adamw")
                 new_states.append((m, v))
             elif self.kind == "lamb":
                 w, m, v = _ops.OPS["lamb_update"](

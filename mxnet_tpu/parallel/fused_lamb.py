@@ -113,7 +113,18 @@ class FusedLamb:
         need no masking: w/m/v padding is zero by construction and grad
         padding is zero (flatten pads zeros; the unflatten vjp only
         scatters real elements), so every derived quantity is zero there
-        too."""
+        too.
+
+        mx.kernels: when the fused-update Pallas kernels are engaged
+        (`kernels` knob + TPU/interpreter + single-device step — see
+        pallas_ops/fused_update.py), the two elementwise passes run as
+        Pallas kernels over the same (rows, CHUNK) views; the tiny
+        per-segment norm scatter and trust ratio stay in XLA. With
+        kernels=off this method is byte-identical to the pre-kernel
+        build."""
+        from ..pallas_ops import fused_update as _fu
+        if _fu.engaged(self.total):
+            return self._apply_flat_pallas(w, g, m, v, t, lr)
         R, C = self.n_rows, _CHUNK
         W = w.reshape(R, C)
         G = g.reshape(R, C) * self.rescale
@@ -178,3 +189,50 @@ class FusedLamb:
         new_w = Wb - lr * trust_rows * make_update(
             mb.astype(jnp.float32), vb.astype(jnp.float32), Wb)
         return (new_w.reshape(-1), new_m.reshape(-1), new_v.reshape(-1))
+
+    def _apply_flat_pallas(self, w, g, m, v, t, lr):
+        """The same update via the mx.kernels fused-update passes: pass 1
+        (moments + per-row sums of squares) and pass 2 (trust-scaled
+        apply) each run once over VMEM-resident tiles; only the
+        per-segment norm scatter + trust ratio (n_segments elements)
+        execute as XLA ops between them — the two-kernel split realizes
+        the optimization_barrier structure physically."""
+        from ..pallas_ops import fused_update as _fu
+        R, C = self.n_rows, _CHUNK
+        W = w.reshape(R, C)
+        G = g.reshape(R, C)
+        c1 = (1 - self.b1 ** t) if self.bias_correction else 1.0
+        c2 = (1 - self.b2 ** t) if self.bias_correction else 1.0
+        wd_rows = jnp.take(self._wd_seg, self._row_seg)
+        new_m, new_v, rw, ru = _fu.lamb_pass1(
+            W, G, m, v, wd_rows, c1, c2, beta1=self.b1, beta2=self.b2,
+            epsilon=self.eps, rescale_grad=self.rescale,
+            clip_gradient=self.clip, bias_correction=self.bias_correction,
+            moments_dtype=self.moments_dtype)
+
+        def seg_norm(rows_sq):
+            # identical to the XLA path: segment scatter-add, not a
+            # cumsum difference (f32 cancellation on ~1e8 prefixes)
+            segsum = jnp.zeros(len(self.sizes), jnp.float32).at[
+                self._row_seg].add(rows_sq)
+            return jnp.sqrt(segsum)
+
+        r1 = seg_norm(rw)
+        r2 = seg_norm(ru)
+        r1 = jnp.where(r1 > 0, r1, 1.0)
+        r2 = jnp.where(r2 > 0, r2, 1.0)
+        trust = r1 / r2
+        if self.lo and self.lo > 0:
+            trust = jnp.maximum(trust, self.lo)
+        if self.hi and self.hi > 0:
+            trust = jnp.minimum(trust, self.hi)
+        trust_rows = jnp.take(trust, self._row_seg)
+        new_w = _fu.lamb_pass2(
+            W, new_m, new_v, wd_rows, trust_rows, c1, c2, lr,
+            beta1=self.b1, beta2=self.b2, epsilon=self.eps,
+            bias_correction=self.bias_correction)
+        # pass 1 hands its moments to pass 2 still row-padded (no
+        # pad(slice(x)) HBM round-trip between the passes); only the
+        # carried state slices back to the flat layout
+        return (new_w.reshape(-1), new_m[:R].reshape(-1),
+                new_v[:R].reshape(-1))

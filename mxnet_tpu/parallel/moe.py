@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import current_mesh
 
-__all__ = ["moe_dispatch", "moe_ffn", "moe_apply"]
+__all__ = ["moe_dispatch", "moe_route", "moe_ffn", "moe_apply"]
 
 
 def moe_dispatch(x, router_w, num_experts, capacity, axis_name=None):
@@ -55,23 +55,33 @@ def moe_dispatch(x, router_w, num_experts, capacity, axis_name=None):
     return dispatch, combine, aux_loss
 
 
-def moe_ffn(x, router_w, w1, w2, axis_name, capacity_factor=1.25,
-            activation=jax.nn.gelu):
-    """Expert-parallel Switch FFN. Call INSIDE shard_map over `axis_name`.
+def moe_route(x, router_w, num_experts):
+    """Compact top-1 routing: (expert (N,) int32, pos (N,) int32, gate
+    (N,) f32, aux_loss). `pos` is the token's slot within its expert's
+    capacity buffer; tokens beyond capacity simply carry pos >= C and
+    the fused dispatch/combine kernels drop them (same semantics as
+    `moe_dispatch`'s in_cap mask, without the (N, E, C) tensor)."""
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)  # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1).astype(jnp.int32)          # (N,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    one_hot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
+    # position within the expert's buffer: cumulative count of earlier
+    # tokens routed to the same expert (only the chosen column is live)
+    pos = (jnp.cumsum(one_hot, axis=0) * one_hot).sum(-1) \
+        .astype(jnp.int32) - 1
+    frac = one_hot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux_loss = num_experts * jnp.sum(frac * mean_prob)
+    return expert, pos, gate, aux_loss
 
-    Shapes per the module docstring. Returns (out (N,D), aux_loss scalar —
-    already psum-averaged over the axis).
-    """
-    n = lax.psum(1, axis_name)
-    e_local = w1.shape[0]
-    num_experts = n * e_local
-    n_tokens, d_model = x.shape
-    capacity = max(int(n_tokens * capacity_factor / num_experts), 1)
 
-    dispatch, combine, aux = moe_dispatch(x, router_w, num_experts, capacity)
-
-    # gather tokens into expert buffers: (E, C, D)
-    buf = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+def _expert_ffn(buf, w1, w2, n, e_local, capacity, d_model, axis_name,
+                activation):
+    """The shared middle of the Switch FFN: ship each expert-shard to
+    its owner, run the batched FFN einsums, ship results back. Used by
+    both the einsum path and the fused-kernel path (pure code motion
+    from moe_ffn — the math is unchanged)."""
     # send each expert-shard to its owner: (E, C, D) -> (n, E_local, C, D)
     buf = buf.reshape(n, e_local, capacity, d_model)
     # all_to_all over leading dim: afterwards dim 0 indexes SOURCE device,
@@ -89,9 +99,46 @@ def moe_ffn(x, router_w, w1, w2, axis_name, capacity_factor=1.25,
     out = out.reshape(e_local, n, capacity, d_model).transpose(1, 0, 2, 3)
     out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                          tiled=False)
-    out = out.reshape(num_experts, capacity, d_model)
+    return out.reshape(n * e_local, capacity, d_model)
 
-    y = jnp.einsum("nec,ecd->nd", combine, out)
+
+def moe_ffn(x, router_w, w1, w2, axis_name, capacity_factor=1.25,
+            activation=jax.nn.gelu):
+    """Expert-parallel Switch FFN. Call INSIDE shard_map over `axis_name`.
+
+    Shapes per the module docstring. Returns (out (N,D), aux_loss scalar —
+    already psum-averaged over the axis).
+
+    mx.kernels: with the Pallas library engaged (`kernels` knob; safe on
+    any mesh — this already runs inside shard_map) the dispatch gather
+    and combine scatter run as fused kernels over compact (N,) routing
+    vectors (pallas_ops/moe_kernels.py) instead of materializing the
+    (N, E, C) one-hot dispatch tensor in HBM. kernels=off keeps the
+    einsum formulation bit-identical to the pre-kernel build.
+    """
+    from ..pallas_ops import moe_kernels as _mk
+
+    n = lax.psum(1, axis_name)
+    e_local = w1.shape[0]
+    num_experts = n * e_local
+    n_tokens, d_model = x.shape
+    capacity = max(int(n_tokens * capacity_factor / num_experts), 1)
+
+    if _mk.engaged():
+        expert, pos, gate, aux = moe_route(x, router_w, num_experts)
+        buf = _mk.dispatch_to_experts(x.astype(jnp.float32), expert, pos,
+                                      num_experts, capacity)
+        out = _expert_ffn(buf, w1, w2, n, e_local, capacity, d_model,
+                          axis_name, activation)
+        y = _mk.combine_from_experts(out, expert, pos, gate)
+    else:
+        dispatch, combine, aux = moe_dispatch(x, router_w, num_experts,
+                                              capacity)
+        # gather tokens into expert buffers: (E, C, D)
+        buf = jnp.einsum("nec,nd->ecd", dispatch, x.astype(jnp.float32))
+        out = _expert_ffn(buf, w1, w2, n, e_local, capacity, d_model,
+                          axis_name, activation)
+        y = jnp.einsum("nec,ecd->nd", combine, out)
     aux = lax.pmean(aux, axis_name)
     return y.astype(x.dtype), aux
 

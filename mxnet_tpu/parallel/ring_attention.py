@@ -132,9 +132,9 @@ def _chunked_bwd(q, k, v, bias, g, lse, delta, causal, sm_scale, chunk):
 
 
 def _use_pallas(q, k):
-    from ..pallas_ops.flash_attention import _HAS_PALLAS, _interpret
-    return (_HAS_PALLAS
-            and (jax.default_backend() == "tpu" or _interpret())
+    from ..pallas_ops.flash_attention import has_pallas, _interpret
+    return ((jax.default_backend() == "tpu" or _interpret())
+            and has_pallas()
             and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0)
 
 

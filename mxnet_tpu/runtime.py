@@ -35,7 +35,7 @@ def _detect():
     add("BF16", lambda: True)              # XLA bf16 everywhere
     add("PALLAS", lambda: __import__(
         "mxnet_tpu.pallas_ops.flash_attention",
-        fromlist=["_HAS_PALLAS"])._HAS_PALLAS)
+        fromlist=["has_pallas"]).has_pallas())
     add("DIST_KVSTORE", lambda: True)      # mesh/collective backend
     # io.native owns the .so path AND builds it on first use — ask it
     add("NATIVE_IO", lambda: __import__(

@@ -1,0 +1,445 @@
+"""mx.kernels (pallas_ops) parity via the Pallas interpreter.
+
+Same pattern as test_flash_interpret: MXNET_TPU_PALLAS_INTERPRET=1
+routes every kernel through `pallas_call(interpret=True)` on CPU, so
+the kernel CODE — int8 matmul epilogue fusion, the fused-update VMEM
+passes, the MoE selection-tile matmuls and their custom VJPs — is
+pinned against the jnp references in tier-1, not just on a real chip.
+
+Also pinned here: kernels=off bit-identity (the fallback IS the
+pre-kernel expression), the mx.zero per-shard composition of the
+fused updates, the kernels=on strictness contract, and an mx.check
+graph lint over each kernel's traced form (no baked constants, no
+silent promotions, donation-safe).
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import config
+
+im = importlib.import_module("mxnet_tpu.pallas_ops.int8_matmul")
+fu = importlib.import_module("mxnet_tpu.pallas_ops.fused_update")
+mk = importlib.import_module("mxnet_tpu.pallas_ops.moe_kernels")
+_common = importlib.import_module("mxnet_tpu.pallas_ops._common")
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    config.set("kernels", "auto")
+    config.set("kernels_min_elements", 1)
+    yield
+    config.reset("kernels")
+    config.reset("kernels_min_elements")
+
+
+# --------------------------------------------------------------------------
+# int8 matmul
+# --------------------------------------------------------------------------
+
+def _int8_case(M=5, K=96, O=200, lead=(), seed=0):
+    rng = np.random.RandomState(seed)
+    shape = tuple(lead) + (M, K) if lead else (M, K)
+    x_q = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+    w_q = jnp.asarray(rng.randint(-127, 128, (K, O)), jnp.int8)
+    w_scale = jnp.asarray((rng.rand(O) * 0.1 + 1e-3).astype(np.float32))
+    bias = jnp.asarray(rng.randn(O).astype(np.float32))
+    return x_q, w_q, jnp.float32(0.017), w_scale, bias
+
+
+@pytest.mark.parametrize("relu", [False, True])
+def test_int8_matmul_parity(relu):
+    x_q, w_q, s_x, w_scale, bias = _int8_case()
+    got = im.int8_matmul(x_q, w_q, s_x, w_scale, bias=bias, relu=relu)
+    ref = im.int8_matmul_reference(x_q, w_q, s_x, w_scale, bias=bias,
+                                   relu=relu)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_int8_matmul_3d_and_no_bias():
+    # the decode path shape: (B, 1, E) activations
+    x_q, w_q, s_x, w_scale, _ = _int8_case(M=1, K=64, O=96, lead=(3,))
+    got = im.int8_matmul(x_q, w_q, s_x, w_scale)
+    ref = im.int8_matmul_reference(x_q, w_q, s_x, w_scale)
+    assert got.shape == (3, 1, 96)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_int8_matmul_per_tensor_scale_broadcasts():
+    x_q, w_q, s_x, _, _ = _int8_case(O=96)
+    w_scale = jnp.asarray([0.05], jnp.float32)          # per-tensor caller
+    got = im.int8_matmul(x_q, w_q, s_x, w_scale)
+    ref = im.int8_matmul_reference(x_q, w_q, s_x, w_scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_int8_matmul_rejects_fp_operands():
+    with pytest.raises(TypeError, match="int8"):
+        im.int8_matmul(jnp.ones((4, 8), jnp.float32),
+                       jnp.ones((8, 4), jnp.int8), 1.0,
+                       jnp.ones((4,), jnp.float32))
+
+
+def test_kernels_off_is_reference_path(monkeypatch):
+    """kernels=off must dispatch the exact XLA fallback — same jaxpr as
+    calling the reference directly (the bit-identity contract)."""
+    config.set("kernels", "off")
+    x_q, w_q, s_x, w_scale, bias = _int8_case()
+    j1 = jax.make_jaxpr(
+        lambda *a: im.int8_matmul(*a, relu=True))(x_q, w_q, s_x, w_scale,
+                                                  bias)
+    j2 = jax.make_jaxpr(
+        lambda *a: im.int8_matmul_reference(*a, relu=True))(
+            x_q, w_q, s_x, w_scale, bias)
+    assert str(j1) == str(j2)
+
+
+def test_kernels_on_raises_without_backend(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_PALLAS_INTERPRET", raising=False)
+    config.set("kernels", "on")
+    with pytest.raises(RuntimeError, match="kernels='on'"):
+        _common.use_pallas()
+
+
+# --------------------------------------------------------------------------
+# fused optimizer update
+# --------------------------------------------------------------------------
+
+def _adam_case(n=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(n).astype(np.float32)),
+            jnp.asarray(rng.randn(n).astype(np.float32)),
+            jnp.asarray(rng.randn(n).astype(np.float32) * 0.01),
+            jnp.abs(jnp.asarray(rng.randn(n).astype(np.float32))) * 0.01)
+
+
+@pytest.mark.parametrize("decoupled", [False, True])
+@pytest.mark.parametrize("clip", [-1.0, 0.5])
+def test_fused_adam_parity(decoupled, clip):
+    w, g, m, v = _adam_case()
+    kw = dict(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.01,
+              rescale_grad=0.5, clip_gradient=clip)
+    got = fu.adam_update(w, g, m, v, 0.003, decoupled_wd=decoupled, **kw)
+    ref = fu.adam_update_reference(w, g, m, v, 0.003,
+                                   decoupled_wd=decoupled,
+                                   **{k: kw[k] for k in
+                                      ("beta1", "beta2", "epsilon", "wd",
+                                       "rescale_grad", "clip_gradient")})
+    assert fu.engaged(w.size)
+    for a, b, name in zip(got, ref, ("w", "m", "v")):
+        assert a.dtype == b.dtype, name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-6, atol=1e-7, err_msg=name)
+
+
+def test_fused_adam_2d_shape_preserved():
+    w, g, m, v = (x.reshape(60, 50) for x in _adam_case())
+    got = fu.adam_update(w, g, m, v, 0.01)
+    assert all(o.shape == (60, 50) for o in got)
+
+
+def test_fused_adam_below_min_elements_falls_back(monkeypatch):
+    config.set("kernels_min_elements", 10_000)
+    assert not fu.engaged(3000)
+
+
+def test_fused_adam_multi_device_falls_back(monkeypatch):
+    # compiled (non-interpret) multi-device SPMD steps keep the XLA
+    # lowering — pallas_call has no GSPMD rule
+    monkeypatch.delenv("MXNET_TPU_PALLAS_INTERPRET", raising=False)
+    monkeypatch.setattr(_common, "multi_device", lambda: True)
+    monkeypatch.setattr(_common, "pallas_available", lambda: True)
+    assert not fu.engaged(3000)
+
+
+def test_fused_update_zero_shard_composition():
+    """The mx.zero composition contract: applying the kernel per flat
+    SHARD is bit-exact against the whole-vector kernel — the update is
+    row-local, so a reduce-scattered gradient + per-shard apply (what a
+    zero'd step runs) produces the same bytes as the replicated apply."""
+    D = 4
+    w, g, m, v = _adam_case(n=D * 1024)
+    whole = fu.adam_update(w, g, m, v, 0.01, wd=0.01)
+    shard = [
+        fu.adam_update(*(x.reshape(D, -1)[d] for x in (w, g, m, v)),
+                       0.01, wd=0.01)
+        for d in range(D)
+    ]
+    for i, name in enumerate(("w", "m", "v")):
+        merged = jnp.concatenate([s[i] for s in shard])
+        np.testing.assert_array_equal(np.asarray(whole[i]),
+                                      np.asarray(merged), err_msg=name)
+
+
+@pytest.mark.parametrize("mdt", ["float32", "bfloat16"])
+def test_fused_lamb_passes_parity(mdt):
+    """FusedLamb.apply_flat: kernels path vs the XLA path, both moment
+    storage dtypes, bias correction + clip + trust bounds live."""
+    from mxnet_tpu.parallel.fused_lamb import FusedLamb
+    rng = np.random.RandomState(1)
+    shapes = [(64, 32), (100,), (7, 13), ()]
+    fl = FusedLamb(shapes, [jnp.float32] * 4, wds=[0.01, 0.0, 0.01, 0.0],
+                   beta1=0.9, beta2=0.999, epsilon=1e-6,
+                   bias_correction=True, rescale_grad=1.0,
+                   clip_gradient=1.0, lower_bound=0.0, upper_bound=10.0,
+                   moments_dtype=mdt)
+
+    def rand(s):
+        return jnp.asarray(np.asarray(rng.randn(*s), np.float32))
+
+    w = fl.flatten([rand(s) for s in shapes])
+    g = fl.flatten([rand(s) for s in shapes])
+    m = jnp.zeros_like(w).astype(jnp.dtype(mdt))
+    v = jnp.zeros_like(w).astype(jnp.dtype(mdt))
+    config.set("kernels", "off")
+    ref = fl.apply_flat(w, g, m, v, jnp.float32(3.0), jnp.float32(0.01))
+    config.set("kernels", "auto")
+    got = fl.apply_flat(w, g, m, v, jnp.float32(3.0), jnp.float32(0.01))
+    for a, b, name in zip(got, ref, ("w", "m", "v")):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-6, atol=2e-7, err_msg=f"{mdt}/{name}")
+
+
+def test_trainer_adam_step_parity():
+    """End to end: a ShardedTrainer adam step with the kernel engaged
+    matches the kernels=off trajectory (losses to printed precision)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    parallel.make_mesh(dp=-1)
+
+    def run():
+        net = nn.Dense(16, in_units=32)
+        mx.random.seed(0)
+        net.initialize()
+        lfn = gloss.L2Loss()
+        tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "adam",
+                                     {"learning_rate": 0.01})
+        x = nd.array(np.random.RandomState(0).randn(8, 32)
+                     .astype(np.float32))
+        y = nd.array(np.zeros((8, 16), np.float32))
+        return [float(np.asarray(tr.step(x, y).asnumpy()))
+                for _ in range(4)]
+
+    config.set("kernels", "off")
+    off = run()
+    config.set("kernels", "auto")
+    on = run()
+    np.testing.assert_allclose(off, on, rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# MoE dispatch/combine
+# --------------------------------------------------------------------------
+
+def _moe_case(N=50, D=40, E=4, C=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    expert = jnp.asarray(rng.randint(0, E, N), jnp.int32)
+    # includes invalid (-1) and overflow (>= C) positions: both drop
+    pos = jnp.asarray(rng.randint(-1, C + 2, N), jnp.int32)
+    gate = jnp.asarray(rng.rand(N).astype(np.float32))
+    return x, expert, pos, gate, E, C
+
+
+def test_moe_dispatch_combine_parity():
+    x, expert, pos, gate, E, C = _moe_case()
+    buf = mk.dispatch_to_experts(x, expert, pos, E, C)
+    bref = mk.dispatch_reference(x, expert, pos, E, C)
+    np.testing.assert_allclose(np.asarray(buf), np.asarray(bref),
+                               rtol=1e-6, atol=1e-6)
+    y = mk.combine_from_experts(buf, expert, pos, gate)
+    yref = mk.combine_reference(bref, expert, pos, gate)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_moe_dispatch_gradient_parity():
+    x, expert, pos, gate, E, C = _moe_case()
+
+    def f(x_):
+        return jnp.sum(mk.dispatch_to_experts(x_, expert, pos, E, C) ** 2)
+
+    def fr(x_):
+        return jnp.sum(mk.dispatch_reference(x_, expert, pos, E, C) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(x)),
+                               np.asarray(jax.grad(fr)(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moe_combine_gradient_parity():
+    x, expert, pos, gate, E, C = _moe_case()
+    buf = mk.dispatch_reference(x, expert, pos, E, C)
+
+    def f(b_, g_):
+        return jnp.sum(mk.combine_from_experts(b_, expert, pos, g_) ** 2)
+
+    def fr(b_, g_):
+        return jnp.sum(mk.combine_reference(b_, expert, pos, g_) ** 2)
+
+    ga, gb = jax.grad(f, argnums=(0, 1))(buf, gate)
+    ra, rb = jax.grad(fr, argnums=(0, 1))(buf, gate)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_moe_ffn_kernel_path_matches_einsum_path():
+    """moe_ffn end to end (inside shard_map over a 1-extent ep axis):
+    the fused dispatch/combine path reproduces the one-hot einsum path,
+    forward and router/expert gradients. Slow-marked (grad through
+    shard_map + interpreter, ~11s): ci/run.sh sanity runs it with the
+    interpret kernel suite; tier-1 covers the same kernels via the
+    direct dispatch/combine parity + VJP tests above."""
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel import moe as moe_mod
+
+    rng = np.random.RandomState(0)
+    N, D, Fh, E = 32, 16, 24, 4
+    x = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    router = jnp.asarray(rng.randn(D, E).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(E, D, Fh).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(E, Fh, D).astype(np.float32) * 0.1)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+
+    def loss(x_, r_, w1_, w2_):
+        y, aux = moe_mod.moe_apply(x_, r_, w1_, w2_, mesh=mesh)
+        return jnp.sum(y ** 2) + aux
+
+    config.set("kernels", "off")
+    ref = loss(x, router, w1, w2)
+    ref_g = jax.grad(loss, argnums=(0, 1, 2))(x, router, w1, w2)
+    config.set("kernels", "auto")
+    assert mk.engaged()
+    got = loss(x, router, w1, w2)
+    got_g = jax.grad(loss, argnums=(0, 1, 2))(x, router, w1, w2)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    for a, b in zip(got_g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# mx.check graph lint over the traced kernels
+# --------------------------------------------------------------------------
+
+def _assert_lint_clean(name, fn, args):
+    from mxnet_tpu import check
+    check.reset()
+    config.set("check", "warn")
+    check.enable()
+    try:
+        jitted = jax.jit(fn)
+        check.check_jit(name, ("test_kernels", name), jitted, args)
+        assert check.findings() == [], check.findings()
+    finally:
+        check.disable()
+        config.reset("check")
+        check.reset()
+
+
+def test_check_lint_int8_kernel_clean():
+    x_q, w_q, s_x, w_scale, bias = _int8_case()
+    _assert_lint_clean(
+        "kernels.int8_matmul",
+        lambda *a: im.int8_matmul(*a, relu=True),
+        (x_q, w_q, s_x, w_scale, bias))
+
+
+def test_check_lint_fused_adam_clean():
+    w, g, m, v = _adam_case()
+    _assert_lint_clean(
+        "kernels.fused_adam",
+        lambda *a: fu.adam_update(*a, wd=0.01, clip_gradient=1.0),
+        (w, g, m, v, jnp.float32(0.01)))
+
+
+def test_check_lint_moe_kernels_clean():
+    x, expert, pos, gate, E, C = _moe_case()
+
+    def roundtrip(x_, e_, p_, g_):
+        buf = mk.dispatch_to_experts(x_, e_, p_, E, C)
+        return mk.combine_from_experts(buf, e_, p_, g_)
+
+    _assert_lint_clean("kernels.moe_dispatch_combine", roundtrip,
+                      (x, expert, pos, gate))
+
+
+# --------------------------------------------------------------------------
+# mx.inspect remediation hints
+# --------------------------------------------------------------------------
+
+def test_inspect_kernel_hint_names_applicable_kernel():
+    """A memory-bound roofline verdict carries the applicable
+    pallas_ops kernel (mirroring mx.check's degenerate-sharding rule
+    naming mx.zero); compute-bound and unknown verdicts carry none."""
+    from mxnet_tpu import inspect as mxi
+
+    def rec(name, flops, bytes_accessed):
+        r = mxi.CostRecord(name, "k")
+        r.flops = flops
+        r.bytes_accessed = bytes_accessed
+        return r
+
+    peak, bw = 100e12, 1e12          # ridge point at AI = 100
+    low = rec("serve.decode(bucket=64)", 1e9, 1e9)       # AI 1: mem-bound
+    assert low.roofline(peak, bw) == "memory-bound"
+    hint = low.kernel_hint() if low.roofline() == "memory-bound" else None
+    # drive via explicit peaks (CPU has none): patch the module lookups
+    import unittest.mock as mock
+    with mock.patch.object(mxi, "peak_flops_per_chip", lambda: peak), \
+            mock.patch.object(mxi, "peak_bandwidth_per_chip", lambda: bw):
+        assert "int8_matmul" in low.kernel_hint()
+        assert "moe_kernels" in rec("moe_ffn(block3)", 1e9,
+                                    1e9).kernel_hint()
+        assert "fused_update" in rec("sharded_step(net)", 1e9,
+                                     1e9).kernel_hint()
+        # unmatched names still get the generic library pointer
+        assert "pallas_ops" in rec("mystery_exec", 1e9, 1e9).kernel_hint()
+        # compute-bound: no hint
+        assert rec("serve.decode", 1e15, 1e9).kernel_hint() is None
+        # snapshot surface carries the hint field
+        d = low.as_dict()
+        assert "int8_matmul" in d["kernel_hint"]
+
+
+def test_inspect_report_renders_kernel_hint(tmp_path):
+    import json
+    import subprocess
+    import sys as _sys
+    import os as _os
+
+    snap = {
+        "backend": "TPU v5e",
+        "peak_flops_per_chip": 197e12,
+        "peak_bandwidth_per_chip": 819e9,
+        "largest_peak_bytes_executable": "serve.decode",
+        "records": [{
+            "name": "serve.decode", "key": "k", "compiles": 1,
+            "flops": 1e9, "bytes_accessed": 1e9, "peak_bytes": 1,
+            "steps": 1, "avg_step_s": 0.001, "roofline": "memory-bound",
+            "kernel_hint": "pallas_ops.int8_matmul via quantize_block",
+        }],
+    }
+    p = tmp_path / "inspect.json"
+    p.write_text(json.dumps(snap))
+    root = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    out = subprocess.run(
+        [_sys.executable, _os.path.join(root, "tools", "inspect_report.py"),
+         str(p)], capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "remediation: pallas_ops.int8_matmul" in out.stdout

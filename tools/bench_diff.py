@@ -40,6 +40,8 @@ HIGHER_BETTER = (
     "value", "tokens_per_sec", "requests_per_sec", "mfu",
     "achieved_tflops", "vs_baseline", "compile_cache_hit",
     "memory_headroom_bytes", "completed",
+    "int8_tokens_per_sec", "int8_requests_per_sec", "int8_completed",
+    "speedup",
 )
 #: numeric fields where a bigger number is a worse run
 LOWER_BETTER = (
@@ -47,7 +49,8 @@ LOWER_BETTER = (
     "input_stall_fraction", "peak_host_rss_mb", "ttft_p50_ms",
     "ttft_p99_ms", "step_skew_p99_ms", "deadline_missed", "shed",
     "rejected", "oom_recoveries", "check_findings", "requeues",
-    "degraded",
+    "degraded", "int8_ttft_p50_ms", "int8_ttft_p99_ms",
+    "pallas_ms", "xla_ms",
 )
 #: provenance fields that must MATCH for two rows to be comparable
 PROVENANCE = ("platform", "smoke_mode")

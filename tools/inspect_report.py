@@ -109,6 +109,11 @@ def report(path):
         if roof:
             perf += f"  [{roof}]"
         lines.append(perf)
+        hint = r.get("kernel_hint")
+        if hint:
+            # memory-bound verdicts carry the in-tree fix: which
+            # mx.kernels entry applies to this executable
+            lines.append(f"  remediation: {hint}")
         coll = r.get("collectives") or {}
         if coll:
             ops = ", ".join(f"{op} {fmt_bytes(b)}/step"

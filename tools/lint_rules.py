@@ -26,6 +26,13 @@ level so it fails the PR, not the pod:
     `datetime.now()` inside a function passed to `jax.jit`. The call
     runs ONCE at trace time and bakes a stale constant into the
     executable — the classic "why is my timestamp frozen" tracing bug.
+  * `pallas-call-outside-lib` — `pl.pallas_call` invoked anywhere but
+    `mxnet_tpu/pallas_ops/`. Every kernel must live in the mx.kernels
+    library: that is where the `kernels=off|auto|on` knob, the
+    bit-exact XLA fallback, the interpret-mode CPU test path, and the
+    bench_kernels coverage are enforced — a stray pallas_call
+    elsewhere has none of them (and silently breaks the kernels=off
+    no-pallas-import fast path ci sanity asserts).
 
 Suppress a finding inline with a `# mx.check: disable=<rule>` comment on
 the offending line. Stdlib-only; exits 1 when any finding survives.
@@ -81,7 +88,14 @@ RULES = {
                 "(invisible to the tsan-lite lock-order analysis)",
     "wallclock-in-jit": "wall-clock call inside a jitted function (runs "
                         "once at trace time, bakes a stale constant)",
+    "pallas-call-outside-lib": "direct pl.pallas_call outside "
+                               "mxnet_tpu/pallas_ops/ (kernels belong in "
+                               "the mx.kernels library: knob, fallback, "
+                               "interpret tests, bench coverage)",
 }
+
+#: the only package allowed to invoke pl.pallas_call
+PALLAS_HOME = os.path.join("mxnet_tpu", "pallas_ops") + os.sep
 
 
 class Finding:
@@ -289,8 +303,28 @@ def rule_wallclock_in_jit(path, tree, source):
     return out
 
 
+def rule_pallas_call_outside_lib(path, tree, source):
+    rel = os.path.relpath(path, REPO)
+    if rel.startswith(PALLAS_HOME):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted and dotted.split(".")[-1] == "pallas_call":
+            out.append(Finding(
+                "pallas-call-outside-lib", path, node.lineno,
+                f"`{dotted}(...)` outside mxnet_tpu/pallas_ops/: kernels "
+                "live in the mx.kernels library, behind the kernels knob "
+                "with an XLA fallback and an interpret-mode test — add "
+                "the kernel there and call its public entry point."))
+    return out
+
+
 ALL_RULES = (rule_shard_map_import, rule_signal_handler_blocking,
-             rule_raw_lock, rule_wallclock_in_jit)
+             rule_raw_lock, rule_wallclock_in_jit,
+             rule_pallas_call_outside_lib)
 
 
 # ---------------------------------------------------------------------------
