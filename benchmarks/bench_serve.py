@@ -5,7 +5,8 @@ server) against the continuous-batching scheduler.
 
 One JSON line:
   {"tokens_per_sec": ..., "requests_per_sec": ..., "ttft_p50_ms": ...,
-   "ttft_p99_ms": ..., "requests": ..., "completed": ..., "rejected":
+   "ttft_p99_ms": ..., "tbt_p99_ms": ..., "queue_share": ...,
+   "slo_violations": ..., "requests": ..., "completed": ..., "rejected":
    ..., "shed": ..., "deadline_missed": ..., "cancelled": ...,
    "degraded": ..., "requeues": ..., "slots": ..., "queue_depth": ...,
    "offered_rps": ..., "platform": ..., "devices": ..., "smoke_mode":
@@ -17,6 +18,16 @@ span from first submit to last completion; ttft is submit-to-first-
 token. Knobs via env: MXNET_TPU_BENCH_SERVE_REQUESTS / _RATE (req/s) /
 _DEADLINE_MS. CPU smoke mode (tiny model) when no TPU; GPT-2 117m bf16
 on the chip. Rides the persistent compile cache like every bench.
+
+mx.slo journals the measured window (MXNET_TPU_BENCH_SERVE_SLO=0 opts
+out; the three slo fields are then null): tbt_p99_ms is the p99 gap
+between consecutive generated tokens, queue_share the fraction of the
+per-phase budget (queue/prefill/decode/stream) spent waiting for a
+slot — mx.pages' future >=2x-TTFT gate reads its baseline from here —
+and slo_violations the objective violations under the armed slo_*
+knobs (all off by default: at the bench's low offered load the row
+contract asserts zero). MXNET_TPU_SLO_DIR persists the journal tail
+for tools/slo_report.py.
 
 `--int8` (or MXNET_TPU_BENCH_SERVE_INT8=1) additionally drives the SAME
 offered load through an int8-quantized copy of the model
@@ -59,8 +70,10 @@ def main():
     bench.enable_compile_cache()
 
     import mxnet_tpu as mx
-    from mxnet_tpu import parallel, serve
+    from mxnet_tpu import parallel, serve, slo
     from mxnet_tpu.models import gpt as gpt_mod
+
+    slo_on = os.environ.get("MXNET_TPU_BENCH_SERVE_SLO", "1") == "1"
 
     parallel.make_mesh(dp=-1)
     if on_tpu:
@@ -100,6 +113,13 @@ def main():
                           max_new_tokens=new_range[1])
         srv.drain()
         assert warm.state == serve.DONE
+        if slo_on:
+            # arm AFTER the warmup so the journaled window is the
+            # measured steady state, not the one-off compile; a fresh
+            # tracker per pass keeps fp and int8 rows independent
+            slo.disable()
+            slo.reset()
+            slo.enable()
 
         srv.start()
         reqs = []
@@ -124,6 +144,10 @@ def main():
         srv.stop()
 
         st = srv.stats()
+        snap = None
+        if slo_on:
+            snap = slo.snapshot()
+            slo.disable()       # appends the summary when SLO_DIR is set
         ttfts = sorted(r.ttft_s * 1e3 for r in reqs
                        if r.ttft_s is not None)
         done = [r for r in reqs if r.state == serve.DONE]
@@ -135,6 +159,11 @@ def main():
             if ttfts else None,
             "ttft_p99_ms": round(_percentile(ttfts, 99), 2)
             if ttfts else None,
+            "tbt_p99_ms": snap["tbt_p99_ms"] if snap else None,
+            "queue_share": (snap["phase_share"]["queue"]
+                            if snap else None),
+            "slo_violations": (sum(snap["violations"].values())
+                               if snap else None),
             "completed": len(done),
             "rejected": st["rejected"],
             "shed": st["shed"],

@@ -462,14 +462,75 @@ assert calls == {'dispatch': 0}, calls
 assert serve.dispatches() == 0, 'disabled fast path counted dispatches'
 print('serve disabled fast path OK (no decode-hook calls)')
 "
+    # slo must be disabled by default: a full request lifecycle through
+    # a real Server makes ZERO mx.slo hook calls and allocates no
+    # journal (the hook sites reduce to one module-bool check) — then
+    # the armed path's access.jsonl must honor the schema contract
+    # (meta line first, schema-versioned access records with the
+    # per-phase attribution, summary last)
+    JAX_PLATFORMS=cpu python -c "
+import json, os, shutil
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, serve, slo
+from mxnet_tpu.models import gpt as gpt_mod
+assert not slo.enabled(), 'slo must default to off'
+hooks = ('note_submit', 'note_admit', 'note_first_dispatch',
+         'note_token', 'note_event', 'note_stream_start',
+         'note_delivered', 'note_stream_end', 'note_finish')
+calls = {h: 0 for h in hooks}
+real = {h: getattr(slo, h) for h in hooks}
+for h in hooks:
+    setattr(slo, h, lambda *a, _h=h, **k: calls.__setitem__(_h, calls[_h] + 1))
+parallel.make_mesh(dp=-1)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+mx.random.seed(0); model.initialize()
+srv = serve.Server(model, slots=2)
+r = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+srv.drain()
+assert r.state == serve.DONE
+assert calls == {h: 0 for h in hooks}, calls
+assert r._slo_j is None, 'disabled fast path allocated a journal'
+for h in hooks:
+    setattr(slo, h, real[h])
+shutil.rmtree('/tmp/_ci_slo', ignore_errors=True)
+slo.enable(slo_dir='/tmp/_ci_slo', rank=0, sample_every=1)
+r2 = srv.submit(np.arange(5, dtype=np.int32), max_new_tokens=4)
+srv.drain()
+assert r2.state == serve.DONE
+slo.disable()
+recs = [json.loads(l) for l in open('/tmp/_ci_slo/0/access.jsonl')]
+kinds = [rec['kind'] for rec in recs]
+assert kinds[0] == 'meta' and 'access' in kinds and kinds[-1] == 'summary', kinds
+meta = recs[0]
+assert meta['schema'] == 1 and 'objectives' in meta and 'rank' in meta, meta
+acc = next(rec for rec in recs if rec['kind'] == 'access')
+for k in ('schema', 'rank', 'req', 'outcome', 'verdict', 'good',
+          'violations', 'why', 'prompt_len', 'requested_new',
+          'new_tokens', 'delivered', 'requeues', 'degraded', 'retries',
+          'queue_ms', 'prefill_ms', 'decode_ms', 'stream_ms', 'ttft_ms',
+          'tbt_max_ms', 'tbt_p99_ms', 'submit_us', 'timeline'):
+    assert k in acc, f'access record missing {k}: {sorted(acc)}'
+evs = [e['event'] for e in acc['timeline']]
+assert evs[0] == 'submit' and 'first_token' in evs and 'finish' in evs, evs
+ts = [e['t_ms'] for e in acc['timeline']]
+assert ts == sorted(ts), 'timeline must be monotone'
+summ = recs[-1]
+assert 'burn_rate' in summ and 'counts' in summ, sorted(summ)
+print('slo disabled fast path OK (zero hook calls) + access.jsonl schema OK')
+"
     # serving acceptance smoke (slow-marked out of the tier-1 sweep):
     # queue full + slow client + mid-generation cancel + deadline expiry
     # + forced memory rejection at admission — the scheduler never
     # raises, never dispatches a predicted-overrun batch, evicts expired
     # slots between decode steps, and every completed request's tokens
-    # are bit-identical to its unloaded single-request generation
+    # are bit-identical to its unloaded single-request generation; plus
+    # the mx.slo 2-rank overload acceptance: merged access logs must
+    # blame the QUEUE for the p99 TTFT and alert on the fast window
+    # first
     JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_serve.py::test_overload_acceptance_smoke \
+        tests/unittest/test_slo.py::test_two_rank_overload_smoke \
         -q -p no:cacheprovider
     # bench_serve row contract: the Poisson open-loop load generator
     # reports throughput, TTFT percentiles and every overload counter —
@@ -483,7 +544,8 @@ print('serve disabled fast path OK (no decode-hook calls)')
 import json
 d = json.load(open('/tmp/_bench_serve.json'))
 for k in ('tokens_per_sec', 'requests_per_sec', 'ttft_p50_ms',
-          'ttft_p99_ms', 'requests', 'completed', 'rejected', 'shed',
+          'ttft_p99_ms', 'tbt_p99_ms', 'queue_share', 'slo_violations',
+          'requests', 'completed', 'rejected', 'shed',
           'deadline_missed', 'cancelled', 'degraded', 'requeues',
           'slots', 'queue_depth', 'offered_rps', 'platform', 'devices',
           'smoke_mode'):
@@ -494,10 +556,18 @@ assert d['completed'] == d['requests'], \
     f'low-load smoke must complete everything: {d}'
 assert d['deadline_missed'] == 0, \
     f'low-load smoke must miss zero deadlines: {d}'
+# the mx.slo journal rode the measured window: the per-token gaps and
+# the phase attribution are populated, and at this low offered load no
+# objective fires (the slo_* knobs default off -> only availability can
+# violate, and everything completed)
+assert d['tbt_p99_ms'] is not None and d['tbt_p99_ms'] > 0, d
+assert d['queue_share'] is not None and 0.0 <= d['queue_share'] <= 1.0, d
+assert d['slo_violations'] == 0, \
+    f'low-load smoke must violate zero objectives: {d}'
 assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
 print('bench_serve contract OK:', {k: d[k] for k in
-      ('tokens_per_sec', 'ttft_p50_ms', 'ttft_p99_ms',
-       'requests_per_sec', 'deadline_missed')})
+      ('tokens_per_sec', 'ttft_p50_ms', 'ttft_p99_ms', 'tbt_p99_ms',
+       'queue_share', 'requests_per_sec', 'deadline_missed')})
 "
     # bench_kernels row contract: one row per pallas_ops kernel with
     # pallas-vs-XLA timing and the roofline verdicts; the CPU smoke runs

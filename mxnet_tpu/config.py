@@ -627,6 +627,70 @@ register_option(
     "model's max_length — either way a stream of novel request lengths "
     "compiles at most one step executable per bucket.")
 register_option(
+    "slo", "off", choices=("off", "on"),
+    doc="mx.slo per-request serving observability. 'off' (default) is "
+        "the zero-overhead fast path: every serve.py hook site "
+        "(submit, admit, dispatch, per-token emit, stream delivery, "
+        "degradation, terminal verdict) reduces to one module-bool "
+        "check — no journal object, no classification, zero "
+        "allocations (asserted by ci/run.sh sanity). 'on' journals "
+        "every request's event timeline, classifies each terminated "
+        "request against the slo_* objectives, feeds the multi-window "
+        "error-budget burn-rate gauges, and tail-samples full journals "
+        "into slo_dir/<rank>/access.jsonl (render them with "
+        "tools/slo_report.py). mx.slo.enable() arms at runtime.")
+register_option(
+    "slo_dir", "",
+    "Base directory for mx.slo exemplar journals: each rank appends "
+    "tail-sampled request journals, burn-rate alert records and a "
+    "summary line to <dir>/<rank>/access.jsonl (meta line first). "
+    "Empty (default) classifies and serves live stats only — nothing "
+    "is persisted.")
+register_option(
+    "slo_ttft_ms", 0.0,
+    "SLO objective: client-visible time-to-first-token budget per "
+    "request, in milliseconds (submit to first DELIVERED token when a "
+    "consumer streams, first generated token otherwise). A completed "
+    "request above the budget is classified bad and burns error "
+    "budget. 0 (default) disables the objective.")
+register_option(
+    "slo_tbt_ms", 0.0,
+    "SLO objective: worst time-between-tokens budget per request, in "
+    "milliseconds — the largest gap between consecutive generated "
+    "tokens (a requeue's replay pause counts: the client really "
+    "waited). 0 (default) disables the objective.")
+register_option(
+    "slo_availability", 0.999,
+    "SLO objective: target fraction of non-cancelled requests that "
+    "must terminate 'completed'. Rejected/shed/expired/failed "
+    "requests violate it; the error budget is 1 - target, and the "
+    "slo_burn_rate{window=} gauges report how fast classifications "
+    "are consuming it (1.0 = exactly sustainable).")
+register_option(
+    "slo_burn_alert", 2.0,
+    "Burn-rate alert threshold for mx.slo: when any window's error-"
+    "budget burn rate reaches this multiple of the sustainable rate, "
+    "an slo_alert telemetry event, a diagnostics flight-ring entry "
+    "and an access-log alert record fire (once per excursion, re-"
+    "armed when the window cools). The fast window reacts to a fresh "
+    "overload first; the slow window confirms it is sustained.")
+register_option(
+    "slo_window_fast_s", 300.0,
+    "Fast burn-rate window for mx.slo, in seconds (default 5 min): "
+    "spikes quickly on a fresh overload, forgets quickly once the "
+    "burst passes — the paging signal.")
+register_option(
+    "slo_window_slow_s", 3600.0,
+    "Slow burn-rate window for mx.slo, in seconds (default 1 h): "
+    "diluted by history, it confirms a burn is sustained rather than "
+    "a blip — the ticket signal.")
+register_option(
+    "slo_sample_every", 10,
+    "mx.slo healthy-exemplar sampling: persist every N-th classified "
+    "request's full journal to access.jsonl even when it met every "
+    "objective (bad, degraded and slower-than-running-p99 requests "
+    "always persist). 0 persists only the tail, no healthy baseline.")
+register_option(
     "scope", "off", choices=("off", "on"),
     doc="mx.scope live introspection. 'off' (default) is the "
         "zero-overhead fast path: the trainer step hook reduces to one "

@@ -339,6 +339,16 @@ def _serve_section():
     return out
 
 
+def _slo_section():
+    sl = sys.modules.get(__package__ + ".slo")
+    if sl is None or not sl._enabled:
+        return None
+    try:
+        return sl.snapshot()
+    except Exception as e:  # noqa: BLE001 - a section must not kill statusz
+        return {"error": str(e)}
+
+
 def statusz(state=None):
     """The one-rank gang-member view the aggregator merges: step +
     rate, flight-ring tail, memory headroom and active degradation
@@ -354,6 +364,7 @@ def statusz(state=None):
     out["memsafe"] = _memsafe_section()
     out["rungs"] = _rungs_section(state)
     out["serve"] = _serve_section()
+    out["slo"] = _slo_section()
     out["trace"] = _trace.skew_verdict()
     out["guard"] = _guard.snapshot() if _guard._enabled else None
     out["profile"] = state.profile_status()

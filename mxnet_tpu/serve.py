@@ -85,6 +85,7 @@ from . import diagnostics as _diagnostics
 from . import guard as _guard
 from . import memsafe as _memsafe
 from . import resilience as _resilience
+from . import slo as _slo
 from . import telemetry as _telemetry
 from . import trace as _trace
 
@@ -226,6 +227,7 @@ class Request:
         self.requeues = 0
         self.evicted_once = False         # each request triggers <= 1 evict
         self._streamed = 0                # replay high-water mark
+        self._slo_j = None                # mx.slo journal (None while off)
         self._rng = None
         self._stream_q = _pyqueue.Queue()
         self._done = threading.Event()
@@ -262,13 +264,23 @@ class Request:
                 print(f"mx.serve: fault injection: slow client — "
                       f"{arg} ms stall per streamed token (request "
                       f"{self.id})", file=sys.stderr)
-        while True:
-            tok = self._stream_q.get()
-            if tok is _EOS_SENTINEL:
-                return
-            if delay:
-                time.sleep(delay)
-            yield tok
+        if _slo._enabled and self._slo_j is not None:
+            _slo.note_stream_start(self)
+        try:
+            while True:
+                tok = self._stream_q.get()
+                if tok is _EOS_SENTINEL:
+                    return
+                if delay:
+                    time.sleep(delay)
+                if self._slo_j is not None:
+                    _slo.note_delivered(self)
+                yield tok
+        finally:
+            # sentinel, break or a GC'd generator: either way the
+            # delivery timeline is over — mx.slo can finalize
+            if self._slo_j is not None:
+                _slo.note_stream_end(self)
 
     @property
     def done(self):
@@ -450,6 +462,10 @@ class Server:
             self._seq += 1
             self._by_id[req.id] = req
             self._stats["submitted"] += 1
+            # journal BEFORE any admission verdict: rejected and shed
+            # requests are exactly the ones mx.slo must explain
+            if _slo._enabled:
+                _slo.note_submit(req)
             # a dead scheduler must fail fast, not enqueue a request no
             # thread will ever drive (the client would wedge in result())
             if self._error is not None:
@@ -945,6 +961,8 @@ class Server:
 
     def _note_degraded(self, action, req, extra):
         self._stats["degraded"] += 1
+        if _slo._enabled and req._slo_j is not None:
+            _slo.note_event(req, action, **extra)
         print(f"mx.serve: degradation ladder: {action} (request "
               f"{req.id}: {extra})", file=sys.stderr)
         if _telemetry._enabled:
@@ -970,6 +988,8 @@ class Server:
             pass
         req.state = RUNNING
         req._admit_perf = time.perf_counter()
+        if _slo._enabled and req._slo_j is not None:
+            _slo.note_admit(req, bucket)
         if _telemetry._enabled:
             _M_QWAIT.observe(req.queue_wait_s)
         if _trace._enabled:
@@ -1007,6 +1027,11 @@ class Server:
             lp = r.prompt.size
             tok[i] = r.prompt[p] if p < lp else r.tokens[p - lp]
             t[i] = p
+        if _slo._enabled:
+            for i in active:
+                r = grp.slots[i]
+                if r._slo_j is not None:
+                    _slo.note_first_dispatch(r)
         t0 = time.perf_counter()
         logits, new_state = self._dispatch(grp, jnp.asarray(tok),
                                            jnp.asarray(t))
@@ -1014,9 +1039,13 @@ class Server:
         lg = np.asarray(logits, np.float32)     # host fetch = the fence
         t1 = time.perf_counter()
         if _trace._enabled:
+            # request ids ride in the span args so mx.slo journals and
+            # trace spans join on one timeline
             _trace.record_span("serve.decode_step", t0, t1, cat="serve",
                                step=sched_step, bucket=grp.bucket,
-                               slots=len(active))
+                               slots=len(active),
+                               reqs=[grp.slots[i].id for i in active
+                                     if grp.slots[i] is not None])
         t_emit = time.perf_counter()
         with self._lock:
             self._stats["steps"] += 1
@@ -1055,6 +1084,12 @@ class Server:
         def on_retry(exc, attempt, delay):
             with self._lock:
                 self._stats["retries"] += 1
+                if _slo._enabled:
+                    for i in grp.active():
+                        r = grp.slots[i]
+                        if r is not None and r._slo_j is not None:
+                            _slo.note_event(r, "retry", attempt=attempt,
+                                            error=type(exc).__name__)
             print(f"mx.serve: retrying decode dispatch after "
                   f"{type(exc).__name__}: {exc} (attempt {attempt + 2}/"
                   f"{self._retry.max_attempts}, backoff {delay:.2f}s)",
@@ -1088,6 +1123,8 @@ class Server:
             _M_TOKENS.inc()
         if len(req.tokens) > req._streamed:
             req._streamed = len(req.tokens)
+            if _slo._enabled and req._slo_j is not None:
+                _slo.note_token(req)
             if req._first_token_perf is None:
                 req._first_token_perf = time.perf_counter()
                 if _telemetry._enabled:
@@ -1119,6 +1156,8 @@ class Server:
             if state != DONE:
                 _telemetry.event("serve", action="finish", req=req.id,
                                  state=state, verdict=verdict)
+        if _slo._enabled and req._slo_j is not None:
+            _slo.note_finish(req, self._OUTCOME[state], verdict)
         req._stream_q.put(_EOS_SENTINEL)
         req._done.set()
 

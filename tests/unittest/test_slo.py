@@ -1,0 +1,602 @@
+"""mx.slo tests: burn-rate window math under an injectable clock
+(budget exhaustion, once-per-excursion hysteresis, recovery re-arm,
+fast/slow multi-window disagreement), the per-request journal's derived
+phase timings and monotone timeline, SLO classification semantics
+(cancelled excluded, non-completed charge availability), the serve.py
+lifecycle integration end to end (access.jsonl meta/access/summary
+schema), the slo=off zero-overhead fast path, tools/slo_report.py's
+TTFT-thief attribution (stream under slow_client, queue under queued
+overload), the telemetry_report "slo:" section, the mx.scope /statusz
+section, and the 2-rank overload acceptance smoke."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import (config, parallel, resilience, scope, serve, slo,
+                       telemetry)
+from mxnet_tpu.models import gpt as gpt_mod
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+SLO_REPORT = os.path.join(ROOT, "tools", "slo_report.py")
+TELEMETRY_REPORT = os.path.join(ROOT, "tools", "telemetry_report.py")
+
+_VOCAB = 128
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    serve.disable()
+    resilience.uninstall()
+    slo.disable()
+    slo.reset()
+    telemetry.reset()
+    telemetry.disable()
+    config.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    parallel.make_mesh(dp=-1)
+    cfg = gpt_mod.gpt_tiny_config()
+    m = gpt_mod.GPTForCausalLM(cfg)
+    mx.random.seed(0)
+    m.initialize()
+    return m
+
+
+def _prompt(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, _VOCAB, (n,)).astype(np.int32)
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# BurnTracker window math (injectable clock)
+# ---------------------------------------------------------------------------
+
+def test_burn_tracker_burn_rate_math():
+    clk = _FakeClock(1000.0)
+    t = slo.BurnTracker(availability=0.9, windows=(("fast", 60.0),),
+                        alert=100.0, clock=clk)
+    for _ in range(9):
+        t.record(True)
+    rates = t.record(False)
+    # bad fraction 1/10 over a 0.1 budget: burning exactly sustainably
+    assert rates["fast"] == pytest.approx(1.0)
+    for _ in range(10):
+        rates = t.record(False)
+    assert rates["fast"] == pytest.approx((11 / 20) / 0.1)
+
+
+def test_burn_tracker_no_data_is_none_not_zero():
+    clk = _FakeClock(50.0)
+    t = slo.BurnTracker(windows=(("fast", 60.0),), clock=clk)
+    assert t.burn_rates() == {"fast": None}
+    t.record(True)
+    assert t.burn_rates()["fast"] == pytest.approx(0.0)
+    # all traffic ages out of the window: back to no-data, not "no burn"
+    clk.t += 1000.0
+    assert t.burn_rates() == {"fast": None}
+
+
+def test_burn_tracker_exhaustion_alerts_once_per_excursion():
+    clk = _FakeClock(0.0)
+    fired = []
+    t = slo.BurnTracker(availability=0.9, windows=(("fast", 60.0),),
+                        alert=2.0, clock=clk,
+                        on_alert=lambda w, b: fired.append((w, b)))
+    for _ in range(9):
+        t.record(True)
+    t.record(False)                 # burn 1.0: below threshold
+    assert fired == []
+    t.record(False)                 # 2/11 -> x1.8
+    assert fired == []
+    t.record(False)                 # 3/12 -> x2.5: the budget is gone
+    assert len(fired) == 1
+    assert fired[0][0] == "fast" and fired[0][1] >= 2.0
+    for _ in range(5):              # still burning: same excursion,
+        t.record(False)             # no alert storm
+    assert len(fired) == 1
+    assert t.alerts["fast"] == 1
+
+
+def test_burn_tracker_recovery_rearms_alert():
+    clk = _FakeClock(0.0)
+    fired = []
+    t = slo.BurnTracker(availability=0.9, windows=(("fast", 60.0),),
+                        alert=2.0, clock=clk,
+                        on_alert=lambda w, b: fired.append(w))
+    for _ in range(4):
+        t.record(False)             # 100% bad -> x10: alert #1
+    assert fired == ["fast"]
+    # the overload ends; healthy traffic in a fresh window cools the
+    # burn below threshold, re-arming the alert
+    clk.t += 120.0
+    for _ in range(10):
+        t.record(True)
+    assert t.burn_rates()["fast"] == pytest.approx(0.0)
+    # a second excursion must fire a second alert
+    for _ in range(10):
+        t.record(False)
+    assert fired == ["fast", "fast"]
+    assert t.alerts["fast"] == 2
+
+
+def test_burn_tracker_multi_window_disagreement():
+    """A fresh overload after an hour of health: the fast window burns
+    hot immediately while the slow window is still diluted by history —
+    only once the burn is SUSTAINED does the slow window confirm."""
+    clk = _FakeClock(0.0)
+    fired = []
+    t = slo.BurnTracker(availability=0.9,
+                        windows=(("fast", 300.0), ("slow", 3600.0)),
+                        alert=2.0, clock=clk,
+                        on_alert=lambda w, b: fired.append(w))
+    for i in range(120):            # an hour of healthy traffic
+        clk.t = i * 30.0
+        t.record(True)
+    assert fired == []
+    for i in range(8):              # a fresh burst of bad requests
+        clk.t = 3600.0 + i
+        t.record(False)
+    rates = t.burn_rates()
+    assert rates["fast"] >= 2.0     # fast window: mostly bad
+    assert rates["slow"] < 2.0      # slow window: diluted by the hour
+    assert fired == ["fast"]
+    # the burn sustains for ~40 minutes: now the slow window agrees
+    for i in range(80):
+        clk.t = 3610.0 + i * 30.0
+        t.record(False)
+    assert t.burn_rates()["slow"] >= 2.0
+    assert fired[0] == "fast" and "slow" in fired
+    assert fired.index("slow") > 0
+    assert t.alerts["slow"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Journal derived timings + classification
+# ---------------------------------------------------------------------------
+
+def _synthetic_journal():
+    j = slo.Journal("r-1", 100.0)
+    j.admit_pc = 100.050
+    j.dispatch_pc = 100.060
+    j.token_pcs = [100.080, 100.090, 100.105]
+    j.deliver_first_pc = 100.120
+    j.deliver_last_pc = 100.140
+    j.delivered = 3
+    j.events.append((100.095, "degraded", {"action": "shrink"}))
+    j.outcome = "completed"
+    j.verdict = "ok"
+    j.finish_pc = 100.106
+    return j
+
+
+def test_journal_phase_timings():
+    j = _synthetic_journal()
+    assert j.queue_ms() == pytest.approx(50.0)
+    assert j.prefill_ms() == pytest.approx(30.0)
+    assert j.decode_ms() == pytest.approx(25.0)
+    assert j.stream_ms() == pytest.approx(40.0)
+    # TTFT is CLIENT-visible: submit to first *delivery*
+    assert j.ttft_ms() == pytest.approx(120.0)
+    assert j.tbt_ms() == pytest.approx([10.0, 15.0])
+    # an unstreamed request falls back to first *generation*
+    j.deliver_first_pc = None
+    assert j.ttft_ms() == pytest.approx(80.0)
+    assert j.stream_ms() is None
+
+
+def test_journal_timeline_is_monotone_with_events():
+    j = _synthetic_journal()
+    j.bucket = 32
+    tl = j.timeline()
+    ts = [e["t_ms"] for e in tl]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    evs = [e["event"] for e in tl]
+    for ev in ("submit", "admit", "first_dispatch", "first_token",
+               "degraded", "finish", "first_delivery"):
+        assert ev in evs
+    admit = next(e for e in tl if e["event"] == "admit")
+    assert admit["bucket"] == 32
+    deg = next(e for e in tl if e["event"] == "degraded")
+    assert deg["action"] == "shrink"
+    fin = next(e for e in tl if e["event"] == "finish")
+    assert fin["outcome"] == "completed" and fin["verdict"] == "ok"
+
+
+def test_classification_semantics():
+    config.set("slo_ttft_ms", 100.0)
+    config.set("slo_tbt_ms", 12.0)
+    slo.enable(clock=_FakeClock())
+    good, viol = slo._classify(_synthetic_journal())
+    # ttft 120ms > 100ms AND worst tbt gap 15ms > 12ms: both objectives
+    assert good is False and viol == ["ttft", "tbt"]
+    fast = _synthetic_journal()
+    fast.deliver_first_pc = 100.090
+    fast.token_pcs = [100.080, 100.090, 100.095]
+    assert slo._classify(fast) == (True, [])
+    shed = slo.Journal("r-2", 100.0)
+    shed.outcome = "shed"
+    assert slo._classify(shed) == (False, ["availability"])
+    cancelled = slo.Journal("r-3", 100.0)
+    cancelled.outcome = "cancelled"
+    # the client's own doing: excluded from the error budget entirely
+    assert slo._classify(cancelled) == (None, [])
+
+
+def test_objectives_disabled_by_default():
+    slo.enable(clock=_FakeClock())
+    j = _synthetic_journal()        # slow, but no latency objective armed
+    assert slo._classify(j) == (True, [])
+    assert slo.objectives()["ttft_ms"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# serve.py lifecycle integration
+# ---------------------------------------------------------------------------
+
+def test_server_journals_end_to_end(model, tmp_path):
+    d = str(tmp_path / "slo")
+    slo.enable(slo_dir=d, rank=0, sample_every=1)
+    srv = serve.Server(model, slots=2)
+    r1 = srv.submit(_prompt(4), max_new_tokens=6)
+    r2 = srv.submit(_prompt(3, seed=1), max_new_tokens=5)
+    got = []
+    th = threading.Thread(target=lambda: got.extend(r2.stream()))
+    th.start()
+    srv.drain()
+    th.join(timeout=10)
+    assert r1.state == serve.DONE and r2.state == serve.DONE
+
+    j1 = r1._slo_j
+    assert j1 is not None and j1.finalized
+    assert j1.outcome == "completed"
+    assert len(j1.token_pcs) == len(r1.tokens)
+    assert j1.queue_ms() is not None and j1.ttft_ms() > 0
+    # the streamed request carries client-side delivery stamps
+    j2 = r2._slo_j
+    assert j2.delivered == len(r2.tokens) == len(got)
+    assert j2.deliver_first_pc is not None
+    assert j2.ttft_ms() >= (j2.token_pcs[0] - j2.submit_pc) * 1e3
+
+    snap = slo.snapshot()
+    assert snap["counts"] == {"completed": 2}
+    assert snap["classified"] == 2 and snap["violations"] == {}
+    assert snap["ttft_p99_ms"] > 0
+    assert snap["burn_rate"]["fast"] == pytest.approx(0.0)
+    assert snap["access_path"] == os.path.join(d, "0", "access.jsonl")
+
+    slo.disable()                   # appends the summary record
+    recs = [json.loads(ln) for ln in open(snap["access_path"])]
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "meta" and kinds[-1] == "summary"
+    assert kinds.count("access") == 2       # sample_every=1: both
+    meta = recs[0]
+    assert meta["schema"] == 1 and meta["rank"] == 0
+    assert meta["objectives"]["availability"] == pytest.approx(0.999)
+    acc = next(r for r in recs if r["kind"] == "access")
+    for key in ("req", "outcome", "verdict", "good", "violations", "why",
+                "prompt_len", "new_tokens", "queue_ms", "prefill_ms",
+                "decode_ms", "stream_ms", "ttft_ms", "tbt_max_ms",
+                "submit_us", "timeline"):
+        assert key in acc, key
+    assert acc["good"] is True and "sampled" in acc["why"]
+    evs = [e["event"] for e in acc["timeline"]]
+    assert evs[0] == "submit"
+    for ev in ("admit", "first_dispatch", "first_token", "finish"):
+        assert ev in evs
+    ts = [e["t_ms"] for e in acc["timeline"]]
+    assert ts == sorted(ts)
+    summ = recs[-1]
+    assert summ["classified"] == 2 and summ["counts"] == {"completed": 2}
+
+
+def test_rejected_requests_charge_availability(model):
+    slo.enable(sample_every=0)      # classify-only: no slo_dir
+    srv = serve.Server(model, slots=1, queue_depth=2, shed="reject")
+    reqs = [srv.submit(_prompt(3, seed=i), max_new_tokens=4)
+            for i in range(6)]
+    srv.drain()
+    shed = [r for r in reqs if r.state == serve.SHED]
+    assert shed                     # the bounded queue pushed back (503)
+    for r in shed:
+        j = r._slo_j
+        assert j.finalized and j.outcome == "shed"
+        assert j.admit_pc is None and j.ttft_ms() is None
+    snap = slo.snapshot()
+    assert snap["counts"]["shed"] == len(shed)
+    assert snap["violations"]["availability"] == len(shed)
+    # every rejection burns error budget against the 99.9% target
+    assert snap["burn_rate"]["fast"] > 1.0
+
+
+def test_slo_off_zero_overhead(model, monkeypatch):
+    """The production default: every serve.py hook site checks one
+    module bool and must never reach mx.slo (ci sanity re-asserts this
+    same contract on the CLI path)."""
+    calls = []
+    for name in ("note_submit", "note_admit", "note_first_dispatch",
+                 "note_token", "note_event", "note_stream_start",
+                 "note_delivered", "note_stream_end", "note_finish"):
+        monkeypatch.setattr(
+            slo, name, lambda *a, _n=name, **k: calls.append(_n))
+    assert not slo.enabled()
+    srv = serve.Server(model, slots=2)
+    r = srv.submit(_prompt(4), max_new_tokens=6)
+    got = []
+    th = threading.Thread(target=lambda: got.extend(r.stream()))
+    th.start()
+    srv.drain()
+    th.join(timeout=10)
+    assert r.state == serve.DONE and got == r.tokens
+    assert calls == []              # zero hook calls while disabled
+    assert r._slo_j is None         # zero allocations too
+
+
+def test_enable_mid_flight_requests_without_journal_are_safe(model):
+    """Requests submitted while disabled carry no journal; arming mx.slo
+    mid-flight must not crash their remaining lifecycle hooks."""
+    srv = serve.Server(model, slots=2)
+    r0 = srv.submit(_prompt(4), max_new_tokens=8)
+    slo.enable(sample_every=0)
+    r1 = srv.submit(_prompt(4, seed=1), max_new_tokens=4)
+    srv.drain()
+    assert r0.state == serve.DONE and r1.state == serve.DONE
+    assert r0._slo_j is None and r1._slo_j is not None
+    assert slo.snapshot()["classified"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tools/slo_report.py attribution
+# ---------------------------------------------------------------------------
+
+def test_slow_client_names_stream_as_ttft_thief(model, tmp_path):
+    """Under `slow_client`, the scheduler is healthy — the budget went
+    to DELIVERY. The report must blame the stream phase, which only the
+    client-visible TTFT can see."""
+    d = str(tmp_path / "slo")
+    srv = serve.Server(model, slots=2)
+    warm = srv.submit(_prompt(4), max_new_tokens=6)
+    srv.drain()
+    assert warm.state == serve.DONE
+    slo.enable(slo_dir=d, rank=0, sample_every=1)
+    config.set("fault_inject", "slow_client:150")
+    resilience.install()
+    r = srv.submit(_prompt(4, seed=2), max_new_tokens=6)
+    got = []
+    th = threading.Thread(target=lambda: got.extend(r.stream()))
+    th.start()
+    srv.drain()
+    th.join(timeout=20)
+    assert r.state == serve.DONE and got == r.tokens
+    j = r._slo_j
+    assert j.stream_ms() > 100.0    # the injected per-token stall
+    assert j.stream_ms() > (j.prefill_ms() or 0.0)
+    slo.disable()
+    out = subprocess.run([sys.executable, SLO_REPORT, d],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "TTFT thief: stream" in out.stdout
+
+
+def _write_rank(tmp_path, rank, n_access, queue_ms, alerts=(),
+                counts=None, violations=None, burn=None):
+    sub = tmp_path / str(rank)
+    sub.mkdir(parents=True, exist_ok=True)
+    lines = [{"kind": "meta", "schema": 1, "rank": rank,
+              "objectives": {"ttft_ms": 100.0, "tbt_ms": 0.0,
+                             "availability": 0.999}}]
+    for i in range(n_access):
+        ttft = queue_ms + 35.0 + i
+        lines.append({
+            "kind": "access", "schema": 1, "rank": rank,
+            "req": f"r{rank}-{i}", "outcome": "completed",
+            "verdict": "ok", "good": False, "violations": ["ttft"],
+            "why": ["slo:ttft"], "prompt_len": 8, "requested_new": 16,
+            "new_tokens": 16, "delivered": 16, "requeues": 0,
+            "degraded": None, "retries": 0,
+            "queue_ms": queue_ms, "prefill_ms": 20.0, "decode_ms": 10.0,
+            "stream_ms": 5.0, "ttft_ms": ttft, "tbt_max_ms": 2.0,
+            "tbt_p99_ms": 2.0, "submit_us": 1000.0 * i,
+            "timeline": [{"t_ms": 0.0, "event": "submit"},
+                         {"t_ms": queue_ms, "event": "admit",
+                          "bucket": 32},
+                         {"t_ms": ttft, "event": "first_token"}]})
+    for i, (window, burn_rate) in enumerate(alerts):
+        lines.append({"kind": "alert", "window": window,
+                      "burn": burn_rate, "ts_s": float(i),
+                      "wall": 1000.0 + i})
+    lines.append({"kind": "summary", "schema": 1, "rank": rank,
+                  "classified": sum((counts or {}).values()),
+                  "counts": counts or {}, "violations": violations or {},
+                  "burn_rate": burn or {},
+                  "objectives": {"ttft_ms": 100.0,
+                                 "availability": 0.999}})
+    with open(sub / "access.jsonl", "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_slo_report_synthetic_queue_overload(tmp_path):
+    _write_rank(tmp_path, 0, 3, queue_ms=400.0,
+                alerts=[("fast", 9.0), ("slow", 2.4)],
+                counts={"completed": 40, "rejected": 2},
+                violations={"ttft": 12, "availability": 2},
+                burn={"fast": 9.0, "slow": 2.4})
+    _write_rank(tmp_path, 1, 2, queue_ms=350.0,
+                counts={"completed": 30},
+                violations={"ttft": 5},
+                burn={"fast": 0.5, "slow": 0.2})
+    out = subprocess.run([sys.executable, SLO_REPORT, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    text = out.stdout
+    assert "2 rank(s)" in text and "5 journaled request(s)" in text
+    assert "objectives: ttft<=100ms availability>=0.999" in text
+    assert "requests: 72 classified" in text
+    assert "top violated objective: ttft" in text
+    assert "TTFT thief: queue" in text
+    assert "BURNING (x9.0 sustainable)" in text      # rank 0 fast window
+    assert "ok (x0.50 sustainable)" in text          # rank 1 fast window
+    assert "first alert: window=fast" in text
+    assert "worst exemplars:" in text
+
+
+def test_slo_report_explicit_file_and_torn_line(tmp_path):
+    _write_rank(tmp_path, 3, 1, queue_ms=10.0,
+                counts={"completed": 1})
+    path = tmp_path / "3" / "access.jsonl"
+    with open(path, "a") as f:
+        f.write('{"kind": "access", "truncated-by-a-cras')
+    out = subprocess.run([sys.executable, SLO_REPORT, str(path)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "1 rank(s)" in out.stdout        # rank from the meta line
+    assert "rank 3" in out.stdout
+    # no args: usage, non-zero
+    out = subprocess.run([sys.executable, SLO_REPORT],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report "slo:" section
+# ---------------------------------------------------------------------------
+
+def test_telemetry_report_renders_slo_section(tmp_path):
+    telemetry.enable()
+    c = telemetry.counter("slo_requests_total")
+    c.labels(verdict="good").inc(95)
+    c.labels(verdict="bad").inc(5)
+    g = telemetry.gauge("slo_burn_rate")
+    g.labels(window="fast").set(6.2)
+    g.labels(window="slow").set(0.8)
+    v = telemetry.counter("slo_violations_total")
+    v.labels(objective="ttft").inc(4)
+    v.labels(objective="availability").inc(1)
+    telemetry.counter("slo_alerts_total").labels(window="fast").inc(1)
+    telemetry.event("slo_alert", window="fast", burn=6.2)
+    path = tmp_path / "slo_run.jsonl"
+    telemetry.dump_jsonl(str(path))
+    r = subprocess.run([sys.executable, TELEMETRY_REPORT, str(path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "slo:" in out
+    assert "classified: 100 requests, 5 bad" in out
+    assert "worst window: fast (x6.20 the sustainable rate, "\
+           "budget burning)" in out
+    assert "top violated objective: ttft" in out
+    assert "alerts:     1 fired — first: window=fast burn=x6.20" in out
+
+
+def test_telemetry_report_omits_slo_when_nothing_classified(tmp_path):
+    telemetry.enable()
+    telemetry.event("step", dur_s=0.01)
+    path = tmp_path / "train_run.jsonl"
+    telemetry.dump_jsonl(str(path))
+    r = subprocess.run([sys.executable, TELEMETRY_REPORT, str(path)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "slo:" not in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# mx.scope /statusz section
+# ---------------------------------------------------------------------------
+
+def test_scope_statusz_slo_section():
+    assert scope._slo_section() is None     # disabled: no section
+    slo.enable(sample_every=0)
+    sec = scope._slo_section()
+    assert sec is not None and sec["enabled"] is True
+    assert "burn_rate" in sec and "counts" in sec
+    slo.disable()
+    assert scope._slo_section() is None
+
+
+# ---------------------------------------------------------------------------
+# 2-rank overload acceptance smoke
+# ---------------------------------------------------------------------------
+
+_WORKER_SRC = textwrap.dedent("""\
+    import sys
+    import numpy as np
+    rank, out_dir = int(sys.argv[1]), sys.argv[2]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, parallel, resilience, serve, slo
+    from mxnet_tpu.models import gpt as gpt_mod
+    parallel.make_mesh(dp=-1)
+    m = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+    mx.random.seed(0)
+    m.initialize()
+    rng = np.random.RandomState(100 + rank)
+    prompt = lambda: rng.randint(0, 128, (6,)).astype(np.int32)
+    srv = serve.Server(m, slots=1)
+    warm = srv.submit(prompt(), max_new_tokens=6)
+    srv.drain()
+    assert warm.state == serve.DONE
+    # armed AFTER the warmup: the journaled window is steady-state
+    config.set("slo_ttft_ms", 50.0)
+    slo.enable(slo_dir=out_dir, rank=rank, sample_every=1)
+    srv.on_burst = lambda n: [srv.submit(prompt(), max_new_tokens=6)
+                              for _ in range(n)]
+    config.set("fault_inject", "burst:4")
+    resilience.install()
+    reqs = [srv.submit(prompt(), max_new_tokens=6) for _ in range(8)]
+    srv.drain()
+    slo.disable()
+    done = sum(r.state == serve.DONE for r in reqs)
+    assert done == len(reqs), (done, len(reqs))
+    print("WORKER_OK", rank, done)
+""")
+
+
+@pytest.mark.slow
+def test_two_rank_overload_smoke(tmp_path):
+    """Acceptance: two ranks under queued overload (slots=1 + a burst
+    fault), merged offline — the report must blame the QUEUE for the
+    p99 TTFT and show the fast burn window alerting first."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER_SRC)
+    d = tmp_path / "slo"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(rk), str(d)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=ROOT, env=env) for rk in (0, 1)]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o
+        assert "WORKER_OK" in o
+    r = subprocess.run([sys.executable, SLO_REPORT, str(d)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    text = r.stdout
+    assert "2 rank(s)" in text
+    # the tail's budget went to slot contention, not compute or client
+    assert "TTFT thief: queue" in text
+    # the overload burned the budget: the fast window reacted first
+    assert "BURNING" in text
+    assert "first alert: window=fast" in text

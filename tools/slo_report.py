@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Offline SLO report over mx.slo access logs (stdlib only — runs where
+the serving gang ran, no jax, no framework import).
+
+    python tools/slo_report.py SLO_DIR [SLO_DIR2 ...]
+    python tools/slo_report.py path/to/access.jsonl
+
+Reads every rank's `<dir>/<rank>/access.jsonl` (meta line first, then
+tail-sampled request journals, burn-rate alert records and summary
+lines) and renders:
+
+  * per-outcome latency breakdown — request counts, client-visible
+    TTFT percentiles and mean per-phase attribution (queue / prefill /
+    decode / stream) per terminal outcome;
+  * the p99-TTFT attribution — over the slowest tail of journaled
+    requests, which phase ate the budget (the "TTFT thief");
+  * the SLO verdict per burn window (fast / slow) from each rank's
+    last summary record, plus the alert history in firing order;
+  * the worst exemplar timelines, rendered event by event.
+
+Exemplars are TAIL-sampled (bad / degraded / slow-p99 / 1-in-N), so
+per-outcome stats here describe the journaled tail plus the healthy
+sample — the summary records carry the complete counts.
+"""
+import json
+import os
+import sys
+
+
+def discover(paths):
+    """[(rank, file)] from directories laid out as <dir>/<rank>/
+    access.jsonl, or explicit .jsonl files (rank from the meta line)."""
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append((None, p))
+            continue
+        if not os.path.isdir(p):
+            continue
+        for name in sorted(os.listdir(p)):
+            sub = os.path.join(p, name)
+            f = os.path.join(sub, "access.jsonl")
+            if name.isdigit() and os.path.isfile(f):
+                out.append((int(name), f))
+    return out
+
+
+def load(path):
+    """Records from one access.jsonl (a torn final line is skipped)."""
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    return recs
+
+
+def _percentile(vals, q):
+    if not vals:
+        return None
+    s = sorted(vals)
+    idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
+    return s[idx]
+
+
+def _fmt(v, unit="ms"):
+    if v is None:
+        return "-"
+    return f"{v:.1f}{unit}"
+
+
+PHASES = ("queue", "prefill", "decode", "stream")
+
+
+def _mean_phases(accs):
+    """Mean per-phase milliseconds over journaled requests (a phase a
+    request never entered contributes 0 — the budget went elsewhere)."""
+    if not accs:
+        return {}
+    out = {}
+    for ph in PHASES:
+        out[ph] = sum(a.get(f"{ph}_ms") or 0.0 for a in accs) / len(accs)
+    return out
+
+
+def ttft_thief(accs, tail_frac=0.10):
+    """(phase, share, mean_phase_ms) over the slowest `tail_frac` of
+    journaled requests by client-visible TTFT — which phase the p99
+    tail actually spent its budget in."""
+    with_ttft = sorted((a for a in accs if a.get("ttft_ms") is not None),
+                       key=lambda a: a["ttft_ms"])
+    if not with_ttft:
+        return None
+    n = max(1, int(round(len(with_ttft) * tail_frac)))
+    tail = with_ttft[-n:]
+    means = _mean_phases(tail)
+    total = sum(means.values())
+    if total <= 0:
+        return None
+    thief = max(means, key=lambda ph: means[ph])
+    return thief, means[thief] / total, means
+
+
+def _verdict(burn):
+    if burn is None:
+        return "no data"
+    if burn >= 1.0:
+        return f"BURNING (x{burn:.1f} sustainable)"
+    return f"ok (x{burn:.2f} sustainable)"
+
+
+def report(ranks):
+    """`ranks` is {rank: [records]}; returns the rendered text."""
+    lines = []
+    metas = {}
+    accs = []
+    alerts = []
+    summaries = {}      # rank -> last summary
+    for rank, recs in sorted(ranks.items()):
+        for r in recs:
+            kind = r.get("kind")
+            if kind == "meta":
+                metas.setdefault(rank, r)
+            elif kind == "access":
+                accs.append(r)
+            elif kind == "alert":
+                alerts.append((rank, r))
+            elif kind == "summary":
+                summaries[rank] = r
+    lines.append(f"slo report: {len(ranks)} rank(s), "
+                 f"{len(accs)} journaled request(s), "
+                 f"{len(alerts)} alert(s)")
+    obj = next((m.get("objectives") for m in metas.values()
+                if m.get("objectives")), None) \
+        or next((s.get("objectives") for s in summaries.values()), {})
+    if obj:
+        parts = []
+        if obj.get("ttft_ms"):
+            parts.append(f"ttft<={obj['ttft_ms']:g}ms")
+        if obj.get("tbt_ms"):
+            parts.append(f"tbt<={obj['tbt_ms']:g}ms")
+        if obj.get("availability"):
+            parts.append(f"availability>={obj['availability']:g}")
+        lines.append("objectives: " + (" ".join(parts) or "(none armed)"))
+
+    # complete per-outcome counts from the summaries (the access records
+    # are only the sampled tail)
+    counts = {}
+    viol = {}
+    for s in summaries.values():
+        for k, v in (s.get("counts") or {}).items():
+            counts[k] = counts.get(k, 0) + int(v)
+        for k, v in (s.get("violations") or {}).items():
+            viol[k] = viol.get(k, 0) + int(v)
+    if counts:
+        total = sum(counts.values())
+        by = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        lines.append(f"requests: {total} classified — {by}")
+    if viol:
+        top = max(viol, key=lambda k: viol[k])
+        by = " ".join(f"{k}={v}" for k, v in sorted(viol.items()))
+        lines.append(f"violations: {by} — top violated objective: {top}")
+
+    # per-outcome latency breakdown over the journaled tail
+    if accs:
+        lines.append("")
+        lines.append("journaled tail by outcome "
+                     "(client TTFT; mean phase attribution):")
+        by_outcome = {}
+        for a in accs:
+            by_outcome.setdefault(a.get("outcome") or "?", []).append(a)
+        for outcome in sorted(by_outcome):
+            group = by_outcome[outcome]
+            ttfts = [a["ttft_ms"] for a in group
+                     if a.get("ttft_ms") is not None]
+            means = _mean_phases(group)
+            attr = " ".join(f"{ph}={_fmt(means.get(ph))}"
+                            for ph in PHASES)
+            lines.append(
+                f"  {outcome:<10} n={len(group):<4} "
+                f"ttft p50={_fmt(_percentile(ttfts, 50))} "
+                f"p99={_fmt(_percentile(ttfts, 99))}  {attr}")
+
+        thief = ttft_thief(accs)
+        if thief is not None:
+            ph, share, means = thief
+            attr = " ".join(
+                f"{p}={100.0 * means[p] / max(1e-9, sum(means.values())):.0f}%"
+                for p in PHASES)
+            lines.append("")
+            lines.append(f"p99 TTFT attribution ({attr})")
+            lines.append(f"TTFT thief: {ph} ({share * 100.0:.0f}% of the "
+                         "slow tail's budget)")
+
+    # window verdicts from each rank's last summary
+    if summaries:
+        lines.append("")
+        lines.append("error-budget windows:")
+        for rank in sorted(summaries):
+            s = summaries[rank]
+            burns = s.get("burn_rate") or {}
+            per = "  ".join(f"{w}: {_verdict(burns.get(w))}"
+                            for w in sorted(burns))
+            lines.append(f"  rank {rank}: {per or 'no windows'}")
+    if alerts:
+        lines.append("alerts (firing order):")
+        ordered = sorted(alerts, key=lambda ra: ra[1].get("wall") or 0)
+        for rank, a in ordered[:8]:
+            lines.append(f"  rank {rank}: window={a.get('window')} "
+                         f"burn={a.get('burn')}")
+        first = ordered[0][1]
+        lines.append(f"first alert: window={first.get('window')} "
+                     f"burn={first.get('burn')}")
+
+    # worst exemplars, timeline by timeline
+    worst = sorted((a for a in accs if a.get("ttft_ms") is not None),
+                   key=lambda a: (a.get("good") is not False,
+                                  -(a.get("ttft_ms") or 0)))[:3]
+    if worst:
+        lines.append("")
+        lines.append("worst exemplars:")
+        for a in worst:
+            why = ",".join(a.get("why") or [])
+            lines.append(
+                f"  rank {a.get('rank')} req {a.get('req')} "
+                f"[{a.get('outcome')}] ttft={_fmt(a.get('ttft_ms'))} "
+                f"tbt_max={_fmt(a.get('tbt_max_ms'))} ({why})")
+            for ev in (a.get("timeline") or [])[:12]:
+                extra = {k: v for k, v in ev.items()
+                         if k not in ("t_ms", "event")}
+                tail = f" {extra}" if extra else ""
+                lines.append(f"    {ev.get('t_ms', 0.0):>10.1f}ms  "
+                             f"{ev.get('event')}{tail}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    paths = [a for a in argv if not a.startswith("-")]
+    if not paths:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: slo_report.py SLO_DIR|access.jsonl ...",
+              file=sys.stderr)
+        return 2
+    files = discover(paths)
+    if not files:
+        print(f"no access.jsonl found under {paths}", file=sys.stderr)
+        return 1
+    ranks = {}
+    for rank, path in files:
+        recs = load(path)
+        if rank is None:
+            meta = next((r for r in recs if r.get("kind") == "meta"), {})
+            rank = int(meta.get("rank", len(ranks)))
+        ranks.setdefault(rank, []).extend(recs)
+    print(report(ranks))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -17,7 +17,10 @@ executables by device memory, flops / arithmetic intensity / roofline, MFU
 against the recorded per-chip peak, estimated collective-traffic share,
 and a one-line input/comm/compute-bound verdict), a "serve:" section when
 the run served traffic (requests by outcome, token throughput, TTFT and
-queue-wait p50/p99, shed/deadline-miss/degradation counts), collective/
+queue-wait p50/p99, shed/deadline-miss/degradation counts), an "slo:"
+section when mx.slo classified requests (good/bad counts, error-budget
+burn rate per window with the worst window named, the top violated
+objective, alert history), collective/
 kvstore bytes moved, and the input-stall fraction (time blocked on the
 input pipeline as a share of run time) — the triage order for a slow TPU
 training run: recompiling? input-bound? comms-bound? only then look at
@@ -221,6 +224,54 @@ def _serve_section(events, snapshot):
     return lines
 
 
+def _slo_section(events, snapshot):
+    """The "slo:" lines (mx.slo's error-budget view of the same serving
+    run): good/bad classifications, burn rate per window with the worst
+    window called out, the top violated objective, and the alert
+    history. Empty when nothing was classified — importing mx.slo
+    registers zero-valued series, and a run that never served must not
+    grow a phantom SLO section."""
+    verdicts = _label_values(snapshot, "slo_requests_total")
+    classified = sum(verdicts.values())
+    alerts = [e for e in events if e.get("kind") == "slo_alert"]
+    if not classified and not alerts:
+        return []
+    lines = ["slo:"]
+    bad = sum(v for k, v in verdicts.items() if '"bad"' in k)
+    lines.append(f"  classified: {int(classified)} requests, "
+                 f"{int(bad)} bad")
+    burns = _label_values(snapshot, "slo_burn_rate")
+    if burns:
+        per = ", ".join(
+            f"{k.split('=')[-1].strip(chr(34) + '{}')} x{v:.2f}"
+            for k, v in sorted(burns.items()))
+        worst = max(burns, key=lambda k: burns[k])
+        worst_name = worst.split('=')[-1].strip(chr(34) + '{}')
+        lines.append(f"  burn rate:  {per} — worst window: {worst_name} "
+                     f"(x{burns[worst]:.2f} the sustainable rate"
+                     + (", budget burning)" if burns[worst] >= 1.0
+                        else ")"))
+    viol = _label_values(snapshot, "slo_violations_total")
+    viol = {k: v for k, v in viol.items() if v}
+    if viol:
+        top = max(viol, key=lambda k: viol[k])
+        top_name = top.split('=')[-1].strip(chr(34) + '{}')
+        by = ", ".join(
+            f"{k.split('=')[-1].strip(chr(34) + '{}')} {int(v)}"
+            for k, v in sorted(viol.items()))
+        lines.append(f"  violations: {by} — top violated objective: "
+                     f"{top_name}")
+    n_alerts = _metric_sum(snapshot, "slo_alerts_total")
+    if alerts or n_alerts:
+        first = alerts[0] if alerts else None
+        line = f"  alerts:     {int(n_alerts or len(alerts))} fired"
+        if first is not None:
+            line += (f" — first: window={first.get('window')} "
+                     f"burn=x{first.get('burn', 0):.2f}")
+        lines.append(line)
+    return lines
+
+
 def report(path, label=None, data=None):
     events, snapshot = data if data is not None else load(path)
     title = f"telemetry report: {path}" if label is None \
@@ -272,6 +323,9 @@ def report(path, label=None, data=None):
 
     # -- serving (mx.serve serve_* series) --------------------------------
     lines.extend(_serve_section(events, snapshot))
+
+    # -- SLO error budget (mx.slo slo_* series) ---------------------------
+    lines.extend(_slo_section(events, snapshot))
 
     # -- comms ------------------------------------------------------------
     coll = _label_values(snapshot, "collective_bytes_total")
