@@ -627,18 +627,27 @@ def main():
     if on_tpu:
         acquire_bench_lock()
         enable_compile_cache()
-    print(json.dumps(run_bench(on_tpu)), flush=True)
+    row = run_bench(on_tpu)
+    print(json.dumps(row), flush=True)
+    from benchmarks import _provenance
+    _provenance.ledger_append("bench.py", [row])
 
 
 if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # never exit non-zero without the JSON line
-        print(json.dumps({
+        crash_row = {
             "metric": METRIC, "value": 0.0, "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
             # a crashed run reported no real platform: mark it smoke so
             # the trajectory never compares it against TPU rows
             "platform": None, "devices": None, "smoke_mode": True,
             "error": f"{type(e).__name__}: {e}"[:500],
-        }), flush=True)
+        }
+        print(json.dumps(crash_row), flush=True)
+        try:
+            from benchmarks import _provenance
+            _provenance.ledger_append("bench.py", [crash_row])
+        except Exception:
+            pass            # the crash row on stdout is the contract

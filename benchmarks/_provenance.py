@@ -1,0 +1,55 @@
+"""Shared bench provenance + mx.ledger glue.
+
+PR 11 gave bench.py's rows the platform / devices / smoke_mode
+provenance triple so tools/bench_diff.py could refuse cross-platform
+comparisons; this helper factors that contract so ALL eight bench
+entrypoints emit it identically, and adds the mx.ledger hook: when
+`ledger_dir` is armed each bench appends one provenance-keyed run
+record to the cross-run ledger. Off is the zero-overhead fast path —
+one bool check, zero record_run calls (asserted by ci/run.sh).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def provenance_fields(on_tpu=None, platform=None, devices=None,
+                      smoke_mode=None):
+    """The three contract fields every bench row carries. jax must
+    already be pinned to its final platform (subprocess probe first,
+    clear_backends + cpu on the fallback path) before calling this —
+    or pass platform/devices explicitly to stay jax-free."""
+    if platform is None or devices is None:
+        import jax
+        if platform is None:
+            platform = jax.default_backend()
+        if devices is None:
+            devices = len(jax.devices())
+    if smoke_mode is None:
+        smoke_mode = not (on_tpu if on_tpu is not None
+                          else platform == "tpu")
+    return {"platform": platform, "devices": devices,
+            "smoke_mode": bool(smoke_mode)}
+
+
+def annotate(rows, fields=None, **kwargs):
+    """Stamp the contract fields onto every row; existing values win
+    (a row that already says where it was measured is not rewritten)."""
+    if fields is None:
+        fields = provenance_fields(**kwargs)
+    for row in rows:
+        for k, v in fields.items():
+            row.setdefault(k, v)
+    return rows
+
+
+def ledger_append(bench, rows, **extra):
+    """The bench-side mx.ledger hook: one run record per invocation.
+    With the ledger off (`ledger_dir` unset) this is one module-bool
+    check and ZERO record_run calls — the ci-asserted fast path."""
+    from mxnet_tpu import ledger
+    if not ledger.enabled():
+        return None
+    return ledger.record_run(bench, rows, **extra)

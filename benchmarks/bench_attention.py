@@ -44,13 +44,30 @@ def attn_flops(B, H, L, D, causal):
     return f / 2 if causal else f
 
 
+def _cpu_bail():
+    # no TPU: pin the cpu backend BEFORE touching jax (in-process TPU
+    # init hangs when the tunnel is down), then emit the error row with
+    # the full provenance contract so the trajectory records the miss
+    import jax
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    from benchmarks import _provenance
+    row = {"error": "needs a TPU backend"}
+    _provenance.annotate([row], on_tpu=False)
+    print(json.dumps(row))
+    _provenance.ledger_append("bench_attention", [row])
+
+
 def main():
     # probe in a killable SUBPROCESS and take the bench flock BEFORE any
     # in-process backend init: attaching a second live TPU client while a
     # lock holder is timing is exactly what the lock exists to prevent
     import bench
-    if not bench.probe_tpu():
-        print(json.dumps({"error": "needs a TPU backend"}))
+    on_tpu = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
+    if not on_tpu:
+        _cpu_bail()
         return
     bench.acquire_bench_lock()
 
@@ -59,15 +76,23 @@ def main():
     import numpy as np
 
     if jax.default_backend() != "tpu":
-        print(json.dumps({"error": "needs a TPU backend"}))
+        _cpu_bail()
         return
 
     from mxnet_tpu.pallas_ops.flash_attention import flash_attention
     from mxnet_tpu import config
+    from benchmarks import _provenance
+
+    rows = []
+    prov = _provenance.provenance_fields(on_tpu=True)
+
+    def emit(row):
+        row.update(prov)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
 
     ceiling = measure_ceiling(jnp, jax)
-    print(json.dumps({"matmul_ceiling_tflops": round(ceiling / 1e12, 1)}),
-          flush=True)
+    emit({"matmul_ceiling_tflops": round(ceiling / 1e12, 1)})
 
     B, H, D = 8, 12, 64
     config.set("pallas_bwd_min_len", 1)   # always the Pallas backward
@@ -96,14 +121,14 @@ def main():
             fence(g[0][:1, :1, :1, :1].astype(jnp.float32))
             t_fb = (time.perf_counter() - t0) / max(2, reps // 3)
             f_fwd = attn_flops(B, H, L, D, causal)
-            print(json.dumps({
+            emit({
                 "config": f"L={L}{'c' if causal else ''}",
                 "fwd_ms": round(t_fwd * 1e3, 2),
                 "fwdbwd_ms": round(t_fb * 1e3, 2),
                 "fwd_tflops": round(f_fwd / t_fwd / 1e12, 1),
                 "fwd_mxu_eff": round(f_fwd / t_fwd / ceiling, 3),
                 "fwdbwd_mxu_eff": round(3.5 * f_fwd / t_fb / ceiling, 3),
-            }), flush=True)
+            })
 
     # fused LAMB at BERT-base scale
     from mxnet_tpu.parallel.fused_lamb import FusedLamb
@@ -127,11 +152,12 @@ def main():
         w2, m2, v2 = step(w2, gbuf, m2, v2, t, lr)
     fence(w2[:1])
     dt = (time.perf_counter() - t0) / reps
-    print(json.dumps({
+    emit({
         "lamb_apply_ms": round(dt * 1e3, 2),
         "lamb_n_params_M": round(N / 1e6, 1),
         "lamb_eff_gbps": round(10 * N * 4 / dt / 1e9, 1),
-    }), flush=True)
+    })
+    _provenance.ledger_append("bench_attention", rows)
 
 
 if __name__ == "__main__":

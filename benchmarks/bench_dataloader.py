@@ -27,7 +27,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np
 
 
-def make_rec(tmp, n=512, h=256, w=256, seed=0):
+# ci's contract check shrinks the workload via env; defaults unchanged
+_N_IMAGES = int(os.environ.get("MXNET_TPU_BENCH_DL_IMAGES", "512"))
+_MIN_ITER = int(os.environ.get("MXNET_TPU_BENCH_DL_MIN", "600"))
+_MIN_DL = int(os.environ.get("MXNET_TPU_BENCH_DL_MIN_DL", "256"))
+
+
+def make_rec(tmp, n=_N_IMAGES, h=256, w=256, seed=0):
     from PIL import Image
     from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack
 
@@ -44,7 +50,7 @@ def make_rec(tmp, n=512, h=256, w=256, seed=0):
     return prefix
 
 
-def time_iter(make, batch_size, min_images=600):
+def time_iter(make, batch_size, min_images=_MIN_ITER):
     it = make()
     n, t0 = 0, time.perf_counter()
     while n < min_images:
@@ -58,10 +64,19 @@ def time_iter(make, batch_size, min_images=600):
 
 
 def main():
+    # this is a HOST benchmark (jax pinned to cpu either way), but the
+    # provenance contract still wants to know whether a real TPU host
+    # fed by this pipeline was behind it: probe in a subprocess like
+    # every other bench (MXNET_TPU_BENCH_FORCE_CPU=1 skips the probe)
+    import bench
+    on_tpu_host = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     from mxnet_tpu.io import ImageRecordIter
+    from benchmarks import _provenance
 
     batch = 64
     shape = (3, 224, 224)
@@ -90,8 +105,9 @@ def main():
         from mxnet_tpu.gluon.data.vision import transforms as T
 
         rng = np.random.RandomState(0)
-        imgs = rng.randint(0, 255, (512, 256, 256, 3), np.uint8)
-        labels = rng.randint(0, 10, (512,)).astype(np.float32)
+        n_ds = max(_N_IMAGES, batch)
+        imgs = rng.randint(0, 255, (n_ds, 256, 256, 3), np.uint8)
+        labels = rng.randint(0, 10, (n_ds,)).astype(np.float32)
         from mxnet_tpu import nd
 
         ds = ArrayDataset(imgs, labels)
@@ -100,10 +116,10 @@ def main():
 
         def rate_of(dl):
             n, t0 = 0, time.perf_counter()
-            while n < 256:
+            while n < _MIN_DL:
                 for x, y in dl:
                     n += x.shape[0]
-                    if n >= 256:
+                    if n >= _MIN_DL:
                         break
             return round(n / (time.perf_counter() - t0), 1)
 
@@ -126,7 +142,9 @@ def main():
 
         out["dataloader_w1_procs"] = dl_rate_procs(1)
         out["dataloader_w8_procs"] = dl_rate_procs(8)
+    _provenance.annotate([out], on_tpu=on_tpu_host)
     print(json.dumps(out), flush=True)
+    _provenance.ledger_append("bench_dataloader", [out])
 
 
 if __name__ == "__main__":

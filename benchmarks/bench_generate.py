@@ -52,6 +52,7 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
     from mxnet_tpu.models import gpt as gpt_mod
+    from benchmarks import _provenance
 
     parallel.make_mesh(dp=-1)
     if on_tpu:
@@ -67,6 +68,8 @@ def main():
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, cfg["vocab_size"], (B, Lp)).astype(np.int32)
 
+    prov = _provenance.provenance_fields(on_tpu=on_tpu)
+    rows = []
     for path, on_device in (("on_device", True), ("host_loop", False)):
         model.generate(prompt, max_new_tokens=N, on_device=on_device)  # warm
         t0 = time.perf_counter()
@@ -76,17 +79,18 @@ def main():
         dt = (time.perf_counter() - t0) / reps
         assert out.shape == (B, N)
         dispatches = 1 if on_device else Lp + N
-        print(json.dumps({
+        row = {
             "path": path,
             "tokens_per_sec": round(B * N / dt, 1),
             "ms_per_dispatch": round(dt / dispatches * 1e3, 3),
             "dispatches": dispatches,
             "batch": B, "prompt": Lp, "new": N,
             "backend": jax.default_backend(),
-            "platform": jax.default_backend(),
-            "devices": len(jax.devices()),
-            "smoke_mode": not on_tpu,
-        }), flush=True)
+        }
+        row.update(prov)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    _provenance.ledger_append("bench_generate", rows)
 
 
 if __name__ == "__main__":

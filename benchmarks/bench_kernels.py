@@ -101,15 +101,14 @@ def main():
     fu = importlib.import_module("mxnet_tpu.pallas_ops.fused_update")
     mk = importlib.import_module("mxnet_tpu.pallas_ops.moe_kernels")
 
+    from benchmarks import _provenance
+
     reps = 20 if on_tpu else 2
     interp = _interp_ctx(on_tpu)
-    provenance = {
-        "platform": jax.default_backend(),
-        "devices": len(jax.devices()),
-        "smoke_mode": not on_tpu,
-    }
+    provenance = _provenance.provenance_fields(on_tpu=on_tpu)
     config.set("kernels_min_elements", 1)
     rng = np.random.RandomState(0)
+    rows = []
 
     def emit(name, shape, xla_fn, xla_args, pallas_fn, pallas_args):
         config.set("kernels", "off")
@@ -132,6 +131,7 @@ def main():
             "shape": shape,
         }
         row.update(provenance)
+        rows.append(row)
         print(json.dumps(row), flush=True)
 
     # -- int8 serving matmul ------------------------------------------
@@ -186,6 +186,7 @@ def main():
     emit("moe_dispatch_combine", f"N{N}xD{D}xE{E}xC{C}",
          roundtrip_ref, (x, expert, pos, gate),
          roundtrip_pallas, (x, expert, pos, gate))
+    _provenance.ledger_append("bench_kernels", rows)
 
 
 if __name__ == "__main__":

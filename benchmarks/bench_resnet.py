@@ -2,7 +2,9 @@
 """ResNet-50 training throughput, images/sec/chip — the second
 BASELINE.json metric (GluonCV ResNet-50). Same shape as bench.py: one
 jitted sharded train step, bf16 compute, SGD+momentum, synthetic ImageNet
-batches. Prints ONE JSON line.
+batches. Prints ONE JSON line carrying the platform/devices/smoke_mode
+provenance contract (benchmarks/_provenance.py); appends a run record
+to the mx.ledger when `ledger_dir` is armed.
 """
 import json
 import os
@@ -14,18 +16,31 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def main():
+    # probe in a killable subprocess BEFORE any in-process backend init
+    # (jax.default_backend() hangs forever when the tunnel is down)
+    import bench
+    on_tpu = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
+    if on_tpu:
+        bench.acquire_bench_lock()
+
     import jax
     import numpy as np
+
+    if not on_tpu:
+        from jax.extend.backend import clear_backends
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.models import resnet as resnet_mod
+    from benchmarks import _provenance
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
     parallel.make_mesh(dp=-1)
-    on_tpu = backend == "tpu"
     if on_tpu:
         batch, size, steps, warmup = 128, 224, 20, 4
     else:
@@ -69,12 +84,15 @@ def main():
                 .get("resnet50_images_per_sec_per_chip")
     except Exception:
         pass
-    print(json.dumps({
+    row = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/s/chip",
         "vs_baseline": round(per_chip / baseline, 4) if baseline else 1.0,
-    }))
+    }
+    _provenance.annotate([row], on_tpu=on_tpu)
+    print(json.dumps(row))
+    _provenance.ledger_append("bench_resnet", [row])
 
 
 if __name__ == "__main__":

@@ -173,6 +173,8 @@ def main():
             "requeues": st["requeues"],
         }
 
+    from benchmarks import _provenance
+
     srv, stats = run_load(model)
     row = dict(stats)
     row.update({
@@ -180,10 +182,8 @@ def main():
         "slots": slots,
         "queue_depth": srv._queue_depth,
         "offered_rps": round(rate, 2),
-        "platform": jax.default_backend(),
-        "devices": len(jax.devices()),
-        "smoke_mode": not on_tpu,
     })
+    row.update(_provenance.provenance_fields(on_tpu=on_tpu))
 
     int8 = "--int8" in sys.argv[1:] \
         or os.environ.get("MXNET_TPU_BENCH_SERVE_INT8") == "1"
@@ -205,6 +205,7 @@ def main():
             "int8_completed": qstats["completed"],
         })
     print(json.dumps(row), flush=True)
+    _provenance.ledger_append("bench_serve", [row])
 
 
 if __name__ == "__main__":

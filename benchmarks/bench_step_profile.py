@@ -59,7 +59,8 @@ def main():
     # jax.default_backend() in-process would start the axon plugin's init,
     # which hangs forever when the tunnel is down (bench.py's probe trick).
     import bench
-    on_tpu = bench.probe_tpu()
+    on_tpu = bench.probe_tpu() \
+        if os.environ.get("MXNET_TPU_BENCH_FORCE_CPU") != "1" else False
     if on_tpu:
         bench.acquire_bench_lock()
 
@@ -213,14 +214,18 @@ def main():
         / 1e3 + t_heads + t_lamb
     row("unattributed", (t_full - attributed) * 1e3)
 
+    from benchmarks import _provenance
+    prov = _provenance.provenance_fields(on_tpu=on_tpu)
     for r in rows:
         r["frac_of_step"] = round(
             r["ms"] * (nl if r["phase"] in ("attn_fwdbwd", "layer_fwdbwd")
                        else 1) / (t_full * 1e3), 3)
         r["backend"] = jax.default_backend()
+        r.update(prov)
         if r["phase"] in ("attn_fwdbwd", "layer_fwdbwd"):
             r["note"] = f"x{nl} layers -> frac_of_step"
         print(json.dumps(r), flush=True)
+    _provenance.ledger_append("bench_step_profile", rows)
 
 
 if __name__ == "__main__":

@@ -722,3 +722,22 @@ register_option(
     "then has an empty ring); stands down in the gluon Trainer while a "
     "scaling AMP loss scaler is attached, whose overflow-skip handles "
     "Inf grads as routine. Costs one device sync per step.")
+register_option(
+    "ledger_dir", "",
+    "Base directory for the mx.ledger cross-run performance ledger: "
+    "every bench entrypoint and the ci tier-1 sweep append one "
+    "provenance-keyed record per run to <dir>/ledger.jsonl (append-"
+    "only, torn-line tolerant). Empty (default) is the zero-overhead "
+    "fast path — every hook site reduces to one module-bool check and "
+    "makes zero record calls (asserted by ci/run.sh). Render, "
+    "backfill and gate the history with tools/ledger_report.py.")
+register_option(
+    "ledger_gate", "error", choices=("warn", "error"),
+    doc="mx.ledger trend-gate severity for ci/run.sh's ledger stage: "
+        "'error' (default) exits nonzero when the drift detector "
+        "CONFIRMS a regression in a like-provenance metric series "
+        "(same platform, device count, smoke flag and config "
+        "fingerprint — CPU-smoke history never gates a TPU number); "
+        "'warn' reports the same verdicts but always exits zero. "
+        "Smoke-mode series and unconfirmed 'suspect' drifts only ever "
+        "warn, whatever this knob says.")

@@ -104,6 +104,9 @@ def test_beam_score_at_least_greedy():
     assert (s_b > s_g + 1e-3).any(), "beam never found a better sequence"
 
 
+# ~20s on the 1-core sweep box (mx.ledger tier-1 budget record);
+# ci/run.sh train runs tests/train unfiltered, so still covered
+@pytest.mark.slow
 def test_decode_sees_updated_weights():
     """The shape-keyed jitted step must re-read parameters per call: decode,
     train more, decode again with the SAME geometry — output must reflect

@@ -10,10 +10,16 @@ import pytest
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                     os.pardir, os.pardir))
 
+# the two heaviest scripts (~25s each on the 1-core sweep box, per the
+# mx.ledger tier-1 budget record) are slow-marked out of the tier-1
+# filter; ci/run.sh train runs tests/train unfiltered so they stay
+# covered every CI pass
 CASES = [
-    ("image_classification/train_cifar10.py",
-     ["--model", "mobilenet0.25", "--epochs", "1", "--batch-size", "32",
-      "--steps-per-epoch", "3"], "epoch 0"),
+    pytest.param(
+        "image_classification/train_cifar10.py",
+        ["--model", "mobilenet0.25", "--epochs", "1", "--batch-size",
+         "32", "--steps-per-epoch", "3"], "epoch 0",
+        marks=pytest.mark.slow),
     ("bert/pretrain.py",
      ["--config", "tiny", "--batch-size", "8", "--seq-len", "32",
       "--steps", "3"], "step 3"),
@@ -31,8 +37,10 @@ CASES = [
     ("nmt/train_transformer.py",
      ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
       "--units", "32"], "decode token accuracy"),
-    ("detection/train_yolo.py",
-     ["--steps", "4", "--batch-size", "4"], "VOC07 mAP"),
+    pytest.param(
+        "detection/train_yolo.py",
+        ["--steps", "4", "--batch-size", "4"], "VOC07 mAP",
+        marks=pytest.mark.slow),
     ("timeseries/train_deepar.py",
      ["--epochs", "10", "--series", "8", "--samples", "5"], "CRPS"),
     ("module_api/train_mnist_module.py",
@@ -42,8 +50,9 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("script,args,expect",
-                         CASES, ids=[c[0] for c in CASES])
+@pytest.mark.parametrize(
+    "script,args,expect", CASES,
+    ids=[(c.values if hasattr(c, "values") else c)[0] for c in CASES])
 def test_example_runs(script, args, expect):
     # JAX_PLATFORMS=cpu alone is NOT enough on this image — the baked axon
     # plugin re-registers itself and backend init hangs probing the TPU

@@ -1,0 +1,379 @@
+#!/usr/bin/env python
+"""Offline report / backfill / gate over the mx.ledger run history
+(stdlib only — loads mxnet_tpu/ledger.py by file path, no jax, no
+framework import; runs anywhere the ledger directory is readable).
+
+    python tools/ledger_report.py [DIR]                  # trajectory report
+    python tools/ledger_report.py [DIR] --gate           # trend gate
+    python tools/ledger_report.py [DIR] --import BENCH_r*.json ...
+    python tools/ledger_report.py [DIR] --record-tier1 LOG --wall SECONDS
+
+DIR defaults to $MXNET_TPU_LEDGER_DIR. The report renders one
+trajectory table per bench — metric series grouped STRICTLY by
+like-provenance (platform, device count, smoke flag, config
+fingerprint: a CPU-smoke row never shares a sparkline with a TPU row),
+each with a sparkline, the latest value, and the drift verdict naming
+the first bad run — plus the TPU anchor rows (the newest real-hardware
+number per metric) and the ci tier-1 time-budget burn line (warns
+above 85% of the 870 s sweep timeout).
+
+`--import` backfills driver artifacts (BENCH_r01..r05.json /
+MULTICHIP_r01..r05.json): bench rows are recovered from the recorded
+`tail`/`parsed` fields, provenance reconstructed from the rows
+themselves (explicit post-PR-11 fields, the 'CPU smoke-mode' error
+annotation, or the `# backend=... devices=...` stderr marker for the
+pre-PR-11 TPU run). Idempotent: a source file already in the ledger is
+skipped, so re-importing is free.
+
+`--gate` exit codes (ci/run.sh ledger stage): 0 clean or warn-only,
+1 confirmed like-provenance regression on real (non-smoke) hardware,
+2 nothing had enough history to judge. MXNET_TPU_LEDGER_GATE=warn
+downgrades rc 1 to 0 (verdicts still print). Smoke-mode series and
+unconfirmed 'suspect' drifts always warn rather than fail.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import re
+import sys
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _load_ledger_mod():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "mxnet_tpu", "ledger.py")
+    spec = importlib.util.spec_from_file_location("mx_ledger", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ledger = _load_ledger_mod()
+
+
+def sparkline(values):
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi == lo:
+        return SPARK[3] * len(values)
+    return "".join(SPARK[int((v - lo) / (hi - lo) * (len(SPARK) - 1))]
+                   for v in values)
+
+
+# ---------------------------------------------------------------------------
+# backfill import
+# ---------------------------------------------------------------------------
+
+def _rows_from_tail(artifact):
+    rows = []
+    for line in (artifact.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    if not rows and isinstance(artifact.get("parsed"), dict):
+        rows = [artifact["parsed"]]
+    return rows
+
+
+def _marker_provenance(tail):
+    """platform/devices from the bench's `# backend=tpu devices=1 ...`
+    stderr marker — the only provenance a pre-PR-11 TPU row left."""
+    m = re.search(r"#\s*backend=(\w+)\s+devices=(\d+)", tail or "")
+    if not m:
+        return None, None
+    return m.group(1), int(m.group(2))
+
+
+def import_artifact(path, ledger_path, existing_sources):
+    """One driver artifact -> one ledger record. Returns the record, or
+    None when the source is already in the ledger (idempotence) or the
+    file is not a recognized artifact."""
+    source = os.path.basename(path)
+    if source in existing_sources:
+        return None
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, ValueError):
+        return None
+    ts = os.path.getmtime(path)
+    name = source.upper()
+    if name.startswith("MULTICHIP"):
+        tail = artifact.get("tail") or ""
+        row = {"metric": "multichip_dryrun",
+               "ok": bool(artifact.get("ok")),
+               "rc": artifact.get("rc"),
+               "skipped": bool(artifact.get("skipped"))}
+        prov = ledger.build_provenance(
+            platform="cpu", devices=artifact.get("n_devices"),
+            smoke_mode=True, rev=None, fingerprint=None, knobs=None)
+        rec = ledger.build_run_record(
+            "multichip_dryrun", [row], provenance=prov, ts=ts,
+            source=source)
+    elif name.startswith("BENCH"):
+        rows = _rows_from_tail(artifact)
+        platform, devices, smoke = ledger.provenance_of_rows(rows)
+        if platform is None and rows:
+            platform, devices = _marker_provenance(artifact.get("tail"))
+            if platform is not None and smoke is None:
+                smoke = platform != "tpu"
+        if not rows:
+            # a crashed run (rc != 0, no JSON row): keep the hole in the
+            # trajectory visible, but with unknown-smoke provenance so
+            # it can never pair with a real series
+            tail_lines = [ln for ln in
+                          (artifact.get("tail") or "").splitlines()
+                          if ln.strip()]
+            rows = [{"error": (tail_lines[-1][:200] if tail_lines
+                               else "no output"),
+                     "smoke_mode": True}]
+            platform, devices, smoke = None, None, True
+        prov = ledger.build_provenance(
+            platform=platform, devices=devices, smoke_mode=smoke,
+            rev=None, fingerprint=None, knobs=None)
+        rec = ledger.build_run_record(
+            "bench.py", rows, provenance=prov, ts=ts, source=source)
+    else:
+        return None
+    ledger.append_record(ledger_path, rec)
+    existing_sources.add(source)
+    return rec
+
+
+def do_import(files, ledger_path):
+    existing = {r.get("source") for r in ledger.read_records(ledger_path)
+                if r.get("source")}
+
+    def order(p):
+        m = re.search(r"r(\d+)", os.path.basename(p))
+        return (os.path.basename(p).split("_")[0],
+                int(m.group(1)) if m else 0)
+
+    imported = skipped = 0
+    for path in sorted(files, key=order):
+        rec = import_artifact(path, ledger_path, existing)
+        if rec is None:
+            skipped += 1
+        else:
+            imported += 1
+            prov = rec["provenance"]
+            print(f"imported {os.path.basename(path)}: "
+                  f"{len(rec['rows'])} row(s), platform="
+                  f"{prov['platform']} devices={prov['devices']} "
+                  f"smoke={prov['smoke_mode']}")
+    print(f"import done: {imported} imported, {skipped} skipped "
+          f"(already present or unrecognized)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 recording
+# ---------------------------------------------------------------------------
+
+_SUMMARY_RE = re.compile(r"(\d+)\s+(passed|failed|error(?:s)?|skipped)")
+_DURATION_RE = re.compile(
+    r"^([0-9.]+)s\s+(?:call|setup|teardown)\s+(\S+)")
+
+
+def parse_pytest_log(text):
+    """(passed, failed, errors, skipped, slowest) from a pytest run's
+    output — the summary tallies plus the --durations section."""
+    passed = failed = errors = skipped = 0
+    for line in text.splitlines():
+        if " in " in line and ("passed" in line or "failed" in line
+                               or "error" in line):
+            for n, what in _SUMMARY_RE.findall(line):
+                if what == "passed":
+                    passed = int(n)
+                elif what == "failed":
+                    failed = int(n)
+                elif what.startswith("error"):
+                    errors = int(n)
+                elif what == "skipped":
+                    skipped = int(n)
+    slowest = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line.strip())
+        if m:
+            slowest.append((m.group(2), float(m.group(1))))
+    slowest.sort(key=lambda x: -x[1])
+    return passed, failed, errors, skipped, slowest[:10]
+
+
+def do_record_tier1(log_path, wall_s, budget_s, ledger_path):
+    with open(log_path, errors="replace") as f:
+        text = f.read()
+    passed, failed, errors, skipped, slowest = parse_pytest_log(text)
+    rec = ledger.build_tier1_record(
+        wall_s, passed, failed, errors=errors, skipped=skipped,
+        slowest=slowest, budget_s=budget_s)
+    ledger.append_record(ledger_path, rec)
+    pct = 100.0 * wall_s / budget_s if budget_s else 0.0
+    print(f"tier-1 recorded: {passed} passed, {failed} failed, "
+          f"{errors} errors, wall {wall_s:.0f}s / {budget_s:.0f}s "
+          f"budget ({pct:.0f}%)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _fmt_val(v):
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    if abs(v) >= 10:
+        return f"{v:.1f}"
+    return f"{v:.3f}"
+
+
+def render_report(records, out=sys.stdout):
+    w = out.write
+    runs = [r for r in records if r.get("kind") in ("run", "tier1")]
+    w(f"mx.ledger report — {len(runs)} run record(s)\n")
+    if not runs:
+        w("  (empty ledger: nothing appended yet)\n")
+        return
+
+    all_series = ledger.series(records)
+    by_key = {}
+    for (key, metric), pts in sorted(all_series.items()):
+        by_key.setdefault(key, []).append((metric, pts))
+
+    for key, metrics in sorted(by_key.items()):
+        w(f"\n[{key}]\n")
+        name_w = max(len(m) for m, _ in metrics)
+        for metric, pts in metrics:
+            vals = [p["value"] for p in pts]
+            v = ledger.verdict(pts, ledger.higher_is_better(metric))
+            tag = v["status"]
+            if v["first_bad"]:
+                tag += f" (first bad: {v['first_bad']['label']})"
+            w(f"  {metric:<{name_w}}  n={len(vals):<3d} "
+              f"last={_fmt_val(vals[-1]):>12}  {sparkline(vals):<16} "
+              f"{tag}\n")
+
+    # the anchors: newest real-hardware (non-smoke, known-platform) value
+    anchors = []
+    for (key, metric), pts in sorted(all_series.items()):
+        if "|smoke=False|" not in key or "platform=tpu" not in key:
+            continue
+        anchors.append((metric, pts[-1]))
+    if anchors:
+        w("\nTPU anchors (newest real-hardware rows — the numbers that "
+          "matter):\n")
+        for metric, p in anchors:
+            w(f"  {metric} = {_fmt_val(p['value'])}  [{p['label']}]\n")
+
+    # tier-1 budget burn
+    tier1 = [r for r in records if r.get("kind") == "tier1"]
+    if tier1:
+        t = tier1[-1]
+        budget = t.get("budget_s") or ledger.TIER1_BUDGET_S
+        wall = t.get("wall_s", 0.0)
+        pct = 100.0 * wall / budget if budget else 0.0
+        w(f"\ntier-1 budget burn: {wall:.0f}s / {budget:.0f}s "
+          f"({pct:.0f}%) — {t.get('passed', 0)} passed, "
+          f"{t.get('failed', 0)} failed, {t.get('errors', 0)} errors\n")
+        if pct > 85.0:
+            w("  WARNING: sweep exceeds 85% of the timeout budget — "
+              "slow-mark or split tests before the driver starts "
+              "killing the sweep\n")
+        for name, secs in (t.get("slowest") or [])[:5]:
+            w(f"    {secs:7.2f}s  {name}\n")
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+def do_gate(records, out=sys.stdout):
+    w = out.write
+    rc, findings = ledger.gate(records)
+    if rc == 2:
+        w("ledger gate: nothing to judge yet (no like-provenance "
+          "series with enough history)\n")
+        return 2
+    for f in findings:
+        fb = f.get("first_bad") or {}
+        where = f" first bad run: {fb.get('label')}" if fb else ""
+        detail = f.get("detail") or {}
+        move = (f" ({detail.get('rel', 0) * 100:.0f}% worse than the "
+                f"window median {_fmt_val(detail.get('median', 0))})"
+                if detail.get("rel") is not None else "")
+        if f["severity"] == "fail":
+            w(f"CONFIRMED regression: {f['metric']}{move}{where}\n"
+              f"  series: {f['key']}\n")
+        else:
+            why = "smoke-mode provenance" if "|smoke=True" in f["key"] \
+                else f["status"]
+            w(f"warn ({why}): {f['metric']} {f['status']}{move}"
+              f"{where}\n  series: {f['key']}\n")
+    if rc == 1 and os.environ.get("MXNET_TPU_LEDGER_GATE") == "warn":
+        w("ledger gate: confirmed regression DOWNGRADED to warning "
+          "(MXNET_TPU_LEDGER_GATE=warn)\n")
+        return 0
+    if rc == 0:
+        w(f"ledger gate: clean ({len(findings)} warning(s))\n")
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="mx.ledger trajectory report / backfill / gate")
+    ap.add_argument("dir", nargs="?",
+                    default=os.environ.get("MXNET_TPU_LEDGER_DIR"),
+                    help="ledger directory (default: "
+                         "$MXNET_TPU_LEDGER_DIR)")
+    ap.add_argument("--import", dest="imports", nargs="+", default=None,
+                    metavar="FILE",
+                    help="backfill driver artifacts (BENCH_r*.json / "
+                         "MULTICHIP_r*.json) into the ledger")
+    ap.add_argument("--gate", action="store_true",
+                    help="judge every like-provenance series; exit 1 on "
+                         "a confirmed non-smoke regression")
+    ap.add_argument("--record-tier1", metavar="LOG", default=None,
+                    help="parse a tier-1 pytest log and append the "
+                         "time-budget record")
+    ap.add_argument("--wall", type=float, default=None,
+                    help="tier-1 sweep wall seconds (with "
+                         "--record-tier1)")
+    ap.add_argument("--budget", type=float,
+                    default=ledger.TIER1_BUDGET_S,
+                    help="tier-1 timeout budget seconds (default 870)")
+    args = ap.parse_args(argv)
+
+    if not args.dir:
+        ap.error("no ledger directory: pass DIR or set "
+                 "MXNET_TPU_LEDGER_DIR")
+    path = ledger.ledger_path(args.dir)
+
+    if args.imports is not None:
+        return do_import(args.imports, path)
+    if args.record_tier1 is not None:
+        if args.wall is None:
+            ap.error("--record-tier1 needs --wall SECONDS")
+        return do_record_tier1(args.record_tier1, args.wall,
+                               args.budget, path)
+    records = ledger.read_records(path)
+    if args.gate:
+        return do_gate(records)
+    render_report(records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
