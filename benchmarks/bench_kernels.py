@@ -100,6 +100,7 @@ def main():
     im = importlib.import_module("mxnet_tpu.pallas_ops.int8_matmul")
     fu = importlib.import_module("mxnet_tpu.pallas_ops.fused_update")
     mk = importlib.import_module("mxnet_tpu.pallas_ops.moe_kernels")
+    pa = importlib.import_module("mxnet_tpu.pallas_ops.paged_attention")
 
     from benchmarks import _provenance
 
@@ -186,6 +187,21 @@ def main():
     emit("moe_dispatch_combine", f"N{N}xD{D}xE{E}xC{C}",
          roundtrip_ref, (x, expert, pos, gate),
          roundtrip_pallas, (x, expert, pos, gate))
+
+    # -- paged decode attention (mx.pages serving hot loop) ------------
+    B, H, D, ps, n_pg = (32, 16, 128, 16, 128) if on_tpu \
+        else (4, 4, 16, 8, 4)
+    P = B * n_pg + 1
+    q = jnp.asarray(rng.randn(B, H, 1, D).astype(np.float32))
+    k_pg = jnp.asarray(rng.randn(P, H, ps, D).astype(np.float32))
+    v_pg = jnp.asarray(rng.randn(P, H, ps, D).astype(np.float32))
+    tables = jnp.asarray(
+        rng.permutation(P - 1)[: B * n_pg].reshape(B, n_pg) + 1,
+        jnp.int32)
+    t = jnp.asarray(rng.randint(0, n_pg * ps, B), jnp.int32)
+    emit("paged_attention", f"B{B}xH{H}xD{D}xps{ps}xn{n_pg}",
+         pa.paged_attention_reference, (q, k_pg, v_pg, tables, t),
+         pa.paged_attention, (q, k_pg, v_pg, tables, t))
     _provenance.ledger_append("bench_kernels", rows)
 
 

@@ -34,7 +34,19 @@ offered load through an int8-quantized copy of the model
 (contrib.quantization.quantize_block -> the pallas_ops.int8_matmul
 decode path) and reports int8_tokens_per_sec / int8_ttft_p99_ms in the
 same row, so tools/bench_diff.py can compare the fp and int8 paths
-(both fields are registered direction-aware there)."""
+(both fields are registered direction-aware there).
+
+`--pages` (or MXNET_TPU_BENCH_SERVE_PAGES=1) re-drives the same
+offered load through a pages=on server (mx.pages paged KV, chunked
+prefill, and — unless MXNET_TPU_BENCH_SERVE_DRAFT=0 — self-draft
+speculative decoding) and reports pages_tokens_per_sec /
+pages_ttft_p50_ms / pages_ttft_p99_ms / prefix_hit_rate /
+accepted_draft_rate plus pages_speedup (pages-vs-dense tokens/s) in
+the same row. `--prefix` (or MXNET_TPU_BENCH_SERVE_PREFIX=1) switches
+BOTH passes to the shared-prefix workload — every prompt opens with
+one common system prefix and diverges in a short tail, the traffic
+shape the prefix tree exists for ('workload' records which shape the
+row measured)."""
 import json
 import os
 import sys
@@ -84,6 +96,13 @@ def main():
         cfg = gpt_mod.gpt_tiny_config()
         n_requests, rate, slots = 16, 40.0, 4
         lp_range, new_range = (4, 12), (4, 10)
+    prefix_mode = "--prefix" in sys.argv[1:] \
+        or os.environ.get("MXNET_TPU_BENCH_SERVE_PREFIX") == "1"
+    if prefix_mode:
+        # the prefix workload is a CAPACITY comparison (pages-vs-dense
+        # tokens/s): offer load well past dense capacity so tokens/s
+        # measures the server, not the arrival process
+        n_requests, rate = (64, 32.0) if on_tpu else (24, 400.0)
     n_requests = int(os.environ.get("MXNET_TPU_BENCH_SERVE_REQUESTS",
                                     n_requests))
     rate = float(os.environ.get("MXNET_TPU_BENCH_SERVE_RATE", rate))
@@ -95,24 +114,45 @@ def main():
     model.initialize()
     rng = np.random.RandomState(0)
 
-    # pre-drawn offered load, shared by the fp and int8 passes: Poisson
-    # interarrivals so arrivals are independent of how the server keeps up
-    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
-    prompts = [rng.randint(0, cfg["vocab_size"],
-                           (rng.randint(*lp_range),)).astype(np.int32)
-               for _ in range(n_requests)]
-    news = [int(rng.randint(*new_range)) for _ in range(n_requests)]
+    page_size = 16 if on_tpu else 8
 
-    def run_load(mdl):
-        srv = serve.Server(mdl, slots=slots)
-        # warm the common bucket so the measured window is steady-state,
-        # not the one-off jit compile (the persistent cache makes
-        # re-runs warm)
-        warm = srv.submit(rng.randint(0, cfg["vocab_size"],
-                                      (lp_range[1],)).astype(np.int32),
-                          max_new_tokens=new_range[1])
+    # pre-drawn offered load, shared by every pass: Poisson interarrivals
+    # so arrivals are independent of how the server keeps up
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    if prefix_mode:
+        # shared-prefix shape: one common system prefix (a whole number
+        # of pages so the prefix tree can match it block-for-block) and
+        # a short unique tail per request
+        pre_len = page_size * (6 if on_tpu else 4)
+        tail_range = (4, 16) if on_tpu else (2, 7)
+        shared = rng.randint(0, cfg["vocab_size"],
+                             (pre_len,)).astype(np.int32)
+        prompts = [np.concatenate(
+            [shared,
+             rng.randint(0, cfg["vocab_size"],
+                         (rng.randint(*tail_range),)).astype(np.int32)])
+            for _ in range(n_requests)]
+    else:
+        prompts = [rng.randint(0, cfg["vocab_size"],
+                               (rng.randint(*lp_range),)).astype(np.int32)
+                   for _ in range(n_requests)]
+    news = [int(rng.randint(*new_range)) for _ in range(n_requests)]
+    # one warm (prompt_len, max_new) pair per distinct total length:
+    # warming covers EVERY bucket the pre-drawn load will touch, so the
+    # measured window is steady-state for all passes — a single-length
+    # warmup leaves the other buckets' jit compiles inside the window
+    warm_pairs = {}
+    for p, n in zip(prompts, news):
+        warm_pairs.setdefault(len(p) + n, (len(p), n))
+
+    def run_load(mdl, **srv_kw):
+        srv = serve.Server(mdl, slots=slots, **srv_kw)
+        warms = [srv.submit(rng.randint(0, cfg["vocab_size"],
+                                        (lp,)).astype(np.int32),
+                            max_new_tokens=n)
+                 for lp, n in warm_pairs.values()]
         srv.drain()
-        assert warm.state == serve.DONE
+        assert all(w.state == serve.DONE for w in warms)
         if slo_on:
             # arm AFTER the warmup so the journaled window is the
             # measured steady state, not the one-off compile; a fresh
@@ -171,6 +211,8 @@ def main():
             "cancelled": st["cancelled"],
             "degraded": st["degraded"],
             "requeues": st["requeues"],
+            "prefix_hit_rate": st.get("prefix_hit_rate"),
+            "accepted_draft_rate": st.get("accepted_draft_rate"),
         }
 
     from benchmarks import _provenance
@@ -182,6 +224,7 @@ def main():
         "slots": slots,
         "queue_depth": srv._queue_depth,
         "offered_rps": round(rate, 2),
+        "workload": "shared_prefix" if prefix_mode else "random",
     })
     row.update(_provenance.provenance_fields(on_tpu=on_tpu))
 
@@ -203,6 +246,33 @@ def main():
             "int8_ttft_p50_ms": qstats["ttft_p50_ms"],
             "int8_ttft_p99_ms": qstats["ttft_p99_ms"],
             "int8_completed": qstats["completed"],
+        })
+
+    pages = "--pages" in sys.argv[1:] \
+        or os.environ.get("MXNET_TPU_BENCH_SERVE_PAGES") == "1"
+    if pages:
+        # the paged path (block-granular KV pool + prefix tree + chunked
+        # prefill) under the SAME pre-drawn offered load, so pages-vs-
+        # dense tokens/s and TTFT are an apples-to-apples pairing at
+        # equal memory budget (pool defaults to slots * max_len pages).
+        # Self-draft speculative decoding exercises the spec path with
+        # ~full acceptance; MXNET_TPU_BENCH_SERVE_DRAFT=0 disables it.
+        drafter = model \
+            if os.environ.get("MXNET_TPU_BENCH_SERVE_DRAFT", "1") != "0" \
+            else None
+        _, pstats = run_load(model, pages="on", page_size=page_size,
+                             drafter=drafter)
+        base_tps = row["tokens_per_sec"] or 0.0
+        row.update({
+            "pages_tokens_per_sec": pstats["tokens_per_sec"],
+            "pages_requests_per_sec": pstats["requests_per_sec"],
+            "pages_ttft_p50_ms": pstats["ttft_p50_ms"],
+            "pages_ttft_p99_ms": pstats["ttft_p99_ms"],
+            "pages_completed": pstats["completed"],
+            "prefix_hit_rate": pstats["prefix_hit_rate"],
+            "accepted_draft_rate": pstats["accepted_draft_rate"],
+            "pages_speedup": round(pstats["tokens_per_sec"] / base_tps, 2)
+            if base_tps else None,
         })
     print(json.dumps(row), flush=True)
     _provenance.ledger_append("bench_serve", [row])

@@ -10,6 +10,9 @@
 #   ci/run.sh dist        — tests/dist (sharding/collectives/pipeline/mp)
 #   ci/run.sh train       — tests/train (convergence-tier, slower)
 #   ci/run.sh native      — build + test the C++ data pipeline
+#   ci/run.sh pages       — mx.pages paged serving: off-path
+#                           zero-overhead, shared-prefix bit-identity,
+#                           interpret-mode kernel parity
 #   ci/run.sh all         — everything + the driver-contract gate
 set -e
 cd "$(dirname "$0")/.."
@@ -734,6 +737,15 @@ unittest_stage() {
         > /tmp/_tier1_sweep.log 2>&1 || rc=$?
     cat /tmp/_tier1_sweep.log
     wall=$(( $(date +%s) - t0 ))
+    # the unittest tests slow-marked out of the tier-1 filter for the
+    # time budget (unlike tests/train, nothing else reruns tests/unittest
+    # unfiltered) — run them explicitly so they stay covered every pass
+    python -m pytest \
+        tests/unittest/test_contrib.py::test_quantize_resnet18_end_to_end \
+        tests/unittest/test_models.py::test_resnet18_trains \
+        "tests/unittest/test_model_zoo.py::test_zoo_forward_shapes[densenet121-64]" \
+        "tests/unittest/test_model_zoo.py::test_zoo_forward_shapes[inceptionv3-96]" \
+        -q -p no:cacheprovider || rc=$?
     if [ -n "${MXNET_TPU_LEDGER_DIR:-}" ]; then
         # tier-1 time-budget tracking: sweep wall time, pass/fail
         # counts and the top-10 slowest tests become a ledger record
@@ -920,6 +932,81 @@ print('seeded regression ledger at', path)
     echo "ledger stage OK: provenance contract, backfill+anchor, gate"
 }
 
+pages_stage() {
+    echo "== pages =="
+    # pages=off (the default) must be the zero-overhead production
+    # path: a full dense request lifecycle constructs no PagePool, no
+    # PrefixTree, never arms the module bool, and surfaces none of the
+    # paged stats keys — the scheduler checks one attribute
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import pages, parallel, serve
+from mxnet_tpu.models import gpt as gpt_mod
+assert not pages.enabled(), 'pages must default to off'
+calls = {'pool': 0, 'tree': 0, 'enable': 0}
+real = (pages.PagePool, pages.PrefixTree, pages.enable)
+pages.PagePool = lambda *a, **k: (calls.__setitem__('pool', calls['pool'] + 1), real[0](*a, **k))[1]
+pages.PrefixTree = lambda *a, **k: (calls.__setitem__('tree', calls['tree'] + 1), real[1](*a, **k))[1]
+pages.enable = lambda *a, **k: (calls.__setitem__('enable', calls['enable'] + 1), real[2](*a, **k))[1]
+parallel.make_mesh(dp=-1)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+mx.random.seed(0); model.initialize()
+srv = serve.Server(model, slots=2)
+r = srv.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+srv.drain()
+srv.stop()
+pages.PagePool, pages.PrefixTree, pages.enable = real
+assert r.state == serve.DONE
+assert calls == {'pool': 0, 'tree': 0, 'enable': 0}, calls
+assert not pages.enabled(), 'dense serving armed mx.pages'
+st = srv.stats()
+assert 'prefix_hit_rate' not in st and 'pool_pages_total' not in st, \
+    sorted(st)
+print('pages disabled fast path OK (no pool, no tree, no paged stats)')
+"
+    # shared-prefix smoke: pages=on must emit BIT-IDENTICAL token
+    # streams to the dense path on prompts sharing a prefix, with the
+    # prefix tree actually reusing blocks (hit rate > 0) and prefill
+    # running chunked (fewer dispatches than prompt tokens)
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import parallel, serve
+from mxnet_tpu.models import gpt as gpt_mod
+parallel.make_mesh(dp=-1)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+mx.random.seed(0); model.initialize()
+rng = np.random.RandomState(7)
+pre = rng.randint(0, 128, (16,)).astype(np.int32)
+prompts = [np.concatenate([pre, rng.randint(0, 128, (n,)).astype(np.int32)])
+           for n in (3, 5, 2, 6)]
+def run(**kw):
+    srv = serve.Server(model, slots=2, **kw)
+    reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+    srv.drain()
+    st = srv.stats()
+    srv.stop()
+    assert all(r.state == serve.DONE for r in reqs), [r.verdict for r in reqs]
+    return [list(r.tokens) for r in reqs], st
+dense, _ = run()
+paged, st = run(pages='on', page_size=8, prefill_chunk=4)
+assert paged == dense, 'paged tokens diverged from dense'
+assert st['prefix_hit_rate'] > 0, st['prefix_hit_rate']
+assert st['chunk_dispatches'] < st['prompt_tokens'], \
+    (st['chunk_dispatches'], st['prompt_tokens'])
+print('pages shared-prefix smoke OK: bit-identical, hit_rate=%.2f,'
+      ' %d dispatches for %d prompt tokens' %
+      (st['prefix_hit_rate'], st['chunk_dispatches'], st['prompt_tokens']))
+"
+    # the paged-attention kernel: interpret-mode parity against the
+    # XLA reference (the only way the kernel CODE runs off-TPU) plus
+    # the kernels=off jaxpr-identity contract
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_kernels.py -q -p no:cacheprovider \
+        -k "paged_attention"
+}
+
 case "$stage" in
     sanity) sanity ;;
     static) static_stage ;;
@@ -927,6 +1014,7 @@ case "$stage" in
     dist) dist_stage ;;
     train) train_stage ;;
     native) native_stage ;;
+    pages) pages_stage ;;
     ledger) ledger_stage ;;
     all)
         sanity
@@ -935,6 +1023,7 @@ case "$stage" in
         unittest_stage
         dist_stage
         train_stage
+        pages_stage
         ledger_stage
         sh tools/check.sh
         ;;

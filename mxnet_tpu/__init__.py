@@ -60,6 +60,7 @@ _LAZY = {
     "guard": ".guard",
     "scope": ".scope",
     "serve": ".serve",
+    "pages": ".pages",
     "trace": ".trace",
     "inspect": ".inspect",
     "dataflow": ".dataflow",

@@ -73,7 +73,8 @@ from .util import fmt_bytes as _fmt_bytes  # shared with memsafe._fmt
 __all__ = [
     "enable", "disable", "enabled", "maybe_enable", "reset",
     "CheckError", "RULES", "report_finding", "suppress",
-    "check_jit", "check_step", "lint_jaxpr", "note_signature",
+    "check_jit", "check_step", "lint_jaxpr", "lint_paging",
+    "note_signature",
     "note_scalar", "findings", "thread_findings", "snapshot", "dump",
     "make_lock", "make_rlock", "LockOrderError",
 ]
@@ -97,6 +98,11 @@ RULES = {
     "degenerate-sharding": "large fully-replicated params or batch "
                            "inputs on a mesh whose data axes span >1 "
                            "device (every device holds the full array)",
+    "degenerate-paging": "a pages=on server whose page size exceeds its "
+                         "smallest bucket (prefix sharing can never "
+                         "engage) or whose drafter's vocabulary differs "
+                         "from the target's (speculative proposals are "
+                         "meaningless token ids)",
     "lock-order-cycle": "two contexts acquire the same locks in opposite "
                         "orders (tsan-lite; reported with both "
                         "acquisition stacks)",
@@ -740,6 +746,53 @@ def _lint_sharding(trainer, name, key, batch):
                 "replicated inputs (lookup tables)",
                 dedupe=(name, "replicated-batch", i),
                 input=i, nbytes=nbytes, devices=extent)
+
+
+def lint_paging(location, page_size, min_bucket, target_vocab,
+                drafter_vocab=None):
+    """Degenerate paging configuration lint, run once at pages=on
+    Server construction (mirrors `degenerate-sharding`: a setup that
+    silently voids the feature's benefit rather than crashing).
+
+    Two shapes: (1) a page size larger than the smallest bucket — every
+    short request rounds its bucket UP to one page, prompts shorter
+    than a page never produce a full (shareable) block, and the prefix
+    tree can never engage for exactly the traffic paging targets;
+    (2) a speculative drafter whose vocabulary differs from the
+    target's — its argmax proposals index a different token space, so
+    every verify round rejects at the first token and the extra
+    dispatches are pure overhead (or worse: out-of-range ids)."""
+    if not _enabled:
+        return
+    if int(page_size) > int(min_bucket):
+        report_finding(
+            "degenerate-paging", location,
+            f"pages_page_size {page_size} exceeds the smallest serve "
+            f"bucket {min_bucket}: every request shorter than a page "
+            "rounds up to a full page and never yields a sharable "
+            "prefix block — the prefix tree cannot engage for short "
+            "traffic.",
+            "lower pages_page_size to at most the smallest bucket (a "
+            "divisor of the common bucket sizes keeps tables dense), "
+            "or raise bucket_pad_min/serve_buckets so the smallest "
+            "bucket covers at least one page",
+            dedupe=(location, "page-size"),
+            page_size=int(page_size), min_bucket=int(min_bucket))
+    if drafter_vocab is not None \
+            and int(drafter_vocab) != int(target_vocab):
+        report_finding(
+            "degenerate-paging", location,
+            f"speculative drafter vocabulary ({drafter_vocab}) differs "
+            f"from the target's ({target_vocab}): draft proposals "
+            "index a different token space, so exact-acceptance "
+            "verification rejects every round and speculative decoding "
+            "only adds dispatches.",
+            "use a drafter trained on the same tokenizer/vocabulary as "
+            "the target model, or detach the drafter "
+            "(Server(drafter=None))",
+            dedupe=(location, "drafter-vocab"),
+            target_vocab=int(target_vocab),
+            drafter_vocab=int(drafter_vocab))
 
 
 # ---------------------------------------------------------------------------

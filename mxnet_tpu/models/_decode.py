@@ -82,6 +82,42 @@ def batched_cached_attention_step(q, k_new, v_new, k_cache, v_cache, t):
     return apply_op(f, q, k_new, v_new, k_cache, v_cache, t)
 
 
+def paged_attention_step(q, k_new, v_new, k_pages, v_pages, tables, wp, wo,
+                         t):
+    """`batched_cached_attention_step` over an mx.pages block-table
+    cache: row b writes this token's K/V into page wp[b] at in-page
+    offset wo[b] and attends over positions <= t[b] gathered through its
+    page table. The attention math is `pallas_ops.paged_attention`,
+    whose XLA fallback is VERBATIM the dense step's f32
+    score/softmax/PV expression at the gathered (B,H,L,D) shapes — the
+    pages=on bit-identity guarantee composes from there.
+
+    The scatter targets (wp[b], wo[b]) are distinct by construction:
+    every serve slot owns its write page exclusively (masked-out rows
+    write their private scratch page), so `.at[].set` never sees
+    duplicate indices.
+
+    q/k_new/v_new (B,H,1,D); k_pages/v_pages (P,H,ps,D); tables
+    (B,n_pg) int32; wp/wo/t (B,) traced int. Returns
+    (out (B,1,H*D), new_k_pages, new_v_pages)."""
+    import jax.numpy as jnp
+
+    from ..ndarray import apply_op
+    from ..pallas_ops import paged_attention as _paged_attn
+
+    def f(q_, kn, vn, kp, vp, tb, wp_, wo_, tt):
+        wpi = wp_.astype(jnp.int32)
+        woi = wo_.astype(jnp.int32)
+        kp = kp.at[wpi, :, woi, :].set(kn[:, :, 0, :].astype(kp.dtype))
+        vp = vp.at[wpi, :, woi, :].set(vn[:, :, 0, :].astype(vp.dtype))
+        B, H, _, D = q_.shape
+        o = _paged_attn(q_, kp, vp, tb.astype(jnp.int32),
+                        tt.astype(jnp.int32))
+        return o.transpose(0, 2, 1, 3).reshape(B, 1, H * D), kp, vp
+
+    return apply_op(f, q, k_new, v_new, k_pages, v_pages, tables, wp, wo, t)
+
+
 def beam_search_loop(logits0, step, reorder, B, beam, eos, max_steps,
                      alpha=0.6, seqs0=None, lengths0=1):
     """Host-side beam bookkeeping shared by TransformerNMT.beam_search and

@@ -177,6 +177,37 @@ class GPTBlock(HybridBlock):
                                        act_type="gelu"))
         return x + h2, k_cache, v_cache
 
+    def step_slots_paged(self, x, k_pages, v_pages, tables, wp, wo, t):
+        """`step_slots` against an mx.pages block-table cache: the K/V
+        write lands in page wp[b] offset wo[b] instead of a dense slot
+        row, and attention gathers through tables (B,n_pg). Everything
+        around the cache access — qkv projection, split, proj, FFN — is
+        VERBATIM `step_slots`, and `paged_attention_step`'s fallback is
+        the dense step's attention math at the gathered shapes, which is
+        what makes pages=on serving bit-identical to pages=off."""
+        from ..ndarray import apply_op
+        from ._decode import paged_attention_step
+
+        attn = self.attn
+        H = attn._num_heads
+        qkv = attn.qkv(self.ln1(x))             # (B, 1, 3E)
+        B, _, E3 = qkv.shape
+        D = E3 // 3 // H
+
+        def split(qkv_d):
+            r = qkv_d.reshape(B, 1, 3, H, D)
+            return (r[:, :, 0].transpose(0, 2, 1, 3),
+                    r[:, :, 1].transpose(0, 2, 1, 3),
+                    r[:, :, 2].transpose(0, 2, 1, 3))   # (B,H,1,D) each
+
+        q, k_new, v_new = apply_op(split, qkv)
+        o, k_pages, v_pages = paged_attention_step(
+            q, k_new, v_new, k_pages, v_pages, tables, wp, wo, t)
+        x = x + attn.proj(o)
+        h2 = self.ffn_out(F.Activation(self.ffn_in(self.ln2(x)),
+                                       act_type="gelu"))
+        return x + h2, k_pages, v_pages
+
 
 class GPTModel(HybridBlock):
     """Token+position embeddings -> pre-LN block stack -> final LN.
@@ -322,6 +353,153 @@ class GPTForCausalLM(HybridBlock):
             lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
             x, g.word_embed.weight.data())
         return logits.reshape(shape=(tok.shape[0], -1)), new_k, new_v
+
+    # -- paged decode (mx.pages block-table cache) -------------------------
+    def _paged_token_step(self, tok_d, pos_d, tb_d, wp_d, wo_d, ks, vs):
+        """Raw-jax one-token paged step (the lax.scan body of the chunk
+        and draft programs): the EXACT `decode_step_slots` computation —
+        embed + pe[pos] + layer stack + ln_f + tied logits — with the
+        layers' cache access routed through `step_slots_paged`. Takes and
+        returns raw arrays (scan carries); ks/vs are tuples of the
+        pooled (P,H,ps,D) page arrays per layer.
+
+        Returns (f32 logits (B,V), new_ks, new_vs)."""
+        import jax.numpy as jnp
+        from ..ndarray import apply_op
+
+        g = self.gpt
+        tok = NDArray(tok_d)
+        t = NDArray(pos_d)
+        x = g.word_embed(tok.reshape(shape=(-1, 1)))
+        pos = apply_op(
+            lambda pe, tt: pe[tt.astype(jnp.int32)][:, None, :],
+            NDArray(g.position_embed.data()._data), t)
+        x = x + pos
+        nk, nv = [], []
+        for i, layer in enumerate(g.layers):
+            x, k, v = layer.step_slots_paged(
+                x, NDArray(ks[i]), NDArray(vs[i]), NDArray(tb_d),
+                NDArray(wp_d), NDArray(wo_d), t)
+            nk.append(k._data)
+            nv.append(v._data)
+        x = g.ln_f(x)
+        logits = apply_op(
+            lambda hh, w: jnp.matmul(hh, w.T.astype(hh.dtype)),
+            x, g.word_embed.weight.data())
+        lg = logits.reshape(shape=(tok.shape[0], -1))._data \
+            .astype(jnp.float32)
+        return lg, tuple(nk), tuple(nv)
+
+    def _paged_write_targets(self, pos_d, active_d, tb_d, page_size):
+        """Write page/offset for one chunk step: active rows write page
+        tables[b, pos//ps] at offset pos%ps; masked rows write their
+        private scratch page (page id == batch row — mx.pages reserves
+        pages 0..slots-1 as per-slot scratch), so a batched step never
+        scatters two rows into one (page, offset) cell and never pollutes
+        a real page of an inactive request. Positions past the table's
+        range also divert to scratch: a speculative round that starts
+        near the bucket's last position feeds its fixed k+1 tokens past
+        the end, and clipping those writes back into the last real page
+        would corrupt positions the row still attends."""
+        import jax.numpy as jnp
+
+        B, n_pg = tb_d.shape
+        idx = jnp.clip(pos_d // page_size, 0, n_pg - 1)
+        real = jnp.take_along_axis(tb_d, idx[:, None], axis=1)[:, 0]
+        scratch = jnp.arange(B, dtype=jnp.int32)
+        ok = active_d & (pos_d < n_pg * page_size)
+        wp = jnp.where(ok, real.astype(jnp.int32), scratch)
+        wo = jnp.where(ok, pos_d % page_size, 0).astype(jnp.int32)
+        return wp, wo
+
+    def decode_paged_chunk(self, toks, t0, n, tables, flat, page_size,
+                           full=False):
+        """Chunked paged decode body (jit_flat_step step_fn): row b feeds
+        its n[b] tokens toks[b, :n[b]] at positions t0[b].. — many prompt
+        tokens per dispatch (batched prefill) or one (steady decode), in
+        ONE executable per (bucket, chunk) shape. The body is a lax.scan
+        of C structurally identical one-token steps, each exactly the
+        dense `decode_step_slots` computation, so a chunk's logits are
+        bit-identical to feeding the same tokens one dispatch at a time.
+
+        Rows past their count (j >= n[b]) run masked: writes land in the
+        row's scratch page and their logits are discarded — mirroring the
+        dense path's harmless pad-slot pollution argument.
+
+        toks (B,C) int32; t0/n (B,) int32; tables (B,n_pg) int32; flat =
+        2*n_l pooled page arrays (K per layer, then V). Returns
+        (last-active f32 logits (B,V) — or the full (B,C,V) stack when
+        `full`, the speculative verify surface — and the new pool
+        arrays)."""
+        import jax
+        import jax.numpy as jnp
+
+        n_l = len(self.gpt.layers)
+        toks_d, t0_d, n_d, tb_d = (toks._data, t0._data, n._data,
+                                   tables._data)
+        flat_d = [f._data for f in flat]
+        B, C = toks_d.shape
+        V = self.gpt.word_embed.weight.shape[0]
+
+        def tok_step(carry, j):
+            ks, vs, last = carry
+            tokj = jax.lax.dynamic_index_in_dim(
+                toks_d, j, axis=1, keepdims=False).astype(jnp.int32)
+            pos = (t0_d + j).astype(jnp.int32)
+            active = j < n_d
+            wp, wo = self._paged_write_targets(pos, active, tb_d,
+                                               page_size)
+            lg, ks, vs = self._paged_token_step(tokj, pos, tb_d, wp, wo,
+                                                ks, vs)
+            last = jnp.where((j == n_d - 1)[:, None], lg, last)
+            return (ks, vs, last), (lg if full else jnp.zeros((), lg.dtype))
+
+        last0 = jnp.zeros((B, V), jnp.float32)
+        (ks, vs, last), stack = jax.lax.scan(
+            tok_step, (tuple(flat_d[:n_l]), tuple(flat_d[n_l:]), last0),
+            jnp.arange(C))
+        out = stack.transpose(1, 0, 2) if full else last   # (B,C,V)|(B,V)
+        return NDArray(out), [NDArray(a) for a in list(ks) + list(vs)]
+
+    def decode_paged_draft(self, tok0, t0, active, tables, flat, page_size,
+                           n_draft):
+        """Greedy draft chain (jit_flat_step step_fn on the DRAFTER
+        model): feed tok0[b] at position t0[b], take the argmax as the
+        next token, repeat — n_draft proposals in one dispatch. The
+        drafter writes its own pooled page arrays (`flat`, the pool's
+        'draft' stream) through the SAME page tables as the target, so a
+        prefix-tree hit skips drafter prefill too.
+
+        Inactive rows (active[b] False — row not in a speculative round)
+        run fully masked into scratch. Proposals feed exact-acceptance
+        verification (arxiv 2302.01318): the target checks them in one
+        chunked step and keeps the longest agreeing prefix, so a wrong
+        draft costs speed, never correctness.
+
+        tok0/t0 (B,) int32; active (B,) bool; tables (B,n_pg) int32.
+        Returns (drafts (B, n_draft) int32, new draft-pool arrays)."""
+        import jax
+        import jax.numpy as jnp
+
+        n_l = len(self.gpt.layers)
+        tok0_d, t0_d, act_d, tb_d = (tok0._data, t0._data, active._data,
+                                     tables._data)
+        flat_d = [f._data for f in flat]
+
+        def tok_step(carry, i):
+            ks, vs, tok = carry
+            pos = (t0_d + i).astype(jnp.int32)
+            wp, wo = self._paged_write_targets(pos, act_d, tb_d, page_size)
+            lg, ks, vs = self._paged_token_step(tok, pos, tb_d, wp, wo,
+                                                ks, vs)
+            nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+            return (ks, vs, nxt), nxt
+
+        (ks, vs, _), drafts = jax.lax.scan(
+            tok_step, (tuple(flat_d[:n_l]), tuple(flat_d[n_l:]),
+                       tok0_d.astype(jnp.int32)),
+            jnp.arange(n_draft))
+        return NDArray(drafts.T), [NDArray(a) for a in list(ks) + list(vs)]
 
     def _init_generate(self, B, max_len):
         """Allocate caches and jit the step (shape-keyed — the reference

@@ -14,6 +14,10 @@ generic lowering loses (the TVM/Relay argument, PAPERS.md 1802.04799):
                             master passes)
   * `moe_kernels`         — fused MoE dispatch/combine without the
                             (N, E, C) one-hot tensor (parallel/moe.py)
+  * `paged_attention`     — one-token decode attention gathered through
+                            an mx.pages block table (the paged serve
+                            path), scalar-prefetch indexed so the dense
+                            gathered operand never hits HBM
 
 Every kernel sits behind the `kernels=off|auto|on` knob with a bit-exact
 XLA-native fallback (see `pallas_ops/_common.py`), ships an
@@ -36,7 +40,9 @@ from . import moe_kernels
 # importlib.import_module (see tests/unittest/test_flash_interpret.py)
 from .flash_attention import flash_attention, mha_reference
 from .int8_matmul import int8_matmul, int8_matmul_reference
+from .paged_attention import paged_attention, paged_attention_reference
 
 __all__ = ["flash_attention", "mha_reference", "int8_matmul",
-           "int8_matmul_reference", "fused_update", "moe_kernels",
+           "int8_matmul_reference", "paged_attention",
+           "paged_attention_reference", "fused_update", "moe_kernels",
            "_common"]

@@ -56,6 +56,17 @@ Robustness — the request lifecycle:
     telemetry, then (3) reject with the budget accounting only when
     the request cannot fit even alone.
 
+Paged serving (`pages=on`, PR 18): the dense per-bucket caches are
+replaced by the mx.pages block-table pool — refcounted fixed-size KV
+pages, a content-hashed prefix tree so shared prompt prefixes prefill
+once, chunked prefill (many prompt tokens per dispatch), and optional
+draft-verify speculative decoding with exact greedy acceptance. The
+`pages=off` default never touches any of it: admission, placement and
+decode run the exact dense code above (ci/run.sh `pages` asserts zero
+mx.pages calls across a dense request lifecycle), and pages=on output
+is bit-identical to pages=off — prefix reuse, chunking and speculation
+change WHEN cache entries are computed, never their values.
+
 Every path is deterministically testable: `resilience.FaultInjector`
 grows `slow_client:ms` (stream consumer stalls; the scheduler must not
 care), `burst:N@step:K` (K-th scheduler step injects N requests via
@@ -84,6 +95,7 @@ from . import config as _config
 from . import diagnostics as _diagnostics
 from . import guard as _guard
 from . import memsafe as _memsafe
+from . import pages as _pages
 from . import resilience as _resilience
 from . import slo as _slo
 from . import telemetry as _telemetry
@@ -349,6 +361,39 @@ class _Group:
         return [i for i, r in enumerate(self.slots) if r is not None]
 
 
+class _PagedGroup:
+    """The paged counterpart of `_Group`: same bucket/slots/pos duck
+    type for the scheduler, but no dense caches — slot i owns a LIST of
+    mx.pages page ids (`pages[i]`, one pool reference each) whose order
+    IS its page table. `cache_bytes` is 0 because the pool is allocated
+    once at server construction and priced there, not per bucket.
+    `matched[i]` records how many prompt tokens arrived pre-filled from
+    the prefix tree; `inserted[i]` latches the one-time tree insertion
+    after the slot's prefill completes."""
+
+    __slots__ = ("bucket", "n_pg", "slots", "pos", "pages", "matched",
+                 "inserted", "cache_bytes")
+
+    def __init__(self, bucket, n_slots, n_pg):
+        self.bucket = bucket
+        self.n_pg = n_pg
+        self.cache_bytes = 0
+        self.slots = [None] * n_slots
+        self.pos = [0] * n_slots
+        self.pages = [[] for _ in range(n_slots)]
+        self.matched = [0] * n_slots
+        self.inserted = [False] * n_slots
+
+    def free_slot(self):
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def active(self):
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+
 # ---------------------------------------------------------------------------
 # Server
 # ---------------------------------------------------------------------------
@@ -370,7 +415,9 @@ class Server:
 
     def __init__(self, model, slots=None, queue_depth=None, shed=None,
                  default_deadline_ms=None, buckets=None, max_len=None,
-                 clock=None, retry=None):
+                 clock=None, retry=None, pages=None, drafter=None,
+                 page_size=None, pool_pages=None, prefill_chunk=None,
+                 spec_k=None):
         enable()
         self.model = model
         g = model.gpt
@@ -379,6 +426,11 @@ class Server:
         self._units = g.word_embed.weight.shape[1]
         self._cache_dtype = g.word_embed.weight.data()._data.dtype
         self._max_len = int(max_len or g.position_embed.shape[0])
+        pages = pages if pages is not None else _config.get("pages")
+        if pages not in ("off", "on"):
+            raise ValueError(f"pages must be 'off' or 'on', got {pages!r}")
+        self._paged = pages == "on"
+        self._drafter = drafter
         self._slots = int(slots or _config.get("serve_slots"))
         self._queue_depth = int(queue_depth
                                 if queue_depth is not None
@@ -409,6 +461,10 @@ class Server:
             "steps": 0, "requeues": 0, "degraded": 0, "retries": 0,
         }
         self._params_bytes = self._measure_params()
+        self._pool = None
+        self._tree = None
+        if self._paged:
+            self._init_paged(page_size, pool_pages, prefill_chunk, spec_k)
         self.on_burst = None
         self._thread = None
         self._stop = threading.Event()
@@ -441,6 +497,67 @@ class Server:
             return _memsafe.resident_bytes(leaves)
         except Exception:
             return 0
+
+    def _init_paged(self, page_size, pool_pages, prefill_chunk, spec_k):
+        """Construct the mx.pages pool + prefix tree and arm the module.
+
+        The usable position range rounds DOWN to a page multiple and
+        buckets round UP to one (`_bucket_for`), so a paged bucket's
+        gathered KV length n_pg*page_size equals the bucket exactly —
+        the shape identity the pages=on-vs-off bit-identity rests on.
+        The default pool holds `slots * max_len/page_size` data pages:
+        the same worst-case KV footprint the dense scheduler would
+        allocate with every slot in the largest bucket, so pages-vs-
+        dense comparisons run at equal memory budget."""
+        ps = int(page_size or _config.get("pages_page_size"))
+        if ps < 1:
+            raise ValueError(f"pages_page_size must be >= 1, got {ps}")
+        self._page_size = ps
+        self._prefill_chunk = max(
+            1, int(prefill_chunk or _config.get("pages_prefill_chunk")))
+        self._spec_k = max(1, int(spec_k or _config.get("pages_spec_k")))
+        max_paged = (self._max_len // ps) * ps
+        if max_paged < 1:
+            raise ValueError(
+                f"pages_page_size {ps} exceeds the model's max_length "
+                f"{self._max_len} — no position fits a single page")
+        self._max_len = max_paged
+        D = self._units // self._heads
+        streams = {"target": [(self._heads, D, self._cache_dtype)]
+                   * (2 * self._n_l)}
+        if self._drafter is not None:
+            dg = self._drafter.gpt
+            d_heads = dg.layers[0].attn._num_heads
+            d_units = dg.word_embed.weight.shape[1]
+            d_dtype = dg.word_embed.weight.data()._data.dtype
+            streams["draft"] = [(d_heads, d_units // d_heads, d_dtype)] \
+                * (2 * len(dg.layers))
+        if self._drafter is not None:
+            try:
+                self._params_bytes += _memsafe.resident_bytes(
+                    [p.data()._data
+                     for p in self._drafter.collect_params().values()])
+            except Exception:
+                pass
+        data = int(pool_pages or _config.get("pages_pool_pages")) \
+            or self._slots * (self._max_len // ps)
+        self._pool = _pages.PagePool(ps, data, self._slots, streams)
+        self._tree = _pages.PrefixTree(self._pool)
+        self._stats.update({
+            "prompt_tokens": 0, "prefix_tokens": 0, "prefix_hits": 0,
+            "chunk_dispatches": 0, "spec_rounds": 0,
+            "drafts_proposed": 0, "drafts_accepted": 0,
+        })
+        from . import check as _check
+        if _check._enabled:
+            smallest = self._buckets[0] if self._buckets is not None \
+                else max(1, int(_config.get("bucket_pad_min")))
+            _check.lint_paging(
+                f"serve.Server(pages=on,page_size={ps})", ps, smallest,
+                int(self.model.gpt.word_embed.weight.shape[0]),
+                None if self._drafter is None
+                else int(self._drafter.gpt.word_embed.weight.shape[0]))
+        _pages.enable()
 
     # -- client surface --------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, eos=None, temperature=0.0,
@@ -526,6 +643,19 @@ class Server:
             out["buckets_allocated"] = sorted(self._groups)
             out["executables"] = len(self._runners)
             out["scheduler_steps"] = self._sched_step
+            if self._paged:
+                out["pages"] = "on"
+                out["page_size"] = self._page_size
+                out["pool_pages_total"] = self._pool.data_pages
+                out["pool_pages_free"] = self._pool.free_pages()
+                out["tree_nodes"] = len(self._tree.nodes)
+                out["cow_copies"] = self._pool.stats["cow_copies"]
+                pt = self._stats["prompt_tokens"]
+                out["prefix_hit_rate"] = (
+                    self._stats["prefix_tokens"] / pt if pt else 0.0)
+                dp = self._stats["drafts_proposed"]
+                out["accepted_draft_rate"] = (
+                    self._stats["drafts_accepted"] / dp if dp else 0.0)
         out["dispatches"] = dispatches()
         return out
 
@@ -559,6 +689,12 @@ class Server:
                 self._finish(r, CANCELLED, "499 server stopped")
             self._queue.clear()
             self._gc_groups()
+            if self._paged and self._tree is not None:
+                # drop the tree's page references so the pool drains
+                # fully (every page back on the free list), and disarm
+                # the module bool this server's construction set
+                self._tree.clear()
+                _pages.disable()
 
     def __enter__(self):
         return self.start()
@@ -670,7 +806,19 @@ class Server:
         cap = _memsafe.capacity_bytes()
         for r in pending:
             b = self._bucket_for(r.prompt.size + r.max_new_tokens)
-            self._runner(b)
+            if self._paged:
+                self._paged_runner(b, self._prefill_chunk, False)
+                self._paged_runner(b, 1, False)
+                if self._drafter is not None:
+                    # the drafter mirrors every target chunk (gap-0
+                    # sync), plus its own chain and the verify step
+                    self._paged_runner(b, self._prefill_chunk, False,
+                                       draft=True)
+                    self._paged_runner(b, 1, False, draft=True)
+                    self._paged_runner(b, self._spec_k + 1, True)
+                    self._draft_runner(b)
+            else:
+                self._runner(b)
             if cap is not None:
                 self._exec_peak(b)
 
@@ -718,7 +866,7 @@ class Server:
             for i in grp.active():
                 r = grp.slots[i]
                 if r.deadline is not None and now > r.deadline:
-                    grp.slots[i] = None
+                    self._vacate(grp, i)
                     self._note_deadline_miss(r, running=True)
         for r in list(self._queue):
             if r.deadline is not None and now > r.deadline:
@@ -739,7 +887,16 @@ class Server:
             b = _dataflow.bucket_length(need, self._buckets)
         else:
             b = _dataflow.bucket_length(need, "pow2")
-        return min(int(b), self._max_len)
+        b = min(int(b), self._max_len)
+        if self._paged:
+            # paged buckets are page multiples, so a bucket's gathered
+            # KV length (n_pg * page_size) equals the bucket exactly —
+            # identical operand shapes to the dense cache (pow2 buckets
+            # with a pow2 page size are already multiples; _init_paged
+            # rounded _max_len down, so the cap stays a multiple too)
+            ps = self._page_size
+            b = min(((b + ps - 1) // ps) * ps, self._max_len)
+        return b
 
     def _buckets_below(self, bucket, floor):
         """Candidate shrink buckets strictly below `bucket`, largest
@@ -789,20 +946,84 @@ class Server:
             (self._slots, self._heads, bucket, D), self._cache_dtype)
             for _ in range(2 * self._n_l)]
 
+    def _paged_runner(self, bucket, C, full, draft=False):
+        """Chunk-step executable for (bucket, chunk length C): the
+        `decode_paged_chunk` body under jit_flat_step with the pool
+        arrays donated — at most three C values ever exist per bucket
+        (prefill_chunk, 1, and spec_k+1 with full logits), so paged
+        serving compiles O(buckets) executables like the dense path."""
+        key = ("paged", bucket, C, full, draft)
+        r = self._runners.get(key)
+        if r is None:
+            from .models._decode import jit_flat_step
+            mdl = self._drafter if draft else self.model
+            n_l = len(mdl.gpt.layers)
+            ps = self._page_size
+
+            def step(toks, t0, n, tables, flat):
+                return mdl.decode_paged_chunk(toks, t0, n, tables, flat,
+                                              ps, full=full)
+
+            r = jit_flat_step(mdl, step, 2 * n_l, donate_state=2 * n_l)
+            self._runners[key] = r
+        return r
+
+    def _draft_runner(self, bucket):
+        """Draft-chain executable: greedy proposals per dispatch on the
+        drafter model, writing the pool's 'draft' stream. The chain runs
+        spec_k+1 steps, not spec_k: step i writes the drafter's KV at
+        position t0+i, and when the verify step accepts all k drafts
+        PLUS the bonus token the next round feeds at t0+k+1 — the extra
+        step fills position t0+k so the drafter cache never has a hole
+        (the gap-0 sync invariant). Its proposal is discarded."""
+        key = ("draft", bucket, self._spec_k)
+        r = self._runners.get(key)
+        if r is None:
+            from .models._decode import jit_flat_step
+            mdl = self._drafter
+            n_l = len(mdl.gpt.layers)
+            ps, k = self._page_size, self._spec_k
+
+            def step(tok0, t0, act, tables, flat):
+                return mdl.decode_paged_draft(tok0, t0, act, tables,
+                                              flat, ps, k + 1)
+
+            r = jit_flat_step(mdl, step, 2 * n_l, donate_state=2 * n_l)
+            self._runners[key] = r
+        return r
+
     def _exec_peak(self, bucket):
         """AOT execution-peak bytes of the bucket's step executable
         (beyond its argument buffers) — `predict_step_bytes`-style
         analysis, no dispatch. Cached per bucket; None when the backend
         withholds analysis (the budget then checks resident bytes
-        alone)."""
+        alone). Paged servers price the HEAVIEST chunk executable the
+        bucket can run (the full-logits speculative verify step when a
+        drafter is attached, else the prefill chunk) — the
+        `memsafe.aot_exec_peak` path pages are admitted through."""
         if bucket in self._exec_peaks:
             return self._exec_peaks[bucket]
         import jax
-        run = self._runner(bucket)
-        tok = jax.ShapeDtypeStruct((self._slots,), np.int32)
-        t = jax.ShapeDtypeStruct((self._slots,), np.int32)
         try:
-            peak = run.aot_exec_peak(tok, t, self._cache_avals(bucket))
+            if self._paged:
+                if self._drafter is not None:
+                    C, full = self._spec_k + 1, True
+                else:
+                    C, full = self._prefill_chunk, False
+                run = self._paged_runner(bucket, C, full)
+                n_pg = bucket // self._page_size
+                toks = jax.ShapeDtypeStruct((self._slots, C), np.int32)
+                t0 = jax.ShapeDtypeStruct((self._slots,), np.int32)
+                nn = jax.ShapeDtypeStruct((self._slots,), np.int32)
+                tb = jax.ShapeDtypeStruct((self._slots, n_pg), np.int32)
+                state = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                         for a in self._pool.state["target"]]
+                peak = run.aot_exec_peak(toks, t0, nn, tb, state)
+            else:
+                run = self._runner(bucket)
+                tok = jax.ShapeDtypeStruct((self._slots,), np.int32)
+                t = jax.ShapeDtypeStruct((self._slots,), np.int32)
+                peak = run.aot_exec_peak(tok, t, self._cache_avals(bucket))
         except Exception:   # noqa: BLE001 — degrade to resident-only
             peak = None
         self._exec_peaks[bucket] = peak
@@ -817,6 +1038,15 @@ class Server:
         cap = _memsafe.capacity_bytes()
         if cap is None:
             return None
+        if self._paged:
+            # the pool is the cache: one constant resident allocation
+            # made at construction — per-bucket admission only prices
+            # the chunk executable's AOT peak on top of it
+            resident = self._params_bytes + self._pool.pool_bytes()
+            return _memsafe.check_budget(
+                f"serve.decode(bucket={bucket},slots={self._slots},"
+                f"pages=on)",
+                self._exec_peak(bucket), resident, capacity=cap)
         new_bytes = 0 if bucket in self._groups \
             else self._cache_bytes(bucket)
         resident = self._params_bytes + new_bytes + sum(
@@ -836,7 +1066,9 @@ class Server:
         floor_new = max(1, min(int(_config.get("serve_min_new_tokens")),
                                req.max_new_tokens))
         bucket = self._bucket_for(req.prompt.size + floor_new)
-        resident = self._params_bytes + self._cache_bytes(bucket)
+        resident = self._params_bytes + (
+            self._pool.pool_bytes() if self._paged
+            else self._cache_bytes(bucket))
         if resident > cap:
             return (f"429 over capacity: smallest viable KV bucket "
                     f"{bucket} needs {_fmt_bytes(resident)} resident "
@@ -868,8 +1100,118 @@ class Server:
             self._admit_budget(bucket)
         except _memsafe.MemoryBudgetError as e:
             return self._admit_pressure(req, bucket, e)
+        if self._paged:
+            got = self._paged_alloc(req, bucket)
+            if got is None:
+                return self._paged_pressure(req, bucket)
+            self._place_paged(req, bucket, got)
+            return True
         self._place(req, bucket)
         return True
+
+    def _paged_alloc(self, req, bucket, max_new=None):
+        """Match the prompt against the prefix tree and allocate the
+        request's EXACT page need upfront: ceil((prompt + max_new) /
+        page_size) pages, not the full bucket//page_size table. This is
+        the headline memory win of paging — a 36-token request in a
+        64-token bucket owns 5 pages, not 8; the executable's table is
+        still bucket-wide, with unowned trailing rows padded to scratch
+        page 0 (reads there are masked, and a speculative round's
+        overshoot writes land in scratch instead of a live page).
+
+        A whole-prompt match would make the first decode write land
+        inside the shared last page (the re-fed prompt tail that
+        produces the sampling logits), so that page is copy-on-write
+        duplicated before the shared original's reference is dropped.
+
+        Returns (pages, matched_tokens, start_pos) with one pool
+        reference held per page, or None when the pool cannot cover the
+        need even after evicting unreferenced prefix-tree leaves."""
+        ps = self._page_size
+        lp = req.prompt.size
+        mn = req.max_new_tokens if max_new is None else max_new
+        n_pg = min(-(-(lp + mn) // ps), bucket // ps)
+        matched_pages, matched = self._tree.match(req.prompt)
+        cow = matched > 0 and matched == lp
+        need = (n_pg - len(matched_pages)) + (1 if cow else 0)
+        if self._pool.free_pages() < need:
+            self._tree.evict(need)
+        if self._pool.free_pages() < need:
+            for p in matched_pages:
+                self._pool.decref(p)
+            return None
+        if cow:
+            dup = self._pool.copy_page(matched_pages[-1])
+            self._pool.decref(matched_pages[-1])
+            matched_pages[-1] = dup
+            pos0 = lp - 1
+        else:
+            pos0 = matched
+        pages = matched_pages + self._pool.alloc(n_pg - len(matched_pages))
+        return pages, matched, pos0
+
+    def _paged_pressure(self, req, bucket):
+        """The degradation ladder under PAGE exhaustion — the paged
+        analog of `_admit_pressure`, with the same rung semantics and
+        REQUEUED-request protections: (1) shrink max_new_tokens to a
+        smaller bucket needing fewer pages, (2) evict-and-requeue the
+        youngest running request (its `_vacate` returns exclusive pages
+        to the pool), (3) reject when nothing else holds pages."""
+        if req.requeues == 0 and self._paged_shrunk(req, bucket):
+            return True
+        if req.requeues == 0 and not req.evicted_once:
+            victim = self._youngest_running(exclude=req)
+            if victim is not None:
+                req.evicted_once = True
+                self._evict_requeue(victim, for_req=req)
+                self._gc_groups()
+                got = self._paged_alloc(req, bucket)
+                if got is not None:
+                    self._place_paged(req, bucket, got)
+                    return True
+                if self._paged_shrunk(req, bucket):
+                    return True
+        if not any(g.active() for g in self._groups.values()):
+            self._queue.remove(req)
+            self._finish(
+                req, REJECTED,
+                f"429 over capacity: page pool exhausted — request "
+                f"needs {-(-(req.prompt.size + req.max_new_tokens) // self._page_size)} "
+                f"pages but only {self._pool.free_pages()} of "
+                f"{self._pool.data_pages} are free with no running "
+                f"work to drain")
+            return True
+        return False
+
+    def _paged_shrunk(self, req, bucket):
+        """Degradation rung 1 (paged): clamp the token budget to the
+        largest smaller page-multiple bucket whose table the pool can
+        cover now."""
+        ps = self._page_size
+        floor_new = max(1, min(int(_config.get("serve_min_new_tokens")),
+                               req.max_new_tokens))
+        floor_total = req.prompt.size + floor_new
+        seen = set()
+        for L in self._buckets_below(bucket, floor_total):
+            L = min(((L + ps - 1) // ps) * ps, self._max_len)
+            if L >= bucket or L < floor_total or L in seen:
+                continue
+            seen.add(L)
+            grp = self._groups.get(L)
+            if grp is not None and grp.free_slot() is None:
+                continue
+            new_max = L - req.prompt.size
+            got = self._paged_alloc(req, L, max_new=new_max)
+            if got is None:
+                continue
+            was = req.max_new_tokens
+            req.max_new_tokens = new_max
+            req.degraded = f"shrink_max_new:{was}->{new_max}"
+            self._note_degraded("shrink_max_new", req,
+                                {"from": was, "to": new_max, "bucket": L})
+            self._place_paged(req, L, got)
+            return True
+        return False
 
     def _admit_pressure(self, req, bucket, err):
         """The graceful-degradation ladder, walked when admission
@@ -982,6 +1324,32 @@ class Server:
         i = grp.free_slot()
         grp.slots[i] = req
         grp.pos[i] = 0
+        self._note_admitted(req, bucket, t0)
+
+    def _place_paged(self, req, bucket, got):
+        """Seat an admitted request in its paged bucket group with the
+        page table `_paged_alloc` built; a prefix-tree match starts the
+        request at the first unmatched position — the matched prefix's
+        prefill is skipped outright."""
+        pages, matched, pos0 = got
+        t0 = time.perf_counter()
+        grp = self._groups.get(bucket)
+        if grp is None:
+            grp = self._groups[bucket] = _PagedGroup(
+                bucket, self._slots, bucket // self._page_size)
+        i = grp.free_slot()
+        grp.slots[i] = req
+        grp.pos[i] = pos0
+        grp.pages[i] = pages
+        grp.matched[i] = matched
+        grp.inserted[i] = False
+        self._stats["prompt_tokens"] += req.prompt.size
+        self._stats["prefix_tokens"] += pos0
+        if matched:
+            self._stats["prefix_hits"] += 1
+        self._note_admitted(req, bucket, t0)
+
+    def _note_admitted(self, req, bucket, t0):
         try:
             self._queue.remove(req)
         except ValueError:
@@ -998,11 +1366,24 @@ class Server:
             _trace.record_span("serve.admit", t0, cat="serve", req=req.id,
                                bucket=bucket)
 
+    def _vacate(self, grp, i):
+        """Release slot i of `grp`. Dense groups just clear the slot
+        (their caches free when the group drains); paged slots drop one
+        pool reference per owned page — tree-shared pages survive with
+        the tree's reference, exclusive ones return to the free list."""
+        grp.slots[i] = None
+        if self._paged and isinstance(grp, _PagedGroup):
+            for p in grp.pages[i]:
+                self._pool.decref(p)
+            grp.pages[i] = []
+            grp.matched[i] = 0
+            grp.inserted[i] = False
+
     def _remove_from_slots(self, req):
         for g in self._groups.values():
             for i, r in enumerate(g.slots):
                 if r is req:
-                    g.slots[i] = None
+                    self._vacate(g, i)
                     return True
         return False
 
@@ -1015,6 +1396,8 @@ class Server:
 
     # -- decode ----------------------------------------------------------
     def _decode_group(self, grp, sched_step):
+        if self._paged:
+            return self._decode_group_paged(grp, sched_step)
         import jax.numpy as jnp
         tok = np.zeros((self._slots,), np.int32)
         t = np.zeros((self._slots,), np.int32)
@@ -1091,6 +1474,242 @@ class Server:
                             _slo.note_event(r, "retry", attempt=attempt,
                                             error=type(exc).__name__)
             print(f"mx.serve: retrying decode dispatch after "
+                  f"{type(exc).__name__}: {exc} (attempt {attempt + 2}/"
+                  f"{self._retry.max_attempts}, backoff {delay:.2f}s)",
+                  file=sys.stderr)
+
+        return self._retry.call(call, site="serve-dispatch",
+                                abort=self._stop.is_set,
+                                on_retry=on_retry)
+
+    # -- paged decode ----------------------------------------------------
+    def _decode_group_paged(self, grp, sched_step):
+        """One scheduler round for a paged bucket group. Mode per round:
+        a SPECULATIVE round (draft chain + one k+1-token verify chunk)
+        when a drafter is attached, every active slot is past its
+        prompt, and at least one is greedy; otherwise a CHUNK round —
+        chunked prefill for slots still inside their prompt, one token
+        for the rest, all in one dispatch."""
+        active = grp.active()
+        if not active:
+            return
+        all_decoding = True
+        any_greedy = False
+        max_need = 1
+        for i in active:
+            r = grp.slots[i]
+            left = r.prompt.size - grp.pos[i]
+            if left > 0:
+                all_decoding = False
+                max_need = max(max_need,
+                               min(self._prefill_chunk, left))
+            if r.temperature == 0.0:
+                any_greedy = True
+        if _slo._enabled:
+            for i in active:
+                r = grp.slots[i]
+                if r._slo_j is not None:
+                    _slo.note_first_dispatch(r)
+        if self._drafter is not None and all_decoding and any_greedy:
+            self._spec_round(grp, active, sched_step)
+        else:
+            self._chunk_round(grp, active, max_need, sched_step)
+
+    def _paged_inputs(self, grp, C):
+        """Blank leading arrays for one chunk dispatch: empty slots run
+        n=0 (every step masked into their scratch page) over table row
+        zeros — valid page ids whose reads feed discarded logits."""
+        B = self._slots
+        toks = np.zeros((B, C), np.int32)
+        t0 = np.zeros((B,), np.int32)
+        n = np.zeros((B,), np.int32)
+        tables = np.zeros((B, grp.n_pg), np.int32)
+        return toks, t0, n, tables
+
+    def _chunk_round(self, grp, active, max_need, sched_step):
+        import jax.numpy as jnp
+        C = self._prefill_chunk if max_need > 1 else 1
+        toks, t0, n, tables = self._paged_inputs(grp, C)
+        for i in active:
+            r = grp.slots[i]
+            lp = r.prompt.size
+            p = grp.pos[i]
+            if p < lp:
+                ni = min(C, lp - p)
+                toks[i, :ni] = r.prompt[p:p + ni]
+            else:
+                ni = 1
+                toks[i, 0] = r.tokens[p - lp]
+            t0[i] = p
+            n[i] = ni
+            tables[i, :len(grp.pages[i])] = grp.pages[i]
+        run = self._paged_runner(grp.bucket, C, False)
+        lead = (jnp.asarray(toks), jnp.asarray(t0), jnp.asarray(n),
+                jnp.asarray(tables))
+        tdec = time.perf_counter()
+        logits = self._dispatch_paged(grp, run, lead, "target")
+        if self._drafter is not None:
+            # mirror the chunk on the drafter so its cache tracks the
+            # target position-for-position (gap-0: a later speculative
+            # round can start its chain with no catch-up work)
+            drun = self._paged_runner(grp.bucket, C, False, draft=True)
+            self._dispatch_paged(grp, drun, lead, "draft")
+        lg = np.asarray(logits, np.float32)     # host fetch = the fence
+        t1 = time.perf_counter()
+        if _trace._enabled:
+            _trace.record_span("serve.decode_step", tdec, t1, cat="serve",
+                               step=sched_step, bucket=grp.bucket,
+                               slots=len(active), chunk=C,
+                               reqs=[grp.slots[i].id for i in active
+                                     if grp.slots[i] is not None])
+        t_emit = time.perf_counter()
+        with self._lock:
+            self._stats["steps"] += 1
+            self._stats["chunk_dispatches"] += 1
+            for i in active:
+                r = grp.slots[i]
+                if r is None or r.state in TERMINAL:
+                    continue        # evicted/cancelled under the dispatch
+                p = grp.pos[i]
+                ni = int(n[i])
+                grp.pos[i] = p + ni
+                lp = r.prompt.size
+                if p + ni >= lp and not grp.inserted[i]:
+                    self._tree_insert(grp, i, r)
+                if p + ni < lp:
+                    continue        # still prefilling the prompt
+                nxt = self._sample(r, lg[i])
+                self._emit(r, nxt)
+                if (r.eos is not None and nxt == r.eos) \
+                        or len(r.tokens) >= r.max_new_tokens:
+                    self._vacate(grp, i)
+                    self._finish(r, DONE, "200 ok")
+        if _trace._enabled:
+            _trace.record_span("serve.stream", t_emit, cat="serve",
+                               step=sched_step)
+
+    def _spec_round(self, grp, active, sched_step):
+        """One speculative decoding round: the drafter chains k greedy
+        proposals per eligible slot, the target verifies them all in ONE
+        k+1-token chunk (full logits), and the host keeps the longest
+        agreeing prefix plus the bonus token — exact greedy acceptance,
+        so the emitted stream is bit-identical to plain greedy decode.
+        Non-greedy slots ride along with a single ordinary token."""
+        import jax.numpy as jnp
+        k = self._spec_k
+        tok0 = np.zeros((self._slots,), np.int32)
+        spec_row = np.zeros((self._slots,), bool)
+        toks, t0, n, tables = self._paged_inputs(grp, k + 1)
+        for i in active:
+            r = grp.slots[i]
+            p = grp.pos[i]
+            tok0[i] = r.tokens[p - r.prompt.size]
+            t0[i] = p
+            tables[i, :len(grp.pages[i])] = grp.pages[i]
+            spec_row[i] = r.temperature == 0.0
+        drafts_out = self._dispatch_paged(
+            grp, self._draft_runner(grp.bucket),
+            (jnp.asarray(tok0), jnp.asarray(t0), jnp.asarray(spec_row),
+             jnp.asarray(tables)), "draft")
+        drafts = np.asarray(drafts_out, np.int32)[:, :k]   # (B, k)
+        for i in active:
+            toks[i, 0] = tok0[i]
+            if spec_row[i]:
+                toks[i, 1:] = drafts[i]
+                n[i] = k + 1
+            else:
+                n[i] = 1
+        run = self._paged_runner(grp.bucket, k + 1, True)
+        tdec = time.perf_counter()
+        logits = self._dispatch_paged(
+            grp, run, (jnp.asarray(toks), jnp.asarray(t0),
+                       jnp.asarray(n), jnp.asarray(tables)), "target")
+        lgs = np.asarray(logits, np.float32)               # (B, k+1, V)
+        t1 = time.perf_counter()
+        if _trace._enabled:
+            _trace.record_span("serve.decode_step", tdec, t1, cat="serve",
+                               step=sched_step, bucket=grp.bucket,
+                               slots=len(active), spec_k=k,
+                               reqs=[grp.slots[i].id for i in active
+                                     if grp.slots[i] is not None])
+        t_emit = time.perf_counter()
+        with self._lock:
+            self._stats["steps"] += 1
+            self._stats["spec_rounds"] += 1
+            for i in active:
+                r = grp.slots[i]
+                if r is None or r.state in TERMINAL:
+                    continue
+                p = grp.pos[i]
+                if not spec_row[i]:
+                    grp.pos[i] = p + 1
+                    nxt = self._sample(r, lgs[i, 0])
+                    self._emit(r, nxt)
+                    if (r.eos is not None and nxt == r.eos) \
+                            or len(r.tokens) >= r.max_new_tokens:
+                        self._vacate(grp, i)
+                        self._finish(r, DONE, "200 ok")
+                    continue
+                self._stats["drafts_proposed"] += k
+                emitted = 0
+                done = False
+                for j in range(k + 1):
+                    # same argmax as _sample's greedy path — exact
+                    # acceptance means verify-then-keep, never trust
+                    nxt = int(lgs[i, j].argmax())
+                    self._emit(r, nxt)
+                    emitted += 1
+                    if (r.eos is not None and nxt == r.eos) \
+                            or len(r.tokens) >= r.max_new_tokens:
+                        done = True
+                        break
+                    if j >= k or int(drafts[i, j]) != nxt:
+                        break
+                    self._stats["drafts_accepted"] += 1
+                grp.pos[i] = p + emitted
+                if done:
+                    self._vacate(grp, i)
+                    self._finish(r, DONE, "200 ok")
+        if _trace._enabled:
+            _trace.record_span("serve.stream", t_emit, cat="serve",
+                               step=sched_step)
+
+    def _tree_insert(self, grp, i, req):
+        """One-time prefix-tree registration of a slot's fully-prefilled
+        prompt blocks (whole pages only — the partial tail stays
+        exclusively owned, and decode writes only land at positions past
+        the prompt, so registered pages are immutable from here on)."""
+        lp = req.prompt.size
+        self._tree.insert(req.prompt, grp.pages[i][:lp // self._page_size])
+        grp.inserted[i] = True
+
+    def _dispatch_paged(self, grp, run, lead, tag):
+        """One paged dispatch under the RetryPolicy, threading the
+        pool's `tag` page-array stream through the donated state (same
+        donated-buffer safety rule as `_dispatch`)."""
+        pool = self._pool
+
+        def call():
+            c0 = pool.state[tag][0]
+            if hasattr(c0, "is_deleted") and c0.is_deleted():
+                raise RuntimeError(
+                    "mx.serve: the failed dispatch consumed the donated "
+                    f"page-pool buffers ('{tag}' stream) — cannot retry "
+                    f"in place (bucket {grp.bucket})")
+            out, new_state = run(*lead, pool.state[tag])
+            pool.state[tag] = new_state
+            return out
+
+        def on_retry(exc, attempt, delay):
+            with self._lock:
+                self._stats["retries"] += 1
+                if _slo._enabled:
+                    for i in grp.active():
+                        r = grp.slots[i]
+                        if r is not None and r._slo_j is not None:
+                            _slo.note_event(r, "retry", attempt=attempt,
+                                            error=type(exc).__name__)
+            print(f"mx.serve: retrying paged dispatch after "
                   f"{type(exc).__name__}: {exc} (attempt {attempt + 2}/"
                   f"{self._retry.max_attempts}, backoff {delay:.2f}s)",
                   file=sys.stderr)

@@ -66,6 +66,7 @@ def _score_sequences(m, src, seqs):
     return np.asarray(scores)
 
 
+@pytest.mark.slow  # ~16s training run; ci train stage runs it unfiltered
 def test_copy_task_greedy_and_beam():
     m, loss = _train_copy_model(steps=150)
     assert loss < 0.3, f"copy task did not train (loss={loss})"
@@ -88,6 +89,7 @@ def test_copy_task_greedy_and_beam():
     assert a_b >= a_g, f"beam ({a_b}) worse than greedy ({a_g})"
 
 
+@pytest.mark.slow  # ~15s training run; ci train stage runs it unfiltered
 def test_beam_score_at_least_greedy():
     """Beam search's actual guarantee: the returned sequence's model score
     is >= the greedy sequence's (alpha=0 disables length normalization).

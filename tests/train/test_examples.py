@@ -10,7 +10,7 @@ import pytest
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                     os.pardir, os.pardir))
 
-# the two heaviest scripts (~25s each on the 1-core sweep box, per the
+# the heaviest scripts (~15-25s each on the 1-core sweep box, per the
 # mx.ledger tier-1 budget record) are slow-marked out of the tier-1
 # filter; ci/run.sh train runs tests/train unfiltered so they stay
 # covered every CI pass
@@ -34,15 +34,19 @@ CASES = [
       "--steps", "2"], "step 1"),
     ("gpt/generate.py",
      ["--steps", "60", "--merges", "40", "--max-new", "8"], "generated:"),
-    ("nmt/train_transformer.py",
-     ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
-      "--units", "32"], "decode token accuracy"),
+    pytest.param(
+        "nmt/train_transformer.py",
+        ["--steps", "20", "--batch-size", "8", "--seq-len", "5",
+         "--units", "32"], "decode token accuracy",
+        marks=pytest.mark.slow),
     pytest.param(
         "detection/train_yolo.py",
         ["--steps", "4", "--batch-size", "4"], "VOC07 mAP",
         marks=pytest.mark.slow),
-    ("timeseries/train_deepar.py",
-     ["--epochs", "10", "--series", "8", "--samples", "5"], "CRPS"),
+    pytest.param(
+        "timeseries/train_deepar.py",
+        ["--epochs", "10", "--series", "8", "--samples", "5"], "CRPS",
+        marks=pytest.mark.slow),
     ("module_api/train_mnist_module.py",
      ["--epochs", "2"], "final validation"),
     ("ocr/train_crnn.py",

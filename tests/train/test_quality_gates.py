@@ -158,6 +158,7 @@ def test_resnet18_synthetic_gratings_gate():
         parallel.set_mesh(None)
 
 
+@pytest.mark.slow  # ~20s; ci train stage runs tests/train unfiltered
 def test_bert_pair_copy_mlm_gate():
     """Falsifiable BERT gate (VERDICT r4 #4, cloning the SyntheticGratings
     pattern): a deterministic pair-structured language — even positions
@@ -265,6 +266,7 @@ def test_nmt_reversal_bleu_gate():
     assert score >= 0.95, f"reversal BLEU {score:.3f} < 0.95 gate"
 
 
+@pytest.mark.slow  # ~16s; ci train stage runs tests/train unfiltered
 def test_crnn_ctc_glyph_gate():
     """Falsifiable CTC gate (the SyntheticGratings pattern for the OCR
     stack): the deterministic rendered-glyph task is fully solvable, so

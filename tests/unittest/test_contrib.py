@@ -144,6 +144,7 @@ def test_quantized_conv_matches_float():
     assert err < 0.05, f"int8 conv error {err}"
 
 
+@pytest.mark.slow  # ~40s: heaviest tier-1 test; ci unittest stage runs it
 def test_quantize_resnet18_end_to_end():
     """int8 ResNet-18: quantize_block swaps every conv+dense through the
     residual graph (hook-based calibration) and top-1 ACCURACY stays within

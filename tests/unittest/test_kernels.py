@@ -25,6 +25,7 @@ from mxnet_tpu import config
 im = importlib.import_module("mxnet_tpu.pallas_ops.int8_matmul")
 fu = importlib.import_module("mxnet_tpu.pallas_ops.fused_update")
 mk = importlib.import_module("mxnet_tpu.pallas_ops.moe_kernels")
+pa = importlib.import_module("mxnet_tpu.pallas_ops.paged_attention")
 _common = importlib.import_module("mxnet_tpu.pallas_ops._common")
 
 
@@ -330,6 +331,83 @@ def test_moe_ffn_kernel_path_matches_einsum_path():
     for a, b in zip(got_g, ref_g):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# paged attention
+# --------------------------------------------------------------------------
+
+def _paged_case(B=3, H=4, D=16, ps=8, n_pg=4, P=20, dtype=np.float32,
+                seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, 1, D).astype(dtype))
+    kp = jnp.asarray(rng.randn(P, H, ps, D).astype(dtype))
+    vp = jnp.asarray(rng.randn(P, H, ps, D).astype(dtype))
+    tables = jnp.asarray(rng.randint(0, P, (B, n_pg)).astype(np.int32))
+    t = jnp.asarray(np.array([5, 17, n_pg * ps - 1], np.int32)[:B])
+    return q, kp, vp, tables, t
+
+
+def test_paged_attention_interpret_parity():
+    q, kp, vp, tables, t = _paged_case()
+    got = pa.paged_attention(q, kp, vp, tables, t)
+    ref = pa.paged_attention_reference(q, kp, vp, tables, t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_paged_attention_parity_bf16():
+    q, kp, vp, tables, t = _paged_case(dtype=np.float32)
+    q, kp, vp = (a.astype(jnp.bfloat16) for a in (q, kp, vp))
+    got = pa.paged_attention(q, kp, vp, tables, t)
+    assert got.dtype == jnp.bfloat16
+    ref = pa.paged_attention_reference(q, kp, vp, tables, t)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_paged_attention_kernels_off_is_reference_path():
+    config.set("kernels", "off")
+    q, kp, vp, tables, t = _paged_case()
+    j1 = jax.make_jaxpr(pa.paged_attention)(q, kp, vp, tables, t)
+    j2 = jax.make_jaxpr(pa.paged_attention_reference)(q, kp, vp,
+                                                      tables, t)
+    assert str(j1) == str(j2)
+
+
+def test_paged_attention_reference_matches_dense_gather():
+    """Tables laid out contiguously (page i of row b = pool row holding
+    positions [i*ps, (i+1)*ps)) reduce the paged computation to the
+    dense cached-attention expression — the shape identity serve's
+    pages=on-vs-off bit-identity rests on."""
+    rng = np.random.RandomState(3)
+    B, H, D, ps, n_pg = 2, 4, 16, 8, 3
+    L = n_pg * ps
+    k = rng.randn(B, H, L, D).astype(np.float32)
+    v = rng.randn(B, H, L, D).astype(np.float32)
+    q = jnp.asarray(rng.randn(B, H, 1, D).astype(np.float32))
+    t = jnp.asarray(np.array([7, 20], np.int32))
+    # scatter the dense caches into pool pages, contiguous tables
+    kp = np.zeros((B * n_pg, H, ps, D), np.float32)
+    vp = np.zeros_like(kp)
+    tables = np.zeros((B, n_pg), np.int32)
+    for b in range(B):
+        for i in range(n_pg):
+            pid = b * n_pg + i
+            tables[b, i] = pid
+            kp[pid] = k[b, :, i * ps:(i + 1) * ps, :]
+            vp[pid] = v[b, :, i * ps:(i + 1) * ps, :]
+    got = pa.paged_attention_reference(q, jnp.asarray(kp),
+                                       jnp.asarray(vp),
+                                       jnp.asarray(tables), t)
+    # dense masked attention, the decode_step math
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, jnp.asarray(k)) / (D ** 0.5)
+    valid = jnp.arange(L)[None, None, None, :] <= t[:, None, None, None]
+    s = jnp.where(valid, s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1),
+                     jnp.asarray(v))
+    assert np.array_equal(np.asarray(got), np.asarray(ref))
 
 
 # --------------------------------------------------------------------------

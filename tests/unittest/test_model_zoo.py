@@ -19,8 +19,9 @@ from mxnet_tpu.gluon.model_zoo import get_model, vision
     ("resnet18_v1", 32),
     ("resnet18_v2", 32),
     ("resnet50_v2", 32),
-    ("densenet121", 64),
-    ("inceptionv3", 96),
+    # the two heaviest zoo builds stay covered via ci's unittest stage
+    pytest.param("densenet121", 64, marks=pytest.mark.slow),
+    pytest.param("inceptionv3", 96, marks=pytest.mark.slow),
 ])
 def test_zoo_forward_shapes(name, size):
     net = get_model(name, classes=10)

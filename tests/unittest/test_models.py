@@ -80,6 +80,7 @@ def test_resnet50_shapes_and_grad():
     assert np.abs(g).sum() > 0
 
 
+@pytest.mark.slow  # ~40s convergence run; ci unittest stage runs it
 def test_resnet18_trains():
     net = resnet_mod.resnet18_v1(classes=4)
     net.initialize()

@@ -41,6 +41,8 @@ HIGHER_BETTER = (
     "achieved_tflops", "vs_baseline", "compile_cache_hit",
     "memory_headroom_bytes", "completed",
     "int8_tokens_per_sec", "int8_requests_per_sec", "int8_completed",
+    "pages_tokens_per_sec", "pages_requests_per_sec", "pages_completed",
+    "prefix_hit_rate", "accepted_draft_rate", "pages_speedup",
     "speedup",
 )
 #: numeric fields where a bigger number is a worse run
@@ -50,6 +52,7 @@ LOWER_BETTER = (
     "ttft_p99_ms", "step_skew_p99_ms", "deadline_missed", "shed",
     "rejected", "oom_recoveries", "check_findings", "requeues",
     "degraded", "int8_ttft_p50_ms", "int8_ttft_p99_ms",
+    "pages_ttft_p50_ms", "pages_ttft_p99_ms",
     "pallas_ms", "xla_ms",
 )
 #: provenance fields that must MATCH for two rows to be comparable
