@@ -121,6 +121,7 @@ def run_bench(on_tpu):
     import mxnet_tpu as mx
     from mxnet_tpu import check as mxcheck
     from mxnet_tpu import diagnostics, memsafe, nd, parallel, telemetry
+    from mxnet_tpu import goodput as mxgoodput
     from mxnet_tpu import inspect as mxinspect
     from mxnet_tpu import trace as mxtrace
     from mxnet_tpu.models import bert as bert_mod
@@ -153,6 +154,13 @@ def run_bench(on_tpu):
     # gang-timeline trajectory next to the throughput one. Sampled steps
     # fence, but telemetry above already fences every step.
     mxtrace.enable()
+    # mx.goodput rides along (memory-only, no goodput_dir): the JSON line
+    # gets the run's goodput fraction (productive step seconds over the
+    # armed wall-clock — compile/warmup drags it below 1.0 on a cold run)
+    # and its top badput cause, so the ledger trajectory catches a
+    # regression in where the bench's wall-clock WENT, not just how fast
+    # the steady-state loop was
+    mxgoodput.enable()
 
     backend = jax.default_backend()
     n_dev = len(jax.devices())
@@ -298,6 +306,12 @@ def run_bench(on_tpu):
             telemetry.counter("compile_cache_hits_total").value),
         "prefetch": bool(use_prefetch),
     }
+    # mx.goodput wall-clock accounting: what fraction of the armed run
+    # produced new kept progress, and where the rest went (a cold run
+    # says "compile"; a stall regression flips it to "input_stall")
+    _gp = mxgoodput.snapshot()
+    out["goodput_fraction"] = _gp.get("goodput_fraction")
+    out["badput_top_cause"] = _gp.get("top_badput_cause")
     # XLA-cost-model efficiency of the train-step executable (mx.inspect):
     # all four fields always present, null when the backend withheld the
     # input (CPU: no peak-FLOPs table entry -> mfu null; single device ->

@@ -13,6 +13,10 @@
 #   ci/run.sh pages       — mx.pages paged serving: off-path
 #                           zero-overhead, shared-prefix bit-identity,
 #                           interpret-mode kernel parity
+#   ci/run.sh goodput     — mx.goodput wall-clock accounting: off-path
+#                           zero-overhead, seeded kill@step fault run
+#                           whose report must attribute restart downtime
+#                           and replayed steps correctly
 #   ci/run.sh all         — everything + the driver-contract gate
 set -e
 cd "$(dirname "$0")/.."
@@ -146,6 +150,15 @@ assert d['critical_path'] is None, '1-device bench must report null'
 for k in ('platform', 'devices', 'smoke_mode'):
     assert k in d, f'bench JSON missing provenance {k}: {sorted(d)}'
 assert d['smoke_mode'] is True and d['platform'] == 'cpu', d
+# mx.goodput ride-along: every bench row reports what fraction of the
+# measured wall-clock produced kept progress and the top badput cause
+# (nullable, but the keys must exist for the ledger trend series)
+for k in ('goodput_fraction', 'badput_top_cause'):
+    assert k in d, f'bench JSON missing {k}: {sorted(d)}'
+assert d['goodput_fraction'] is None or \
+    0.0 <= d['goodput_fraction'] <= 1.0, d['goodput_fraction']
+assert d['badput_top_cause'] is None or \
+    isinstance(d['badput_top_cause'], str), d['badput_top_cause']
 print('bench efficiency fields OK:', {k: d[k] for k in
       ('mfu', 'achieved_tflops', 'peak_device_bytes',
        'comm_bytes_per_step', 'check_findings', 'step_skew_p99_ms',
@@ -1007,6 +1020,64 @@ print('pages shared-prefix smoke OK: bit-identical, hit_rate=%.2f,'
         -k "paged_attention"
 }
 
+goodput_stage() {
+    echo "== goodput =="
+    # goodput must be disabled by default: a full prefetch training loop
+    # AND a full serve request lifecycle make ZERO accountant calls
+    # (every hook site reduces to one module-bool check), no interval
+    # state exists, and nothing is written
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, dataflow, serve, goodput
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.models import gpt as gpt_mod
+assert not goodput.enabled(), 'goodput must default to off'
+hooks = ('note', 'note_step', 'note_oom_begin', 'note_resume',
+         'note_rollback', 'enable')
+calls = {h: 0 for h in hooks}
+real = {h: getattr(goodput, h) for h in hooks}
+for h in hooks:
+    setattr(goodput, h, lambda *a, _h=h, **k: calls.__setitem__(_h, calls[_h] + 1))
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), 'sgd',
+                             {'learning_rate': 0.1})
+x = nd.array(np.ones((8, 8), np.float32))
+y = nd.array(np.zeros((8, 4), np.float32))
+for d, l in dataflow.prefetch_to_mesh(iter([([x], [y])] * 3), tr, depth=2):
+    tr.step(d, l)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+model.initialize()
+srv = serve.Server(model, slots=2)
+r = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+srv.drain()
+srv.stop()
+for h in hooks:
+    setattr(goodput, h, real[h])
+assert r.state == serve.DONE
+assert calls == {h: 0 for h in hooks}, calls
+assert goodput._totals is None and goodput._cursor is None, \
+    'disabled fast path allocated accountant state'
+assert goodput.snapshot()['enabled'] is False
+print('goodput disabled fast path OK (zero hook calls, no state)')
+"
+    # seeded-fault acceptance (slow-marked out of the tier-1 sweep):
+    # 2-rank launch with --goodput-dir, rank 1 SIGKILLed at step 3,
+    # elastic relaunch resumes and replays — tools/goodput_report.py
+    # must partition 100% of gang wall-clock (within 1%), attribute the
+    # restart downtime, and count replayed steps == high-water minus
+    # the restored step; plus the SDC-rollback replay classification
+    # and the serve idle/decode split (slow-marked for tier-1 budget,
+    # covered here every pass)
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_goodput.py::test_kill_relaunch_report_attributes_downtime_and_replay \
+        tests/unittest/test_goodput.py::test_rollback_steps_count_as_replay \
+        tests/unittest/test_goodput.py::test_serve_idle_vs_decode_split \
+        -q -p no:cacheprovider
+}
+
 case "$stage" in
     sanity) sanity ;;
     static) static_stage ;;
@@ -1015,6 +1086,7 @@ case "$stage" in
     train) train_stage ;;
     native) native_stage ;;
     pages) pages_stage ;;
+    goodput) goodput_stage ;;
     ledger) ledger_stage ;;
     all)
         sanity
@@ -1024,6 +1096,7 @@ case "$stage" in
         dist_stage
         train_stage
         pages_stage
+        goodput_stage
         ledger_stage
         sh tools/check.sh
         ;;

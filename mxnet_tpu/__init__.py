@@ -58,6 +58,7 @@ _LAZY = {
     "memsafe": ".memsafe",
     "check": ".check",
     "guard": ".guard",
+    "goodput": ".goodput",
     "scope": ".scope",
     "serve": ".serve",
     "pages": ".pages",

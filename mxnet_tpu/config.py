@@ -767,6 +767,31 @@ register_option(
     "scaling AMP loss scaler is attached, whose overflow-skip handles "
     "Inf grads as routine. Costs one device sync per step.")
 register_option(
+    "goodput", "off", choices=("off", "on"),
+    doc="mx.goodput gang-level wall-clock accounting. 'off' (default) "
+        "is the zero-overhead fast path: every hook site (trainer "
+        "step/compile, dataflow batch-wait, checkpoint save/restore, "
+        "reshard/resize, OOM-ladder recovery, serve scheduler loop) "
+        "reduces to one module-bool check — no accountant state, zero "
+        "allocations (asserted by ci/run.sh goodput). 'on' classifies "
+        "every second of run wall-clock into exhaustive non-overlapping "
+        "categories (goodput: productive step / serve decode; badput: "
+        "compile, input stall, checkpoint, reshard, OOM recovery, "
+        "rollback replay, serve idle/degraded) with a step-id "
+        "high-water mark so re-trained steps after a rollback or "
+        "restart count as badput:replay, never goodput. Merge rank "
+        "files + restarts.jsonl with tools/goodput_report.py; "
+        "tools/launch.py --goodput-dir arms the whole gang.")
+register_option(
+    "goodput_dir", "",
+    "Base directory for mx.goodput interval files: each rank appends "
+    "its classified wall-clock intervals to <dir>/<rank>/goodput.jsonl "
+    "(meta line first, torn-line tolerant). A relaunched rank recovers "
+    "its step-id high-water mark from the existing file, so replayed "
+    "steps after a restart are attributed badput:replay. Empty "
+    "(default) accounts in memory only — live surfaces (statusz, "
+    "telemetry, post-mortem) still work; nothing is persisted.")
+register_option(
     "ledger_dir", "",
     "Base directory for the mx.ledger cross-run performance ledger: "
     "every bench entrypoint and the ci tier-1 sweep append one "

@@ -43,6 +43,7 @@ import numpy as np
 
 from . import _locklint
 from . import config as _config
+from . import goodput as _goodput
 from . import guard as _guard
 from . import resilience as _resilience
 from . import telemetry as _telemetry
@@ -160,7 +161,7 @@ class MeshPrefetcher:
     def __next__(self):
         if self._exhausted or self._closed.is_set():
             raise StopIteration
-        if _telemetry._enabled or _trace._enabled:
+        if _telemetry._enabled or _trace._enabled or _goodput._enabled:
             t0 = time.perf_counter()
             item = self._q.get()
             if item is not _STOP and not isinstance(item, BaseException):
@@ -176,6 +177,10 @@ class MeshPrefetcher:
                     # the span trace_report's input-bound verdict sums
                     _trace.record_span("input.batch_wait", t0, t1,
                                        cat="input")
+                if _goodput._enabled:
+                    # the same consumer-visible wait, accounted as
+                    # badput:input_stall wall-clock
+                    _goodput.note("input_stall", t0, t1)
         else:
             item = self._q.get()
         if item is _STOP:

@@ -147,6 +147,14 @@ def record_step(step, loss=None, lr=None, grad_norm=None, shapes=None,
         "compile_total": _M_COMPILE_TOTAL.value,
         "recompile_total": _M_RECOMPILE_TOTAL.value,
     }
+    _gp = sys.modules.get(__package__ + ".goodput")
+    if _gp is not None and _gp._enabled and _gp._t_enable is not None:
+        # bare dict reads, no accountant lock — same compact-digest
+        # discipline as the telemetry counters above
+        _el = time.perf_counter() - _gp._t_enable
+        if _el > 0:
+            _good = sum((_gp._totals or {}).get(c, 0.0) for c in _gp.GOOD)
+            rec["goodput_fraction"] = round(min(1.0, _good / _el), 4)
     rec.update(extra)
     with _lock:
         # appends share the readers' lock: records() list()s the deque and
@@ -723,6 +731,17 @@ def dump(reason="manual", exc_info=None, note=None, path=None):
             pm["guard"] = _g.snapshot()
     except Exception as e:
         pm["guard"] = {"error": str(e)}
+    try:
+        # wall-clock accounting story (mx.goodput — via sys.modules so a
+        # run that never touched it pays no import): per-category
+        # goodput/badput seconds, the fraction, top badput cause, and
+        # the progress high-water mark — a post-mortem of a thrashing
+        # run then shows where its wall-clock went
+        _gp = sys.modules.get(__package__ + ".goodput")
+        if _gp is not None and _gp._enabled:
+            pm["goodput"] = _gp.snapshot()
+    except Exception as e:
+        pm["goodput"] = {"error": str(e)}
     try:
         pm["profiler_tail"] = _profiler_tail()
     except Exception:

@@ -63,6 +63,7 @@ import time
 
 from . import _locklint
 from . import config as _config
+from . import goodput as _goodput
 from . import telemetry as _telemetry
 
 __all__ = [
@@ -877,6 +878,10 @@ def _sdc_restore(trainer, step):
     _sdc_restores += 1
     if _telemetry._enabled:
         _M_SDC_RESTORES.inc()
+    if _goodput._enabled:
+        # steps at or below the rolled-back high-water re-train as
+        # badput:replay, not goodput, until progress passes it again
+        _goodput.note_rollback(int(step), int(restored))
     print(f"mx.guard: restored the last verified checkpoint (step "
           f"{restored}) — replaying past the corrupted update",
           file=sys.stderr)

@@ -89,6 +89,7 @@ HIGHER_BETTER = (
     "native", "python", "dataloader_w1", "dataloader_w8",
     "fwd_tflops", "fwd_mxu_eff", "fwdbwd_mxu_eff", "lamb_eff_gbps",
     "matmul_ceiling_tflops", "achievable_mfu", "passed", "ok",
+    "goodput_fraction",
 )
 LOWER_BETTER = (
     "step_p99_ms", "compile_time_s", "recompile_count",
@@ -397,6 +398,27 @@ def telemetry_digest():
     return out
 
 
+def goodput_digest():
+    """Compact digest of the live mx.goodput accountant — the goodput
+    fraction, per-category seconds, top badput cause, high-water step.
+    Same no-import discipline as telemetry_digest(): read only when the
+    module is already in sys.modules and armed."""
+    gp = sys.modules.get("mxnet_tpu.goodput")
+    if gp is None or not getattr(gp, "_enabled", False):
+        return None
+    try:
+        snap = gp.snapshot()
+        return {"goodput_fraction": snap.get("goodput_fraction"),
+                "goodput_s": snap.get("goodput_s"),
+                "badput_s": snap.get("badput_s"),
+                "untracked_s": snap.get("untracked_s"),
+                "top_badput_cause": snap.get("top_badput_cause"),
+                "categories": snap.get("categories"),
+                "hw_step": snap.get("hw_step")}
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # record builders / hooks
 # ---------------------------------------------------------------------------
@@ -410,6 +432,10 @@ def build_run_record(bench, rows, provenance=None, ts=None, source=None,
         provenance = build_provenance(rows)
     if digest is None:
         digest = telemetry_digest()
+        gd = goodput_digest()
+        if gd is not None:
+            digest = dict(digest or {})
+            digest["goodput"] = gd
     ts = time.time() if ts is None else ts
     rec = {"kind": "run", "schema": SCHEMA, "bench": bench, "ts": ts,
            "iso": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts)),

@@ -57,6 +57,7 @@ from . import _locklint
 
 from . import config as _config
 from . import diagnostics as _diagnostics
+from . import goodput as _goodput
 from . import telemetry as _telemetry
 
 __all__ = [
@@ -609,6 +610,7 @@ def recover_trainer(trainer, exc, data, labels, fence_every):
     shift relative to an uninterrupted one — losses stay valid, they are
     just a different sample. Deterministic-parity tests run dropout-free."""
     step = int(trainer.num_update) + 1
+    t_rung = time.perf_counter() if _goodput._enabled else None
     if not isinstance(exc, MemoryBudgetError):
         # pre-flight rejections already counted themselves in check_budget
         _count_oom("device", step=step)
@@ -643,6 +645,15 @@ def recover_trainer(trainer, exc, data, labels, fence_every):
             trainer.set_grad_accum(value)
         trainer._step_cache.clear()
         _note_transition(trainer, kind, value, step)
+        if _goodput._enabled:
+            # the ladder walk so far (failed attempt + re-plan) is
+            # badput:oom_recovery, and so is the retry's re-jit below
+            # (note_oom_begin re-categorizes its cache-miss interval)
+            now = time.perf_counter()
+            _goodput.note("oom_recovery", t_rung if t_rung is not None
+                          else now, now, step=step, rung=kind)
+            t_rung = now
+            _goodput.note_oom_begin(step)
         try:
             out = trainer._step_once(data, labels, fence_every)
         except Exception as e2:  # noqa: BLE001 — classified below

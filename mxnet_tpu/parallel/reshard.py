@@ -63,6 +63,7 @@ import time
 import numpy as np
 
 from .. import config as _config
+from .. import goodput as _goodput
 from .. import telemetry as _telemetry
 
 __all__ = ["ReshardError", "Plan", "Session", "state_layouts",
@@ -455,8 +456,12 @@ class Session:
         a "reshard" event, the diagnostics ring entry, and the module's
         last_reshard() info (merged into the resume post-mortem)."""
         plan = Plan(self.moves)
-        note_reshard(kind, plan, time.perf_counter() - self._t0,
+        t1 = time.perf_counter()
+        note_reshard(kind, plan, t1 - self._t0,
                      src_fp=src_fp, dst_fp=dst_fp)
+        if _goodput._enabled:
+            # "op" not "kind": the record's "kind" key is the line type
+            _goodput.note("reshard", self._t0, t1, op=kind)
         return plan
 
 

@@ -25,6 +25,7 @@ from .. import _engine
 from .. import check as _check
 from .. import config as _config
 from .. import diagnostics as _diagnostics
+from .. import goodput as _goodput
 from .. import guard as _guard
 from .. import inspect as _inspect
 from .. import memsafe as _memsafe
@@ -596,8 +597,11 @@ class ShardedTrainer:
         # A cache-miss step traces regardless of sampling — compiles are
         # always-record events (rare, seconds-scale)
         tracing = _trace._enabled and (is_miss or _trace.sampled(step_no))
+        # mx.goodput accounts every completed step (replay-aware) — one
+        # module bool here, like the other observers
+        accounting = _goodput._enabled
         observing = (_telemetry._enabled or _diagnostics._enabled or sentinel
-                     or _inspect._enabled or tracing)
+                     or _inspect._enabled or tracing or accounting)
         t_build = time.perf_counter() if (is_miss and observing) else None
         if is_miss:
             self._step_cache[key] = self._build_step(len(data), len(labels), shapes)
@@ -718,7 +722,7 @@ class ShardedTrainer:
             fenced = False
             if observing:
                 if _telemetry._enabled or sentinel or _inspect._enabled \
-                        or tracing:
+                        or tracing or accounting:
                     # fence on the loss (one output of the step executable
                     # fences the whole executable) so the histogram records
                     # device step time, not just async dispatch; on tunnel
@@ -742,6 +746,11 @@ class ShardedTrainer:
                 if tracing:
                     self._trace_record_step(step_no, t_build, t_step,
                                             t_disp, t_done)
+                if accounting:
+                    # before inspect (whose miss-path analysis takes
+                    # real wall time): the step's interval must end at
+                    # the fence, not at the analyzer
+                    _goodput.note_step(step_no, t_build, t_step, t_done)
                 if _inspect._enabled:
                     # LAST observer: the miss-path analysis lower+compile
                     # takes real wall time that must not leak into the

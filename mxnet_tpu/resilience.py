@@ -60,6 +60,7 @@ import zlib
 from . import _locklint
 from . import config as _config
 from . import diagnostics as _diagnostics
+from . import goodput as _goodput
 from . import guard as _guard
 from . import telemetry as _telemetry
 from . import trace as _trace
@@ -676,6 +677,8 @@ class CheckpointManager:
             # the peers show step spans is checkpoint-bound, not slow
             _trace.record_span("checkpoint.save", t0, t0 + dt, step=step,
                                cat="checkpoint", always=True)
+        if _goodput._enabled:
+            _goodput.note("checkpoint_save", t0, t0 + dt, step=step)
         _diagnostics.record_event("checkpoint", step=step, path=path,
                                   dur_s=round(dt, 6))
         self._gc()
@@ -767,6 +770,9 @@ class CheckpointManager:
         self._last_saved_step = int(self.trainer.num_update)
         if _telemetry._enabled:
             _M_RESTORE_SECONDS.observe(time.perf_counter() - t0)
+        if _goodput._enabled:
+            _goodput.note("checkpoint_restore", t0, time.perf_counter(),
+                          step=int(self.trainer.num_update))
         return path
 
     def last_saved_path(self):
@@ -850,6 +856,10 @@ def _note_resume(path, step, fallbacks=0):
                          fallbacks=fallbacks)
     _diagnostics.record_event("resume", path=path, step=int(step),
                               fallbacks=fallbacks)
+    if _goodput._enabled:
+        # marker for the offline report: replayed-step count must equal
+        # the high-water mark minus this restored step
+        _goodput.note_resume(int(step))
 
 
 # ---------------------------------------------------------------------------

@@ -339,6 +339,16 @@ def _serve_section():
     return out
 
 
+def _goodput_section():
+    gp = sys.modules.get(__package__ + ".goodput")
+    if gp is None or not gp._enabled:
+        return None
+    try:
+        return gp.snapshot()
+    except Exception:
+        return None
+
+
 def _slo_section():
     sl = sys.modules.get(__package__ + ".slo")
     if sl is None or not sl._enabled:
@@ -365,6 +375,7 @@ def statusz(state=None):
     out["rungs"] = _rungs_section(state)
     out["serve"] = _serve_section()
     out["slo"] = _slo_section()
+    out["goodput"] = _goodput_section()
     out["trace"] = _trace.skew_verdict()
     out["guard"] = _guard.snapshot() if _guard._enabled else None
     out["profile"] = state.profile_status()

@@ -93,6 +93,7 @@ import numpy as np
 from . import _locklint
 from . import config as _config
 from . import diagnostics as _diagnostics
+from . import goodput as _goodput
 from . import guard as _guard
 from . import memsafe as _memsafe
 from . import pages as _pages
@@ -711,7 +712,15 @@ class Server:
                 self._scheduler_failed(e)
                 return
             if not work:
-                self._wake.wait(0.005)
+                if _goodput._enabled:
+                    # an empty scheduler pass is queue-idle wall-clock
+                    # (coalesced write-side — one record per idle span,
+                    # not one per 5 ms poll)
+                    t0 = time.perf_counter()
+                    self._wake.wait(0.005)
+                    _goodput.note("serve_idle", t0)
+                else:
+                    self._wake.wait(0.005)
                 self._wake.clear()
 
     def _scheduler_failed(self, exc):
@@ -787,7 +796,18 @@ class Server:
             self._admit()
             groups = [g for g in self._groups.values() if g.active()]
         for grp in groups:
+            if not _goodput._enabled:
+                self._decode_group(grp, n)
+                continue
+            # decode time for a batch holding any degraded/requeued
+            # request is "serve_degraded" — capacity spent delivering
+            # below-contract service rather than clean goodput
+            with self._lock:
+                degr = any(grp.slots[i].degraded or grp.slots[i].requeues
+                           for i in grp.active())
+            t0 = time.perf_counter()
             self._decode_group(grp, n)
+            _goodput.note("serve_degraded" if degr else "serve_decode", t0)
         with self._lock:
             self._gc_groups()
             if _telemetry._enabled:

@@ -1,0 +1,523 @@
+"""mx.goodput tests: the zero-overhead off path, interval-accountant
+partition discipline under concurrent hook fire, step classification
+precedence (replay / oom_recovery / compile / step), write-side
+coalescing, torn-line healing, high-water recovery across relaunch
+generations, the serve idle-vs-decode split, the offline report's
+multi-rank merge (silent ranks degrade, never wedge) and partition
+property, and the kill-and-relaunch attribution acceptance."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, dataflow, goodput, nd, parallel, telemetry
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LAUNCH = os.path.join(ROOT, "tools", "launch.py")
+GOODPUT_REPORT = os.path.join(ROOT, "tools", "goodput_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_goodput():
+    yield
+    goodput.disable()
+    goodput.reset()
+    config.reset()
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _trainer():
+    parallel.make_mesh(dp=-1)
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    lfn = gloss.L2Loss()
+    return parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                                   {"learning_rate": 0.1})
+
+
+def _xy():
+    return (nd.array(np.ones((8, 8), np.float32)),
+            nd.array(np.zeros((8, 4), np.float32)))
+
+
+def _report_module():
+    spec = importlib.util.spec_from_file_location("_goodput_report_ut",
+                                                  GOODPUT_REPORT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- the zero-overhead off path ----------------------------------------------
+
+def test_off_by_default_zero_hook_calls():
+    # the production fast path: a prefetch training loop makes ZERO
+    # accountant calls — every hook site is one module-bool check
+    assert not goodput.enabled()
+    hooks = ("note", "note_step", "note_oom_begin", "note_resume",
+             "note_rollback")
+    calls = {h: 0 for h in hooks}
+    real = {h: getattr(goodput, h) for h in hooks}
+    for h in hooks:
+        setattr(goodput, h,
+                lambda *a, _h=h, **k: calls.__setitem__(_h, calls[_h] + 1))
+    try:
+        tr = _trainer()
+        x, y = _xy()
+        for d, l in dataflow.prefetch_to_mesh(iter([([x], [y])] * 3), tr,
+                                              depth=2):
+            tr.step(d, l)
+    finally:
+        for h in hooks:
+            setattr(goodput, h, real[h])
+    assert calls == {h: 0 for h in hooks}
+    assert goodput._totals is None and goodput._cursor is None, \
+        "disabled fast path allocated accountant state"
+
+
+# -- the interval accountant -------------------------------------------------
+
+def test_overlapping_intervals_never_double_count():
+    goodput.enable()
+    t = time.perf_counter()
+    assert goodput.note("step", t, t + 0.4)
+    # fully shadowed by the step above: dropped, counted as shadowed
+    assert not goodput.note("compile", t + 0.1, t + 0.3)
+    # partial overlap keeps only the unclaimed tail [t+0.4, t+0.6)
+    assert goodput.note("input_stall", t + 0.2, t + 0.6)
+    snap = goodput.snapshot()
+    assert snap["categories"]["step"] == pytest.approx(0.4)
+    assert snap["categories"]["input_stall"] == pytest.approx(0.2)
+    assert "compile" not in snap["categories"]
+    assert snap["shadowed_s"] == pytest.approx(0.2)
+    # the partition invariant: claimed seconds equal the covered span
+    assert sum(snap["categories"].values()) == pytest.approx(0.6)
+
+
+def test_partition_exhaustive_under_concurrent_fire():
+    # N threads hammer the accountant with overlapping real-time spans:
+    # goodput + badput can never exceed elapsed (the monotone cursor
+    # drops overlap), and untracked is the explicit remainder so the
+    # three always partition elapsed exactly
+    goodput.enable()
+    cats = ("step", "serve_decode", "compile", "input_stall", "serve_idle")
+
+    def fire(cat):
+        for _ in range(60):
+            t0 = time.perf_counter()
+            time.sleep(0.0005)
+            goodput.note(cat, t0)
+
+    threads = [threading.Thread(target=fire, args=(c,)) for c in cats]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    snap = goodput.snapshot()
+    assert snap["goodput_s"] + snap["badput_s"] <= snap["elapsed_s"] + 1e-6
+    # the three rounded surfaces partition elapsed (3-decimal rounding
+    # leaves up to 1.5 ms of slack)
+    assert snap["goodput_s"] + snap["badput_s"] + snap["untracked_s"] \
+        == pytest.approx(snap["elapsed_s"], abs=0.005)
+    assert snap["shadowed_s"] >= 0.0
+    claimed = sum(snap["categories"].values())
+    assert claimed == pytest.approx(snap["goodput_s"] + snap["badput_s"],
+                                    abs=0.005)
+
+
+def test_note_step_classification_precedence():
+    goodput.enable()
+    t = time.perf_counter()
+    # jit-cache miss: build through fence is badput:compile
+    goodput.note_step(1, t, t + 0.1, t + 0.2)
+    # warm step: goodput
+    goodput.note_step(2, None, t + 0.2, t + 0.3)
+    # the OOM ladder marked step 3: its re-jitted retry is oom_recovery,
+    # NOT compile, even though it is a cache miss
+    goodput.note_oom_begin(3)
+    goodput.note_step(3, t + 0.3, t + 0.35, t + 0.4)
+    goodput.note_step(4, None, t + 0.4, t + 0.5)
+    # step 3 again while the high-water mark is 4: replay beats all
+    goodput.note_step(3, None, t + 0.5, t + 0.6)
+    snap = goodput.snapshot()
+    assert snap["categories"]["compile"] == pytest.approx(0.2)
+    assert snap["categories"]["step"] == pytest.approx(0.2)
+    assert snap["categories"]["oom_recovery"] == pytest.approx(0.1)
+    assert snap["categories"]["replay"] == pytest.approx(0.1)
+    assert snap["hw_step"] == 4
+    assert goodput.high_water() == 4
+
+
+def test_coalescing_merges_contiguous_idle_runs(tmp_path):
+    # high-frequency categories merge while contiguous: three back-to-
+    # back idle waits land as ONE record (n=3) — file volume tracks
+    # state transitions; a category change flushes the run
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    t = time.perf_counter()
+    goodput.note("serve_idle", t, t + 0.01)
+    goodput.note("serve_idle", t + 0.011, t + 0.02)
+    goodput.note("serve_idle", t + 0.021, t + 0.03)
+    goodput.note("step", t + 0.03, t + 0.05, step=1)
+    goodput.flush()
+    recs = [json.loads(line)
+            for line in open(tmp_path / "0" / "goodput.jsonl")]
+    idles = [r for r in recs if r.get("cat") == "serve_idle"]
+    assert len(idles) == 1, recs
+    assert idles[0]["n"] == 3
+    assert idles[0]["dur_us"] == pytest.approx(0.03 * 1e6, rel=0.01)
+    # totals stay exact (the merge changes granularity, not accounting)
+    snap = goodput.snapshot()
+    assert snap["categories"]["serve_idle"] == pytest.approx(0.028)
+
+
+def test_torn_line_healed_and_skipped(tmp_path):
+    # a SIGKILLed writer leaves a half-written final line: the next
+    # generation must heal it (its own records start on a fresh line)
+    # and both the high-water recovery and the report must skip it
+    d = tmp_path / "0"
+    d.mkdir()
+    path = d / "goodput.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": 1, "rank": 0,
+                            "epoch_unix_ns": 10**18, "gen": 0,
+                            "hw_step": 0, "t_start_us": 0.0}) + "\n")
+        f.write(json.dumps({"kind": "int", "cat": "step", "t0_us": 0.0,
+                            "dur_us": 1e6, "step": 7}) + "\n")
+        f.write('{"kind":"int","cat":"st')     # torn: no newline
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    assert goodput.high_water() == 7
+    t = time.perf_counter()
+    goodput.note_step(8, None, t, t + 0.01)
+    goodput.flush()
+    lines = open(path).read().splitlines()
+    parsed, garbage = [], []
+    for line in lines:
+        try:
+            parsed.append(json.loads(line))
+        except ValueError:
+            garbage.append(line)
+    # exactly the torn fragment is garbage — nothing got glued onto it
+    assert garbage == ['{"kind":"int","cat":"st']
+    assert [r["kind"] for r in parsed].count("meta") == 2
+    mod = _report_module()
+    gens = mod.load(str(path))
+    assert len(gens) == 2
+    assert gens[1]["meta"]["hw_step"] == 7
+
+
+def test_high_water_survives_relaunch_generation(tmp_path):
+    # generation 0 completes steps 1..3, dies; the relaunched generation
+    # recovers hw=3 from the file, classifies the re-trained step 3 as
+    # replay, and step 4 as fresh goodput
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    t = time.perf_counter()
+    for s in (1, 2, 3):
+        goodput.note_step(s, None, t + 0.01 * (s - 1), t + 0.01 * s)
+    goodput.flush_summary()
+    goodput.disable()
+    goodput.reset()
+    assert goodput.high_water() == 0
+
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    assert goodput.high_water() == 3
+    t = time.perf_counter()
+    goodput.note_step(3, None, t, t + 0.01)
+    goodput.note_step(4, None, t + 0.01, t + 0.02)
+    goodput.flush()          # the step-4 interval is the coalescing tail
+    snap = goodput.snapshot()
+    assert snap["categories"].get("replay", 0) > 0
+    assert snap["categories"].get("step", 0) > 0
+    assert snap["hw_step"] == 4
+    mod = _report_module()
+    acct = mod.account_rank(mod.load(str(tmp_path / "0" / "goodput.jsonl")))
+    assert acct["generations"] == 2
+    assert acct["hw_step"] == 4
+
+
+def test_rollback_steps_count_as_replay(tmp_path):
+    # the SDC-rollback shape: train to step 5, guard restores the
+    # verified step-2 checkpoint, steps 3..5 re-train as badput:replay
+    # (progress already paid for), step 6 is goodput again — and the
+    # report's replay check verifies count == hw - restored
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    t = time.perf_counter()
+    for s in range(1, 6):
+        goodput.note_step(s, None, t + 0.01 * (s - 1), t + 0.01 * s)
+    goodput.note_rollback(5, restored=2)
+    # continue past the first pass's cursor (t+0.05) — earlier stamps
+    # would be shadowed by the already-claimed span
+    t2 = t + 0.05
+    for i, s in enumerate((3, 4, 5)):
+        goodput.note_step(s, None, t2 + 0.01 * i, t2 + 0.01 * (i + 1))
+    goodput.note_step(6, None, t2 + 0.03, t2 + 0.04)
+    goodput.flush_summary()
+    snap = goodput.snapshot()
+    assert snap["categories"]["replay"] == pytest.approx(0.03, rel=0.01)
+    assert snap["categories"]["step"] == pytest.approx(0.06, rel=0.01)
+    mod = _report_module()
+    acct = mod.account_rank(mod.load(str(tmp_path / "0" / "goodput.jsonl")))
+    checks = [c for c in acct["replay_checks"] if c["ev"] == "rollback"]
+    assert len(checks) == 1
+    chk = checks[0]
+    assert chk["restored"] == 2 and chk["hw"] == 5
+    assert chk["expected_replayed"] == 3 and chk["replayed"] == 3
+    assert chk["ok"]
+
+
+@pytest.mark.slow  # real Server thread + jit; ci/run.sh goodput runs it
+def test_serve_idle_vs_decode_split(tmp_path):
+    # the scheduler loop attributes its own wall-clock: queue-idle waits
+    # land in serve_idle, decode dispatches in serve_decode, and the two
+    # never overlap (monotone cursor)
+    from mxnet_tpu import serve
+    from mxnet_tpu.models import gpt as gpt_mod
+    parallel.make_mesh(dp=-1)
+    model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+    mx.random.seed(0)
+    model.initialize()
+    goodput.enable(goodput_dir=str(tmp_path), rank=0)
+    # start() runs the scheduler thread — without it drain() steps the
+    # scheduler inline and there is no idle loop to account
+    srv = serve.Server(model, slots=2).start()
+    try:
+        time.sleep(0.08)            # queue empty: idle accrues
+        r = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+        # the scheduler THREAD owns decode — result() waits; drain()
+        # would race a second step() against the loop
+        r.result(timeout=120)
+        assert r.state == serve.DONE
+        time.sleep(0.05)
+    finally:
+        srv.stop()
+    snap = goodput.snapshot()
+    assert snap["categories"].get("serve_idle", 0) > 0, snap["categories"]
+    assert snap["categories"].get("serve_decode", 0) > 0, snap["categories"]
+    assert snap["goodput_s"] + snap["badput_s"] <= snap["elapsed_s"] + 1e-6
+
+
+# -- the offline report ------------------------------------------------------
+
+def _write_gen(f, epoch_ns, gen, hw, t_start_us, intervals, events=(),
+               t_end_us=None):
+    f.write(json.dumps({"kind": "meta", "schema": 1, "rank": 0,
+                        "epoch_unix_ns": epoch_ns, "gang_epoch_ns": None,
+                        "gen": gen, "hw_step": hw,
+                        "t_start_us": t_start_us}) + "\n")
+    for cat, t0, dur, step in intervals:
+        rec = {"kind": "int", "cat": cat, "t0_us": t0, "dur_us": dur}
+        if step is not None:
+            rec["step"] = step
+        f.write(json.dumps(rec) + "\n")
+    for ev in events:
+        f.write(json.dumps(dict(ev, kind="ev")) + "\n")
+    if t_end_us is not None:
+        f.write(json.dumps({"kind": "summary", "schema": 1, "rank": 0,
+                            "gen": gen, "t_end_us": t_end_us,
+                            "hw_step": hw}) + "\n")
+
+
+def _two_gen_fixture(dirpath, rank):
+    """Rank file with a 2 s restart gap: gen 0 trains steps 1..3
+    (compile-heavy), gen 1 resumes from step 2 and replays step 3."""
+    d = dirpath / str(rank)
+    d.mkdir(parents=True)
+    e0 = 10**18
+    with open(d / "goodput.jsonl", "w") as f:
+        _write_gen(f, e0, 0, 0, 0.0,
+                   [("compile", 0.0, 2e6, 1),
+                    ("step", 2e6, 1e6, 2),
+                    ("step", 3e6, 1e6, 3)],
+                   t_end_us=4e6)
+        _write_gen(f, e0 + 6 * 10**9, 1, 3, 0.0,
+                   [("replay", 0.1e6, 0.5e6, 3),
+                    ("step", 0.6e6, 1e6, 4),
+                    ("step", 1.6e6, 1e6, 5)],
+                   events=[{"ev": "resume", "step": 2, "hw": 3,
+                            "t_us": 50.0}],
+                   t_end_us=2.6e6)
+
+
+def test_report_partition_sums_to_elapsed_with_downtime(tmp_path):
+    _two_gen_fixture(tmp_path, 0)
+    mod = _report_module()
+    acct = mod.account_rank(mod.load(str(tmp_path / "0" / "goodput.jsonl")))
+    cats = acct["categories"]
+    # wall-clock: gen0 [0s, 4s], gen1 [6s, 8.6s] -> elapsed 8.6 s with a
+    # 2 s generation gap reconstructed as restart downtime
+    assert acct["elapsed_s"] == pytest.approx(8.6)
+    assert cats["restart_downtime"] == pytest.approx(2.0)
+    assert cats["untracked"] == pytest.approx(0.1)
+    # the acceptance bar: categories sum to elapsed within 1%
+    assert sum(cats.values()) == pytest.approx(acct["elapsed_s"],
+                                               rel=0.01)
+    chk = acct["replay_checks"][0]
+    assert chk["expected_replayed"] == 1 and chk["replayed"] == 1
+    assert chk["ok"]
+    gang = mod.gang_accounting({0: acct})
+    assert gang["goodput_fraction"] == pytest.approx(4.0 / 8.6, rel=1e-3)
+    verdict = mod.verdict_line(gang)
+    assert verdict.startswith("gang goodput 46.5%")
+    assert "top badput:" in verdict
+    assert "restart downtime" in verdict and "compile" in verdict
+
+
+def test_report_merges_ranks_and_degrades_on_silent_rank(tmp_path):
+    # two readable ranks + one whose file holds only garbage: the gang
+    # table covers the readable ranks and names the skipped one — the
+    # report degrades, it never wedges
+    _two_gen_fixture(tmp_path, 0)
+    _two_gen_fixture(tmp_path, 1)
+    silent = tmp_path / "2"
+    silent.mkdir()
+    (silent / "goodput.jsonl").write_text("not json at all\n{torn")
+    r = subprocess.run(
+        [sys.executable, GOODPUT_REPORT, str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert sorted(doc["ranks"]) == ["0", "1"]
+    assert doc["skipped_ranks"] and doc["skipped_ranks"][0][0] == 2
+    assert doc["gang"]["elapsed_s"] == pytest.approx(17.2)
+    assert doc["gang"]["goodput_fraction"] == pytest.approx(4.0 / 8.6,
+                                                            rel=1e-3)
+    # the text rendering names the skip too
+    rt = subprocess.run(
+        [sys.executable, GOODPUT_REPORT, str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert rt.returncode == 0, rt.stdout + rt.stderr
+    assert "rank 2: SKIPPED" in rt.stdout
+    assert "gang goodput 46.5%" in rt.stdout
+
+
+def test_report_chrome_trace_lanes(tmp_path):
+    _two_gen_fixture(tmp_path, 0)
+    out = tmp_path / "badput.json"
+    r = subprocess.run(
+        [sys.executable, GOODPUT_REPORT, str(tmp_path),
+         "--chrome", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    # goodput lane (tid 0) holds only good categories; the badput lane
+    # carries compile/replay plus the synthesized restart_downtime span
+    assert all(e["name"] in ("step", "serve_decode")
+               for e in spans if e["tid"] == 0)
+    bad = {e["name"] for e in spans if e["tid"] == 1}
+    assert {"compile", "replay", "restart_downtime"} <= bad
+    down = next(e for e in spans if e["name"] == "restart_downtime")
+    assert down["dur"] == pytest.approx(2e6)
+
+
+# -- kill-and-relaunch attribution acceptance --------------------------------
+
+_GOODPUT_WORKER = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + \
+        " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, {root!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, resilience, config, goodput
+from mxnet_tpu.gluon import nn, loss as gloss
+
+rank = int(os.environ.get("JAX_PROCESS_ID", "0"))
+base, total = sys.argv[1], int(sys.argv[2])
+config.set("checkpoint_dir", os.path.join(base, "ck", str(rank)))
+# every-2 so the injected kill at step 3 restores step 2 and must
+# REPLAY step 3 (a kill landing on a checkpointed step would leave
+# nothing to replay and the replay check would be vacuous)
+config.set("checkpoint_every_n_steps", 2)
+config.set("resume", "auto")
+resilience.install()
+assert goodput.enabled(), "launch --goodput-dir must arm the accountant"
+
+parallel.make_mesh(dp=-1)
+net = nn.Dense(4, in_units=8); mx.random.seed(0); net.initialize()
+lfn = gloss.L2Loss()
+tr = parallel.ShardedTrainer(net, lambda o, l: lfn(o, l), "sgd",
+                             {{"learning_rate": 0.1}})
+rs = np.random.RandomState(42)
+batches = [(rs.randn(8, 8).astype(np.float32),
+            rs.randn(8, 4).astype(np.float32)) for _ in range(total)]
+while tr.num_update < total:
+    xb, yb = batches[tr.num_update]
+    tr.step(nd.array(xb), nd.array(yb))
+print(f"rank {{rank}} done at step {{tr.num_update}} "
+      f"(hw {{goodput.high_water()}})", flush=True)
+"""
+
+
+@pytest.mark.slow  # 3 subprocess jax sessions; ci/run.sh goodput runs it
+def test_kill_relaunch_report_attributes_downtime_and_replay(tmp_path):
+    """Acceptance: 2-rank --goodput-dir launch, rank 1 SIGKILLed at
+    step 3, supervised relaunch resumes from the step-2 checkpoint.
+    tools/goodput_report.py must partition 100% of each rank's
+    wall-clock (within 1%), reconstruct the restart downtime from the
+    generation gap, and verify replayed steps == high-water minus the
+    restored step on the killed rank."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_GOODPUT_WORKER.format(root=ROOT))
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    gdir = run_dir / "goodput"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PROCESS_ID", "MXNET_TPU_FAULT_INJECT")}
+    env["MXNET_TPU_FAULT_INJECT"] = "kill@step:3@rank:1"
+    r = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2", "--launcher", "local",
+         "--max-restarts", "2", "--restart-backoff", "0.1",
+         "--goodput-dir", str(gdir),
+         "--diagnostics-dir", str(run_dir / "diag"),
+         sys.executable, str(worker), str(run_dir), "6"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "relaunching" in r.stderr
+
+    out = subprocess.run(
+        [sys.executable, GOODPUT_REPORT, str(gdir),
+         "--restarts", str(run_dir / "diag" / "restarts.jsonl"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["supervision_events"] >= 1
+    assert 0.0 < doc["gang"]["goodput_fraction"] < 1.0
+    for rank in ("0", "1"):
+        acct = doc["ranks"][rank]
+        cats = acct["categories"]
+        # the gang relaunch tears down BOTH ranks: two generations and
+        # a reconstructed downtime gap each
+        assert acct["generations"] == 2, acct
+        assert cats.get("restart_downtime", 0.0) > 0.0, cats
+        # 100% partition: categories (untracked included) sum to the
+        # rank's wall-clock within 1%
+        assert sum(cats.values()) == pytest.approx(
+            acct["elapsed_s"], rel=0.01, abs=0.05)
+        resumes = [c for c in acct["replay_checks"] if c["ev"] == "resume"]
+        assert resumes, acct["replay_checks"]
+        assert all(c["ok"] for c in resumes), resumes
+    # the killed rank's arithmetic is deterministic: killed at step 3,
+    # last checkpoint at step 2 -> exactly one replayed step
+    chk = [c for c in doc["ranks"]["1"]["replay_checks"]
+           if c["ev"] == "resume"][-1]
+    assert chk["hw"] - chk["restored"] == 1
+    assert chk["expected_replayed"] == 1 and chk["replayed"] == 1
+    # downtime (two process relaunches incl. jax import) must rank
+    # among the top badput causes in the verdict
+    assert "restart downtime" in doc["verdict"], doc["verdict"]

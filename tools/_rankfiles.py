@@ -1,0 +1,63 @@
+"""Shared per-rank run-artifact discovery for the offline report tools
+(stdlib only — importable without jax or the framework).
+
+Every per-rank observability layer uses one layout: rank R appends to
+`<dir>/<R>/<filename>` (mx.slo access logs, mx.trace span files,
+mx.goodput interval files). The report tools accept either the run
+directory or explicit file paths; this module is the one place that
+maps both spellings to `[(rank, path)]` so the tools agree on rank
+resolution and on what happens when two files claim the same rank.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def discover_rank_files(paths, filename, rank_from_path=True,
+                        tool="report"):
+    """[(rank, path)] from directories laid out as
+    `<dir>/<rank>/<filename>` and/or explicit files.
+
+    A directory contributes every all-digit subdir holding `filename`,
+    in numeric rank order. An explicit file takes its rank from the
+    nearest all-digit path component when `rank_from_path` is true,
+    else None (the reader resolves it from the file's own meta line).
+    Two files claiming the same rank (e.g. runA/1 + runB/1), or a file
+    with no parseable rank, take the lowest free slot rather than
+    silently overwriting the earlier file in the merge — the first
+    honest parse keeps its rank."""
+    out, used = [], set()
+
+    def claim(rank, path):
+        if rank is not None and rank in used:
+            print(f"{tool}: {path} duplicates rank {rank}; assigning a "
+                  "free rank id", file=sys.stderr)
+            rank = None
+        if rank is None and rank_from_path:
+            rank = 0
+            while rank in used:
+                rank += 1
+        if rank is not None:
+            used.add(rank)
+        out.append((rank, path))
+
+    for p in paths:
+        if os.path.isdir(p):
+            # (len, name) sorts digit names numerically without int()ing
+            for name in sorted(os.listdir(p), key=lambda n: (len(n), n)):
+                f = os.path.join(p, name, filename)
+                if name.isdigit() and os.path.isfile(f):
+                    claim(int(name), f)
+            continue
+        if not os.path.isfile(p):
+            continue
+        rank = None
+        if rank_from_path:
+            for part in reversed(os.path.normpath(
+                    os.path.dirname(p)).split(os.sep)):
+                if part.isdigit():
+                    rank = int(part)
+                    break
+        claim(rank, p)
+    return out

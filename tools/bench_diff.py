@@ -43,7 +43,7 @@ HIGHER_BETTER = (
     "int8_tokens_per_sec", "int8_requests_per_sec", "int8_completed",
     "pages_tokens_per_sec", "pages_requests_per_sec", "pages_completed",
     "prefix_hit_rate", "accepted_draft_rate", "pages_speedup",
-    "speedup",
+    "speedup", "goodput_fraction",
 )
 #: numeric fields where a bigger number is a worse run
 LOWER_BETTER = (

@@ -1,0 +1,441 @@
+#!/usr/bin/env python
+"""Merge per-rank mx.goodput interval files into ONE gang wall-clock
+accounting table with a one-line verdict (stdlib only — runs where the
+files land, no jax, no framework import).
+
+    python tools/goodput_report.py GOODPUT_DIR
+    python tools/goodput_report.py GOODPUT_DIR --restarts diag/restarts.jsonl
+    python tools/goodput_report.py GOODPUT_DIR --json
+    python tools/goodput_report.py GOODPUT_DIR --chrome badput.json
+
+Input: `<dir>/<rank>/goodput.jsonl` files written by mx.goodput (per
+relaunch generation: one meta line carrying the rank's wall epoch,
+generation and recovered high-water step, then classified goodput/
+badput intervals, resume/rollback event markers, and a summary). A
+relaunched worker appends a NEW meta to the same file; each
+generation's monotonic interval stamps are mapped onto the wall clock
+via its own meta epoch, so every generation lands at its true
+position.
+
+The report partitions 100% of each rank's wall-clock (first meta to
+last record): the live categories come from the interval records,
+`restart_downtime` is reconstructed OFFLINE from the gap between one
+generation's last record and the next generation's start (cross-checked
+against launch.py's `restarts.jsonl` when present — pass --restarts or
+keep it next to the rank dirs), and whatever no hook claimed lands in
+`untracked`, printed explicitly so the table always sums to elapsed.
+
+It also verifies progress accounting: every `resume`/`rollback` event
+marker predicts how many steps must re-train (high-water minus the
+restored step); the report counts the replay intervals that follow and
+flags a mismatch.
+
+A rank whose file is missing, empty, or unparseable is reported and
+skipped — the gang table degrades to the readable ranks, it never
+wedges.
+
+`--chrome` writes a chrome://tracing / Perfetto JSON with one track
+per rank (goodput lane + badput lane), aligned to the same shared gang
+epoch mx.trace uses — load it next to trace_report's merged timeline.
+`--json` prints the machine-readable accounting instead of text.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _rankfiles import discover_rank_files  # noqa: E402
+
+GOOD = ("step", "serve_decode")
+#: render order: goodput first, then live badput, then the two
+#: report-side categories no live hook can know
+CATEGORY_ORDER = (
+    "step", "serve_decode",
+    "compile", "input_stall", "checkpoint_save", "checkpoint_restore",
+    "reshard", "oom_recovery", "replay", "serve_idle", "serve_degraded",
+    "restart_downtime", "untracked",
+)
+
+
+def discover(paths):
+    """[(rank, path)] from a goodput dir (numbered subdirs) or explicit
+    files (rank from the nearest all-digit path component, else the
+    lowest free slot)."""
+    return discover_rank_files(paths, "goodput.jsonl",
+                               tool="goodput_report")
+
+
+def load(path):
+    """[generation, ...] from one rank file: each a dict with the meta,
+    its interval records (wall-stamped via the meta epoch), event
+    markers, and the last summary. Torn/garbage lines are skipped (a
+    SIGKILLed writer is the expected author)."""
+    gens = []
+    cur = None
+    try:
+        f = open(path)
+    except OSError as e:
+        print(f"goodput_report: cannot read {path}: {e}", file=sys.stderr)
+        return []
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # half-written tail from a killed writer
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                cur = {"meta": rec, "intervals": [], "events": [],
+                       "summary": None}
+                gens.append(cur)
+            elif cur is None:
+                continue  # records before any meta: unmappable
+            elif kind == "int":
+                cur["intervals"].append(rec)
+            elif kind == "ev":
+                cur["events"].append(rec)
+            elif kind == "summary":
+                cur["summary"] = rec
+    return gens
+
+
+def _abs_s(meta, t_us):
+    """Wall-clock seconds (unix) for one monotonic microsecond stamp,
+    via this generation's meta epoch."""
+    try:
+        return (int(meta["epoch_unix_ns"]) / 1e9) + float(t_us) / 1e6
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def _gen_bounds(gen):
+    """(start_s, end_s) wall bounds of one generation: meta t_start to
+    the last record's end (summary t_end when present)."""
+    meta = gen["meta"]
+    start = _abs_s(meta, meta.get("t_start_us") or 0.0)
+    end = start
+    for rec in gen["intervals"]:
+        t1 = _abs_s(meta, (rec.get("t0_us") or 0.0)
+                    + (rec.get("dur_us") or 0.0))
+        if t1 is not None and (end is None or t1 > end):
+            end = t1
+    if gen["summary"] is not None:
+        t1 = _abs_s(meta, gen["summary"].get("t_end_us") or 0.0)
+        if t1 is not None and (end is None or t1 > end):
+            end = t1
+    return start, end
+
+
+def account_rank(gens):
+    """One rank's accounting: per-category seconds over every
+    generation, restart downtime from the inter-generation gaps,
+    untracked as the explicit remainder, and the replay checks each
+    resume/rollback marker predicts."""
+    cats = {}
+    replays = []           # (gen, step) of every replay interval
+    events = []
+    bounds = []
+    for gen in gens:
+        meta = gen["meta"]
+        for rec in gen["intervals"]:
+            cat = rec.get("cat") or "?"
+            cats[cat] = cats.get(cat, 0.0) + (rec.get("dur_us") or 0.0) / 1e6
+            if cat == "replay" and rec.get("step") is not None:
+                replays.append((meta.get("gen"), int(rec["step"])))
+        for ev in gen["events"]:
+            events.append(dict(ev, _gen=meta.get("gen")))
+        bounds.append(_gen_bounds(gen))
+    downtime = 0.0
+    for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+        if e0 is not None and s1 is not None:
+            downtime += max(0.0, s1 - e0)
+    start = bounds[0][0] if bounds else None
+    end = bounds[-1][1] if bounds else None
+    elapsed = max(0.0, (end or 0.0) - (start or 0.0)) \
+        if (start is not None and end is not None) else 0.0
+    tracked = sum(cats.values()) + downtime
+    out = dict(cats)
+    if downtime > 0:
+        out["restart_downtime"] = downtime
+    out["untracked"] = max(0.0, elapsed - tracked)
+    checks = []
+    for ev in events:
+        if ev.get("ev") not in ("resume", "rollback"):
+            continue
+        restored = ev.get("restored", ev.get("step"))
+        hw = ev.get("hw")
+        if restored is None or hw is None:
+            continue
+        expected = max(0, int(hw) - int(restored))
+        # replayed steps land strictly above the restored step, at or
+        # below the high-water mark the marker recorded
+        got = len({s for _g, s in replays
+                   if int(restored) < s <= int(hw)})
+        checks.append({"ev": ev["ev"], "gen": ev.get("_gen"),
+                       "restored": int(restored), "hw": int(hw),
+                       "expected_replayed": expected,
+                       "replayed": got,
+                       "ok": got == expected})
+    hw = 0
+    for g in gens:
+        for rec in (g["meta"], g["summary"]):
+            v = (rec or {}).get("hw_step")
+            if isinstance(v, int) and v > hw:
+                hw = v
+        for rec in g["intervals"]:
+            v = rec.get("step")
+            if isinstance(v, int) and v > hw:
+                hw = v
+    return {"categories": out, "elapsed_s": elapsed, "start_s": start,
+            "end_s": end, "generations": len(gens),
+            "hw_step": hw, "replay_checks": checks}
+
+
+def load_restarts(path):
+    """Supervision events from launch.py's restarts.jsonl (restart +
+    stale-heartbeat records share it); [] when absent."""
+    if not path or not os.path.isfile(path):
+        return []
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError as e:
+        print(f"goodput_report: cannot read {path}: {e}", file=sys.stderr)
+    return out
+
+
+def gang_accounting(per_rank):
+    """Aggregate the per-rank accounts into the gang view: rank-seconds
+    per category over the summed rank wall-clocks."""
+    cats = {}
+    elapsed = 0.0
+    for acct in per_rank.values():
+        elapsed += acct["elapsed_s"]
+        for cat, s in acct["categories"].items():
+            cats[cat] = cats.get(cat, 0.0) + s
+    good = sum(s for c, s in cats.items() if c in GOOD)
+    frac = good / elapsed if elapsed > 0 else None
+    return {"elapsed_s": elapsed, "goodput_s": good,
+            "goodput_fraction": frac, "categories": cats}
+
+
+def _pretty(cat):
+    return cat.replace("_", " ")
+
+
+def verdict_line(gang):
+    """The one-line verdict: gang goodput percentage and the top badput
+    causes by share of gang wall-clock."""
+    if not gang["elapsed_s"]:
+        return "gang goodput: no accounted wall-clock"
+    bad = sorted(((c, s) for c, s in gang["categories"].items()
+                  if c not in GOOD and s > 0),
+                 key=lambda cs: -cs[1])[:3]
+    tops = ", ".join(
+        f"{_pretty(c)} {100.0 * s / gang['elapsed_s']:.1f}%"
+        for c, s in bad)
+    pct = 100.0 * (gang["goodput_fraction"] or 0.0)
+    line = f"gang goodput {pct:.1f}%"
+    if tops:
+        line += f" — top badput: {tops}"
+    return line
+
+
+def render(per_rank, gang, skipped, restarts):
+    lines = [f"goodput report: {len(per_rank)} rank(s), "
+             f"{sum(a['generations'] for a in per_rank.values())} "
+             f"generation(s), {len(restarts)} supervision event(s)"]
+    for rank, why in skipped:
+        lines.append(f"  rank {rank}: SKIPPED ({why}) — gang numbers "
+                     "cover the readable ranks only")
+    lines.append("")
+    lines.append(f"{'category':<20}{'rank-seconds':>14}{'share':>9}")
+    el = gang["elapsed_s"]
+    seen = set()
+    for cat in CATEGORY_ORDER:
+        s = gang["categories"].get(cat)
+        if s is None:
+            continue
+        seen.add(cat)
+        share = f"{100.0 * s / el:.1f}%" if el else "-"
+        tag = "" if cat in GOOD else "  (badput)" \
+            if cat not in ("untracked",) else ""
+        lines.append(f"{_pretty(cat):<20}{s:>14.3f}{share:>9}{tag}")
+    for cat in sorted(set(gang["categories"]) - seen):
+        s = gang["categories"][cat]
+        share = f"{100.0 * s / el:.1f}%" if el else "-"
+        lines.append(f"{_pretty(cat):<20}{s:>14.3f}{share:>9}  (badput)")
+    lines.append(f"{'wall-clock':<20}{el:>14.3f}{'100.0%':>9}  "
+                 f"({len(per_rank)} rank(s))")
+    lines.append("")
+    for rank in sorted(per_rank):
+        acct = per_rank[rank]
+        good = sum(s for c, s in acct["categories"].items() if c in GOOD)
+        frac = 100.0 * good / acct["elapsed_s"] if acct["elapsed_s"] else 0.0
+        down = acct["categories"].get("restart_downtime", 0.0)
+        lines.append(
+            f"rank {rank}: {frac:.1f}% goodput over "
+            f"{acct['elapsed_s']:.1f}s, {acct['generations']} gen(s), "
+            f"hw step {acct['hw_step']}"
+            + (f", {down:.1f}s restart downtime" if down else ""))
+        for chk in acct["replay_checks"]:
+            state = "ok" if chk["ok"] else "MISMATCH"
+            lines.append(
+                f"  replay check ({chk['ev']}, gen {chk['gen']}): "
+                f"{chk['replayed']} replayed step(s), expected "
+                f"hw {chk['hw']} - restored {chk['restored']} = "
+                f"{chk['expected_replayed']}  [{state}]")
+    n_restarts = sum(1 for r in restarts if "attempt" in r
+                     or r.get("kind") == "stale_heartbeat")
+    if n_restarts:
+        lines.append("")
+        lines.append(f"supervisor: {n_restarts} restart/kill event(s) "
+                     "in restarts.jsonl "
+                     + ("— consistent with the generation gaps above"
+                        if any(a["categories"].get("restart_downtime")
+                               for a in per_rank.values())
+                        else "— but NO generation gap was observed in "
+                        "the rank files"))
+    lines.append("")
+    lines.append(verdict_line(gang))
+    return "\n".join(lines)
+
+
+def chrome_trace(ranks_gens):
+    """Chrome-trace events: one process per rank, a goodput lane and a
+    badput lane, on the shared gang epoch axis (falling back to the
+    earliest rank epoch when the gang epoch is absent)."""
+    zero_ns = None
+    for gens in ranks_gens.values():
+        for gen in gens:
+            e = gen["meta"].get("gang_epoch_ns")
+            if e is None:
+                e = gen["meta"].get("epoch_unix_ns")
+            if e is not None and (zero_ns is None or int(e) < zero_ns):
+                zero_ns = int(e)
+    if zero_ns is None:
+        zero_ns = 0
+    events = []
+    for rank, gens in sorted(ranks_gens.items()):
+        events.append({"ph": "M", "pid": rank, "name": "process_name",
+                       "args": {"name": f"rank {rank} goodput"}})
+        for tid, name in ((0, "goodput"), (1, "badput")):
+            events.append({"ph": "M", "pid": rank, "tid": tid,
+                           "name": "thread_name", "args": {"name": name}})
+        for gen in gens:
+            meta = gen["meta"]
+            for rec in gen["intervals"]:
+                t0 = _abs_s(meta, rec.get("t0_us") or 0.0)
+                if t0 is None:
+                    continue
+                cat = rec.get("cat") or "?"
+                ev = {"ph": "X", "pid": rank,
+                      "tid": 0 if cat in GOOD else 1,
+                      "name": cat,
+                      "ts": round(t0 * 1e6 - zero_ns / 1e3, 1),
+                      "dur": rec.get("dur_us") or 0.0}
+                args = {k: v for k, v in rec.items()
+                        if k in ("step", "n", "op", "rung", "hw")}
+                if args:
+                    ev["args"] = args
+                events.append(ev)
+        # the offline-reconstructed downtime gets its own badput span
+        gaps = [(_gen_bounds(a), _gen_bounds(b))
+                for a, b in zip(gens, gens[1:])]
+        for (s0, e0), (s1, e1) in gaps:
+            if e0 is None or s1 is None or s1 <= e0:
+                continue
+            events.append({"ph": "X", "pid": rank, "tid": 1,
+                           "name": "restart_downtime",
+                           "ts": round(e0 * 1e6 - zero_ns / 1e3, 1),
+                           "dur": round((s1 - e0) * 1e6, 1)})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="+",
+                   help="goodput dir(s) (numbered rank subdirs) and/or "
+                        "goodput.jsonl files")
+    p.add_argument("--restarts", default=None,
+                   help="launch.py restarts.jsonl to cross-check restart "
+                        "downtime against (default: restarts.jsonl next "
+                        "to the first goodput dir, when present)")
+    p.add_argument("--json", action="store_true",
+                   help="print the machine-readable accounting instead "
+                        "of the text table")
+    p.add_argument("--chrome", default=None, metavar="OUT",
+                   help="also write a chrome://tracing JSON with per-"
+                        "rank goodput/badput lanes on the shared gang "
+                        "epoch axis")
+    args = p.parse_args(argv)
+
+    files = discover(args.paths)
+    if not files:
+        print(f"no goodput.jsonl found under {args.paths}",
+              file=sys.stderr)
+        return 2
+    ranks_gens = {}
+    skipped = []
+    for rank, path in files:
+        gens = load(path)
+        if not gens:
+            skipped.append((rank, f"no usable records in {path}"))
+            continue
+        ranks_gens[rank] = gens
+    if not ranks_gens:
+        print("no rank produced usable records", file=sys.stderr)
+        return 2
+    per_rank = {r: account_rank(g) for r, g in ranks_gens.items()}
+    gang = gang_accounting(per_rank)
+
+    restarts_path = args.restarts
+    if restarts_path is None:
+        for cand in args.paths:
+            base = cand if os.path.isdir(cand) else os.path.dirname(cand)
+            f = os.path.join(base, "restarts.jsonl")
+            if os.path.isfile(f):
+                restarts_path = f
+                break
+    restarts = load_restarts(restarts_path)
+
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(ranks_gens), f)
+        print(f"goodput_report: wrote {args.chrome}", file=sys.stderr)
+
+    if args.json:
+        print(json.dumps({
+            "gang": gang,
+            "verdict": verdict_line(gang),
+            "ranks": {str(r): a for r, a in sorted(per_rank.items())},
+            "skipped_ranks": [[r, why] for r, why in skipped],
+            "supervision_events": len(restarts),
+        }, indent=1, sort_keys=True))
+    else:
+        print(render(per_rank, gang, skipped, restarts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

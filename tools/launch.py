@@ -131,7 +131,7 @@ ELASTIC_SETTLE_S = 3.0
 
 def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
               restart_count=0, trace_dir=None, trace_epoch_ns=None,
-              heartbeat_timeout=None, scope_port=0):
+              heartbeat_timeout=None, scope_port=0, goodput_dir=None):
     if ":" not in coordinator:
         coordinator = coordinator + ":9876"  # default coordination port
     env = dict(os.environ)
@@ -166,6 +166,16 @@ def build_env(rank, num_workers, coordinator, diagnostics_dir=None,
         env["MXNET_TPU_TRACE_DIR"] = trace_dir
         if trace_epoch_ns is not None:
             env["MXNET_TPU_TRACE_EPOCH_NS"] = str(trace_epoch_ns)
+    if goodput_dir:
+        # arm mx.goodput in every worker (per-rank interval files under
+        # <dir>/<rank>/goodput.jsonl). The gang epoch is SHARED with
+        # mx.trace (one wall timestamp, fixed across relaunch
+        # generations) so tools/goodput_report.py's chrome badput lane
+        # lands on the same axis as trace_report's timeline
+        env["MXNET_TPU_GOODPUT"] = "on"
+        env["MXNET_TPU_GOODPUT_DIR"] = goodput_dir
+        if trace_epoch_ns is not None:
+            env.setdefault("MXNET_TPU_TRACE_EPOCH_NS", str(trace_epoch_ns))
     if heartbeat_timeout:
         # arm mx.guard in every worker: per-rank liveness heartbeats
         # under <diagnostics_dir>/<rank>/heartbeat.json, which the
@@ -767,7 +777,7 @@ def _plan_world(world, codes, elastic, min_workers, max_world):
 def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
                  max_restarts=0, restart_backoff=3.0, elastic=False,
                  min_workers=1, trace_dir=None, heartbeat_timeout=0.0,
-                 scope_port=0):
+                 scope_port=0, goodput_dir=None):
     """Run the gang; with --max-restarts, supervise it: when any rank
     dies (crash, SIGKILL rank death, or a preemption save), tear down the
     peer ranks, back off exponentially (with jitter), and relaunch the
@@ -789,7 +799,7 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
     signal.signal(signal.SIGTERM, _kill)
     attempt = 0
     world = num_workers
-    trace_epoch_ns = time.time_ns() if trace_dir else None
+    trace_epoch_ns = time.time_ns() if (trace_dir or goodput_dir) else None
     while True:
         if killed.get("sig"):
             # signal arrived during the restart backoff: no gang running,
@@ -801,7 +811,8 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
                             restart_count=attempt, trace_dir=trace_dir,
                             trace_epoch_ns=trace_epoch_ns,
                             heartbeat_timeout=heartbeat_timeout,
-                            scope_port=scope_port)
+                            scope_port=scope_port,
+                            goodput_dir=goodput_dir)
             proc, pump = _spawn(command, env, rank, diagnostics_dir,
                                 restart_count=attempt)
             procs.append(proc)
@@ -952,6 +963,16 @@ def main(argv=None):
                         "trace epoch; merge into a clock-aligned Perfetto "
                         "trace + straggler verdict with "
                         "tools/trace_report.py")
+    p.add_argument("--goodput-dir", default=None,
+                   help="arm mx.goodput wall-clock accounting in every "
+                        "worker (MXNET_TPU_GOODPUT=on): each rank appends "
+                        "classified goodput/badput intervals (step, "
+                        "compile, input stall, checkpoint, reshard, OOM "
+                        "recovery, replay, serve decode/idle/degraded) to "
+                        "<dir>/<rank>/goodput.jsonl against the shared "
+                        "gang epoch; merge with restarts.jsonl into a "
+                        "gang accounting table and verdict with "
+                        "tools/goodput_report.py")
     p.add_argument("--heartbeat-timeout", type=float, default=0.0,
                    help="arm mx.guard liveness in every worker "
                         "(MXNET_TPU_GUARD=1) and poll the per-rank "
@@ -1027,6 +1048,12 @@ def main(argv=None):
             print("warning: --heartbeat-timeout is local-launcher only "
                   "(remote heartbeat files are not visible here)",
                   file=sys.stderr)
+        if args.goodput_dir:
+            print("warning: --goodput-dir is local-launcher only (arm "
+                  "remote workers with MXNET_TPU_GOODPUT=on / "
+                  "MXNET_TPU_GOODPUT_DIR and collect the rank files "
+                  "before running tools/goodput_report.py)",
+                  file=sys.stderr)
         if args.scope_port:
             print("warning: --scope-port is local-launcher only (the "
                   "aggregator fans out to 127.0.0.1 rank ports; arm "
@@ -1045,7 +1072,8 @@ def main(argv=None):
                         min_workers=args.min_workers,
                         trace_dir=args.trace_dir,
                         heartbeat_timeout=args.heartbeat_timeout,
-                        scope_port=args.scope_port)
+                        scope_port=args.scope_port,
+                        goodput_dir=args.goodput_dir)
 
 
 if __name__ == "__main__":

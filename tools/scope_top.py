@@ -8,8 +8,9 @@
 
 Polls the aggregator's merged `/statusz` and renders, per rank: the
 current step, steps/s (the rank's own rate window, falling back to the
-poll-to-poll delta), heartbeat / last-step age, mx.memsafe headroom, and
-live serve stats (active requests, TTFT p50) — plus the gang footer:
+poll-to-poll delta), heartbeat / last-step age, mx.memsafe headroom,
+live serve stats (active requests, TTFT p50), and the mx.goodput
+fraction with its top badput cause — plus the gang footer:
 step spread, stale/unreachable ranks, and the mx.trace skew verdict
 naming the suspected straggler. `--once` prints a single snapshot (no
 screen clearing) — the scriptable spelling; the default loop refreshes
@@ -61,6 +62,17 @@ def _rate(payload, prev, rank, now):
     return "-"
 
 
+def _goodput_cell(payload):
+    gp = payload.get("goodput")
+    if not gp or gp.get("goodput_fraction") is None:
+        return "-"
+    cell = f"{gp['goodput_fraction'] * 100:.0f}%"
+    if gp.get("top_badput_cause"):
+        # abbreviated top badput cause, e.g. "83% !replay"
+        cell += f" !{gp['top_badput_cause'][:8]}"
+    return cell
+
+
 def _serve_cell(payload):
     sv = payload.get("serve")
     if not sv or not sv.get("servers"):
@@ -79,7 +91,8 @@ def render(status, prev, url):
         f"world {status.get('world_size')}  "
         f"{time.strftime('%H:%M:%S')}",
         f"{'rank':<5}{'step':>8}{'steps/s':>9}{'hb_age':>8}"
-        f"{'step_age':>9}{'headroom':>11}{'serve':>14}  state",
+        f"{'step_age':>9}{'headroom':>11}{'serve':>14}"
+        f"{'goodput':>13}  state",
     ]
     stale = set(status.get("stale_ranks") or [])
     unreachable = set(status.get("unreachable_ranks") or [])
@@ -92,12 +105,12 @@ def render(status, prev, url):
                                    and "error" in payload
                                    and "step" not in payload):
             lines.append(f"{rank:<5}{'-':>8}{'-':>9}{'-':>8}{'-':>9}"
-                         f"{'-':>11}{'-':>14}  UNREACHABLE "
+                         f"{'-':>11}{'-':>14}{'-':>13}  UNREACHABLE "
                          f"({payload.get('error', '?')})")
             continue
         if rank in failing:
             lines.append(f"{rank:<5}{'-':>8}{'-':>9}{'-':>8}{'-':>9}"
-                         f"{'-':>11}{'-':>14}  FAILING "
+                         f"{'-':>11}{'-':>14}{'-':>13}  FAILING "
                          f"(HTTP {payload.get('http_status', '?')})")
             continue
         steps_now[rank] = payload.get("step")
@@ -110,7 +123,8 @@ def render(status, prev, url):
             f"{_age(payload.get('heartbeat_age_s')):>8}"
             f"{_age(payload.get('last_step_age_s')):>9}"
             f"{fmt_bytes(ms.get('headroom_bytes')):>11}"
-            f"{_serve_cell(payload):>14}  {state}")
+            f"{_serve_cell(payload):>14}"
+            f"{_goodput_cell(payload):>13}  {state}")
     foot = []
     if status.get("step_spread") is not None:
         foot.append(f"step spread {status['step_spread']} "

@@ -26,23 +26,15 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _rankfiles import discover_rank_files  # noqa: E402
+
 
 def discover(paths):
     """[(rank, file)] from directories laid out as <dir>/<rank>/
     access.jsonl, or explicit .jsonl files (rank from the meta line)."""
-    out = []
-    for p in paths:
-        if os.path.isfile(p):
-            out.append((None, p))
-            continue
-        if not os.path.isdir(p):
-            continue
-        for name in sorted(os.listdir(p)):
-            sub = os.path.join(p, name)
-            f = os.path.join(sub, "access.jsonl")
-            if name.isdigit() and os.path.isfile(f):
-                out.append((int(name), f))
-    return out
+    return discover_rank_files(paths, "access.jsonl",
+                               rank_from_path=False, tool="slo_report")
 
 
 def load(path):

@@ -46,6 +46,9 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _rankfiles import discover_rank_files  # noqa: E402
+
 # Perfetto lane (tid) per span category, so each rank's track splits into
 # stable sub-lanes instead of interleaving unrelated spans on one row
 _TID = {"step": 0, "input": 1, "compile": 2, "checkpoint": 3, "host": 4}
@@ -60,35 +63,7 @@ SKEW_FRACTION = 0.25
 def discover(paths):
     """[(rank, path)] from a trace dir (numbered subdirs) or explicit
     files (rank from the nearest all-digit path component, else order)."""
-    if len(paths) == 1 and os.path.isdir(paths[0]):
-        base = paths[0]
-        out = []
-        for name in sorted(os.listdir(base), key=lambda n: (len(n), n)):
-            f = os.path.join(base, name, "trace.jsonl")
-            if name.isdigit() and os.path.isfile(f):
-                out.append((int(name), f))
-        return out
-    out, used = [], set()
-    for p in paths:
-        rank = None
-        for part in reversed(os.path.normpath(
-                os.path.dirname(p)).split(os.sep)):
-            if part.isdigit():
-                rank = int(part)
-                break
-        if rank is None or rank in used:
-            # no parseable rank, or two files claiming the same rank
-            # (e.g. runA/1 + runB/1): take the lowest free slot rather
-            # than silently overwriting the earlier file in the merge
-            if rank in used:
-                print(f"trace_report: {p} duplicates rank {rank}; "
-                      "assigning a free rank id", file=sys.stderr)
-            rank = 0
-            while rank in used:
-                rank += 1
-        used.add(rank)
-        out.append((rank, p))
-    return out
+    return discover_rank_files(paths, "trace.jsonl", tool="trace_report")
 
 
 def load(path):
