@@ -17,6 +17,10 @@
 #                           zero-overhead, seeded kill@step fault run
 #                           whose report must attribute restart downtime
 #                           and replayed steps correctly
+#   ci/run.sh fleet       — mx.fleet replicated serving: off-path
+#                           zero-overhead, kill-a-replica-mid-load smoke
+#                           (zero accepted requests lost, restarts.jsonl
+#                           records the relaunch)
 #   ci/run.sh all         — everything + the driver-contract gate
 set -e
 cd "$(dirname "$0")/.."
@@ -723,6 +727,7 @@ static_stage() {
         tests/unittest/test_dataflow.py tests/unittest/test_inspect.py \
         tests/unittest/test_trace.py tests/unittest/test_guard.py \
         tests/unittest/test_serve.py tests/unittest/test_scope.py \
+        tests/unittest/test_fleet.py \
         -q -m 'not slow' -p no:cacheprovider
     # the heavier scope acceptance tests ride here instead of the tier-1
     # sweep (the PR 5 slow-marking pattern): the bit-identical-loss gate
@@ -734,6 +739,7 @@ static_stage() {
         tests/unittest/test_scope.py::test_aggregator_not_wedged_by_silent_rank \
         tests/unittest/test_scope.py::test_scope_top_renders_once \
         tests/unittest/test_scope.py::test_scope_top_unreachable_aggregator_exits_nonzero \
+        tests/unittest/test_scope.py::test_profilez_capture_and_409_on_concurrent \
         -q -p no:cacheprovider
 }
 
@@ -756,8 +762,12 @@ unittest_stage() {
     python -m pytest \
         tests/unittest/test_contrib.py::test_quantize_resnet18_end_to_end \
         tests/unittest/test_models.py::test_resnet18_trains \
+        tests/unittest/test_models.py::test_resnet50_shapes_and_grad \
+        tests/unittest/test_bert_finetune.py::test_qa_finetune_overfits_tiny \
+        tests/unittest/test_flash_interpret.py::test_interpret_ring_pallas_inner \
         "tests/unittest/test_model_zoo.py::test_zoo_forward_shapes[densenet121-64]" \
         "tests/unittest/test_model_zoo.py::test_zoo_forward_shapes[inceptionv3-96]" \
+        "tests/unittest/test_model_zoo.py::test_zoo_forward_shapes[mobilenetv2_0.5-224]" \
         -q -p no:cacheprovider || rc=$?
     if [ -n "${MXNET_TPU_LEDGER_DIR:-}" ]; then
         # tier-1 time-budget tracking: sweep wall time, pass/fail
@@ -1018,6 +1028,11 @@ print('pages shared-prefix smoke OK: bit-identical, hit_rate=%.2f,'
     JAX_PLATFORMS=cpu python -m pytest \
         tests/unittest/test_kernels.py -q -p no:cacheprovider \
         -k "paged_attention"
+    # the speculative-decoding exactness gate (slow-marked out of the
+    # tier-1 sweep for its ~13s drafter drive; covered here every pass)
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_pages.py::test_speculative_bit_identical_to_plain_greedy \
+        -q -p no:cacheprovider
 }
 
 goodput_stage() {
@@ -1078,6 +1093,60 @@ print('goodput disabled fast path OK (zero hook calls, no state)')
         -q -p no:cacheprovider
 }
 
+fleet_stage() {
+    echo "== fleet =="
+    # fleet=off (the default) must be the zero-overhead production
+    # path: a full serve request lifecycle constructs no endpoint, no
+    # router, makes zero fleet calls, and the scope status page carries
+    # no fleet section — every hook site is one module-bool check
+    JAX_PLATFORMS=cpu python -c "
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import fleet, parallel, scope, serve
+from mxnet_tpu.models import gpt as gpt_mod
+assert not fleet.enabled(), 'fleet must default to off'
+hooks = ('snapshot', 'enable', 'ReplicaEndpoint', 'Router')
+calls = {h: 0 for h in hooks}
+real = {h: getattr(fleet, h) for h in hooks}
+for h in hooks:
+    setattr(fleet, h, lambda *a, _h=h, **k: (calls.__setitem__(_h, calls[_h] + 1), real[_h](*a, **k))[1])
+assert scope._fleet_section() is None, 'fleet=off grew a scope section'
+parallel.make_mesh(dp=-1)
+model = gpt_mod.GPTForCausalLM(gpt_mod.gpt_tiny_config())
+mx.random.seed(0); model.initialize()
+srv = serve.Server(model, slots=2)
+r = srv.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+srv.drain()
+srv.stop()
+assert r.state == serve.DONE
+assert calls == {h: 0 for h in hooks}, calls
+assert scope._fleet_section() is None, 'dense serving armed mx.fleet'
+for h in hooks:
+    setattr(fleet, h, real[h])
+fleet.enable()
+sec = scope._fleet_section()
+assert sec is not None and 'endpoints' in sec, sec
+fleet.disable()
+print('fleet disabled fast path OK (no endpoint, no router, no section)')
+"
+    # kill-a-replica-mid-load acceptance (slow-marked out of the tier-1
+    # sweep): tools/launch.py --serve-replicas 2 behind the health
+    # router, SIGKILL one replica while a generation streams through
+    # it — the stream must complete bit-identically on the survivor
+    # (zero accepted requests lost), restarts.jsonl must record the
+    # replica_exit + replica_relaunch pair, the relaunched replica must
+    # serve again, and SIGTERM must drain both replicas through the
+    # resilience preemption path (covered here every pass)
+    # plus the rolling-update acceptance (slow-marked out of the tier-1
+    # sweep for its ~60s of live replica restarts; covered here every
+    # pass): a background client must see every request complete DONE
+    # while the fleet rolls replica-by-replica onto a new version
+    JAX_PLATFORMS=cpu python -m pytest \
+        tests/unittest/test_fleet.py::test_launch_fleet_supervises_replicas \
+        tests/unittest/test_fleet.py::test_rolling_update_serves_continuously \
+        -q -p no:cacheprovider
+}
+
 case "$stage" in
     sanity) sanity ;;
     static) static_stage ;;
@@ -1087,6 +1156,7 @@ case "$stage" in
     native) native_stage ;;
     pages) pages_stage ;;
     goodput) goodput_stage ;;
+    fleet) fleet_stage ;;
     ledger) ledger_stage ;;
     all)
         sanity
@@ -1097,6 +1167,7 @@ case "$stage" in
         train_stage
         pages_stage
         goodput_stage
+        fleet_stage
         ledger_stage
         sh tools/check.sh
         ;;

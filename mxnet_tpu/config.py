@@ -639,6 +639,70 @@ register_option(
         "speculative decoding. Emitted tokens are bit-identical to "
         "pages=off.")
 register_option(
+    "fleet", "off", choices=("off", "on"),
+    doc="mx.fleet replicated serving. 'off' (default) is the zero-"
+        "overhead fast path: no replica endpoint, no router, no fleet "
+        "section in mx.scope statusz — every hook site reduces to one "
+        "module-bool check (asserted by ci/run.sh fleet). 'on' (or "
+        "constructing a fleet.ReplicaEndpoint / running "
+        "`python -m mxnet_tpu.fleet`) arms the replica-side serving "
+        "endpoint so a fleet Router can health-route, drain, fail "
+        "over and roll this process. The router itself is stdlib-only "
+        "and launched by `tools/launch.py --serve-replicas N`.")
+register_option(
+    "fleet_port", 8900,
+    "Base port for mx.fleet: the router's front door listens here and "
+    "replica R serves its generation endpoint on port+1+R (the same "
+    "base+1+rank layout as scope_port, on a separate base so the two "
+    "gangs of listeners never collide).")
+register_option(
+    "fleet_retry_max", 3,
+    "Per-request failover budget in the mx.fleet router: a request "
+    "whose replica dies mid-stream (or answers a retriable overload "
+    "verdict) is re-submitted to a surviving replica at most this "
+    "many times — with a `skip` high-water mark so tokens already "
+    "delivered are never re-sent — before the router returns a 503.")
+register_option(
+    "fleet_health_interval_ms", 250.0,
+    "mx.fleet router health-poll cadence: every interval the router "
+    "fetches each replica's /healthz liveness and /statusz placement "
+    "payload (queue depth, slot occupancy, TTFT percentiles, memsafe "
+    "admission hints) with a hard per-fetch timeout, so routing "
+    "decisions ride data no staler than one interval.")
+register_option(
+    "fleet_stall_timeout_ms", 10000.0,
+    "mx.fleet router per-read stall bound on an in-flight generation "
+    "stream: a replica that stops producing tokens for this long "
+    "(wedged-but-alive — the wedge_replica drill) is treated as dead "
+    "and the request fails over to a survivor. 0 disables.")
+register_option(
+    "fleet_drain_grace_s", 30.0,
+    "Zero-drop drain budget: a SIGTERMed replica stops admitting, "
+    "then finishes in-flight requests for up to this many seconds; "
+    "whatever is still running at expiry is cancelled with a "
+    "retriable verdict so the router requeues it on a survivor "
+    "(replay skips already-streamed tokens). Then the process exits "
+    "via the resilience preemption path (exit code 83).")
+register_option(
+    "fleet_autoscale", "off", choices=("off", "on"),
+    doc="mx.fleet queue-wait autoscaling. 'on' grows the replica "
+        "count when every healthy replica's published p99 queue wait "
+        "stays above fleet_autoscale_p99_ms for a full "
+        "fleet_autoscale_window_s, and shrinks when the fleet sits "
+        "idle (zero queued, negligible queue wait) for the same "
+        "window — clamped to [--min-workers, --serve-replicas-max] "
+        "through the launcher's elastic world-size plumbing.")
+register_option(
+    "fleet_autoscale_p99_ms", 500.0,
+    "Sustained p99 queue-wait threshold (milliseconds) above which "
+    "the mx.fleet router asks the supervisor for one more replica; "
+    "scale-down arms below one quarter of this value.")
+register_option(
+    "fleet_autoscale_window_s", 5.0,
+    "How long the mx.fleet autoscale pressure signal must persist "
+    "before a scale event fires — hysteresis so one burst or one "
+    "idle poll cannot flap the replica count.")
+register_option(
     "pages_page_size", 16,
     "Tokens per mx.pages KV page. Paged buckets round up to a page "
     "multiple (and the servable max_length rounds down to one), so a "

@@ -89,7 +89,9 @@ HIGHER_BETTER = (
     "native", "python", "dataloader_w1", "dataloader_w8",
     "fwd_tflops", "fwd_mxu_eff", "fwdbwd_mxu_eff", "lamb_eff_gbps",
     "matmul_ceiling_tflops", "achievable_mfu", "passed", "ok",
-    "goodput_fraction",
+    "goodput_fraction", "fleet_tokens_per_sec",
+    "fleet_scaling_efficiency", "single_tokens_per_sec",
+    "fleet_completed",
 )
 LOWER_BETTER = (
     "step_p99_ms", "compile_time_s", "recompile_count",
@@ -99,7 +101,7 @@ LOWER_BETTER = (
     "degraded", "int8_ttft_p50_ms", "int8_ttft_p99_ms", "pallas_ms",
     "xla_ms", "ms", "fwd_ms", "fwdbwd_ms", "lamb_apply_ms",
     "ms_per_dispatch", "tbt_p99_ms", "slo_violations", "wall_s",
-    "failed", "errors", "rc",
+    "failed", "errors", "rc", "failover_dropped_requests",
 )
 
 _enabled = False

@@ -1053,6 +1053,22 @@ class FaultInjector:
                               the step) — the mid-generation
                               cancellation drill; the slot is evicted
                               between decode steps
+      kill_replica@step:3   — mx.fleet: SIGKILL this serving replica at
+                              scheduler step 3, mid-generation — the
+                              router must fail its in-flight requests
+                              over to survivors (bit-identical replay
+                              past the streamed high-water) and the
+                              supervisor must relaunch the worker
+      wedge_replica@step:3  — mx.fleet: park the serving scheduler
+                              forever at step 3 WITHOUT dying — health
+                              checks keep answering while tokens stop;
+                              the router's per-read stall bound
+                              (fleet_stall_timeout_ms) must fail over
+      slow_replica:200      — mx.fleet: this replica's endpoint delays
+                              every streamed token 200 ms (consumed by
+                              the ReplicaEndpoint at its first submit)
+                              — published TTFT degrades and placement
+                              must shift load to faster replicas
     Any spec may append @rank:N to fire on that rank only. Specs fire at
     most once, and only on the FIRST launch (MXNET_TPU_RESTART_COUNT=0)
     unless @every_restart is appended — a relaunched gang must not re-kill
@@ -1098,13 +1114,14 @@ class FaultInjector:
                                     "stall_input", "exc", "shrink", "grow",
                                     "oom", "hang", "corrupt_grad",
                                     "stall_heartbeat", "slow_client",
-                                    "burst", "cancel"):
+                                    "burst", "cancel", "kill_replica",
+                                    "wedge_replica", "slow_replica"):
                 raise ValueError(
                     f"fault_inject: unknown fault {spec['kind']!r} in "
                     f"{part!r} (know: sigterm, kill, corrupt_ckpt, "
                     "stall_input, exc, shrink, grow, oom, hang, "
                     "corrupt_grad, stall_heartbeat, slow_client, burst, "
-                    "cancel)")
+                    "cancel, kill_replica, wedge_replica, slow_replica)")
             specs.append(spec)
         return cls(specs)
 

@@ -339,6 +339,16 @@ def _serve_section():
     return out
 
 
+def _fleet_section():
+    fl = sys.modules.get(__package__ + ".fleet")
+    if fl is None or not fl._enabled:
+        return None
+    try:
+        return fl.snapshot()
+    except Exception:
+        return None
+
+
 def _goodput_section():
     gp = sys.modules.get(__package__ + ".goodput")
     if gp is None or not gp._enabled:
@@ -374,6 +384,7 @@ def statusz(state=None):
     out["memsafe"] = _memsafe_section()
     out["rungs"] = _rungs_section(state)
     out["serve"] = _serve_section()
+    out["fleet"] = _fleet_section()
     out["slo"] = _slo_section()
     out["goodput"] = _goodput_section()
     out["trace"] = _trace.skew_verdict()

@@ -82,7 +82,9 @@ asserts zero `note_dispatch` calls). Constructing a `Server` arms it.
 from __future__ import annotations
 
 import collections
+import os
 import queue as _pyqueue
+import signal as _sig
 import sys
 import threading
 import time
@@ -660,6 +662,44 @@ class Server:
         out["dispatches"] = dispatches()
         return out
 
+    def admission_hints(self):
+        """What a fleet router needs to PREDICT this server's admission
+        verdict without a round trip: memsafe headroom next to the
+        analytic cache cost of every bucket admission could newly
+        allocate (dense), or the free-page count (paged). A None
+        `headroom_bytes` means memsafe is off — nothing to predict.
+        Published per replica via the mx.fleet /statusz payload; the
+        router skips replicas whose hints predict a 429 (the
+        memory-safe-by-prediction discipline, one level up)."""
+        out = {"max_len": self._max_len, "slots": self._slots,
+               "queue_depth": self._queue_depth,
+               "buckets": self._buckets,       # None => pow2 policy
+               "pages": "on" if self._paged else "off"}
+        cap = _memsafe.capacity_bytes()
+        if cap is None:
+            out["headroom_bytes"] = None
+            return out
+        with self._lock:
+            if self._paged:
+                resident = self._params_bytes + self._pool.pool_bytes()
+                out["page_size"] = self._page_size
+                out["pool_pages_free"] = self._pool.free_pages()
+            else:
+                resident = self._params_bytes + sum(
+                    g.cache_bytes for g in self._groups.values())
+                if self._buckets is not None:
+                    cands = list(self._buckets)
+                else:
+                    cands, b = [], max(1, int(_config.get("bucket_pad_min")))
+                    while b < self._max_len:
+                        cands.append(b)
+                        b *= 2
+                    cands.append(self._max_len)
+                out["bucket_cost"] = {str(b): self._cache_bytes(b)
+                                      for b in cands}
+        out["headroom_bytes"] = max(0, int(cap) - int(resident))
+        return out
+
     # -- lifecycle -------------------------------------------------------
     def start(self):
         """Run the scheduler in a background thread until `stop()`."""
@@ -866,6 +906,27 @@ class Server:
                   f"scheduler step {sched_step}", file=sys.stderr)
             if rid is not None:
                 self.cancel(int(rid))
+        # fleet drills, fired from the scheduler so they land mid-
+        # generation: kill_replica is the SIGKILLed-worker failover
+        # drill (the router must replay in-flight requests on a
+        # survivor); wedge_replica parks the scheduler forever WITHOUT
+        # holding the lock — health checks keep answering, tokens stop,
+        # exactly the stalled-but-alive replica the router's per-read
+        # stall bound exists for
+        hit = inj.take("kill_replica", step=sched_step)
+        if hit is not None:
+            print(f"mx.serve: fault injection: kill_replica at scheduler "
+                  f"step {sched_step} (pid {os.getpid()})", file=sys.stderr)
+            sys.stderr.flush()
+            os.kill(os.getpid(), _sig.SIGKILL)
+        hit = inj.take("wedge_replica", step=sched_step)
+        if hit is not None:
+            print(f"mx.serve: fault injection: wedge_replica at scheduler "
+                  f"step {sched_step} — scheduler parked, process alive",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            while True:
+                time.sleep(3600)
 
     def _apply_cancels(self):
         pending, self._pending_cancels = self._pending_cancels, []

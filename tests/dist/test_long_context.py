@@ -44,6 +44,7 @@ def _ring_temp_bytes(mesh, L, chunk=128, B=1, H=2, D=64):
     return g.lower(q, q, q).compile().memory_analysis().temp_size_in_bytes
 
 
+@pytest.mark.slow  # ~12s AOT memory sweeps; ci dist stage runs it unfiltered
 def test_ring_memory_linear_in_length():
     """Per-device temp for ring fwd+bwd must scale ~linearly in L (the
     O(L_local) claim): quadratic would grow 16x from 2k to 8k."""

@@ -89,6 +89,7 @@ class Unpiped(HybridBlock):
         return self.head(x)
 
 
+@pytest.mark.slow  # ~13s pipeline compile; ci dist stage runs it unfiltered
 def test_pipeline_matches_unpiped():
     steps = 6
     batches = _batches(steps)

@@ -59,6 +59,7 @@ def test_ring_grad_parity(causal):
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # ~13s compile-heavy parity; ci dist stage runs it unfiltered
 def test_ring_chunked_inner_matches_dense():
     # chunk smaller than L_local: the scan path (the O(L*chunk) memory
     # guarantee) must agree with single-chunk dense
@@ -112,6 +113,7 @@ def _train_losses(mesh_axes, seq_parallel, steps=3, B=8, L=64):
     return losses
 
 
+@pytest.mark.slow  # ~14s compile-heavy parity; ci dist stage runs it unfiltered
 def test_bert_sp2_loss_parity():
     """BERT-tiny at dp=2 x sp=2 matches the sp=1 (dp=4) trajectory."""
     ref = _train_losses({"dp": 4}, seq_parallel=False)

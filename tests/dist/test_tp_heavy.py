@@ -62,6 +62,7 @@ def _train(steps=3, tp=False):
     return losses, log, tr
 
 
+@pytest.mark.slow  # ~13s tp4 compile; ci dist stage runs it unfiltered
 def test_tp4_compiles_warning_free_and_matches_dp():
     losses_tp, log, tr = _train(tp=True)
     assert dict(tr.mesh.shape)["tp"] == 4

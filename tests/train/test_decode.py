@@ -138,6 +138,7 @@ def test_decode_sees_updated_weights():
     assert not np.array_equal(out1, out2), "weights changed but decode didn't"
 
 
+@pytest.mark.slow  # ~11s generate trace; ci train stage runs it unfiltered
 def test_greedy_is_single_encode():
     """KV-cache decode: exactly ONE encoder pass regardless of output
     length (the r1 implementation re-encoded per step, O(L^2))."""

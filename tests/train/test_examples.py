@@ -26,12 +26,16 @@ CASES = [
     ("bert/long_context.py",
      ["--dp", "2", "--sp", "2", "--seq-len", "64", "--steps", "2"],
      "step 2"),
-    ("bert/long_context.py",
-     ["--dp", "2", "--sp", "2", "--pp", "2", "--seq-len", "64",
-      "--steps", "2"], "step 2"),
-    ("gpt/pretrain.py",
-     ["--config", "tiny", "--dp", "2", "--sp", "2", "--seq-len", "64",
-      "--steps", "2"], "step 1"),
+    pytest.param(
+        "bert/long_context.py",
+        ["--dp", "2", "--sp", "2", "--pp", "2", "--seq-len", "64",
+         "--steps", "2"], "step 2",
+        marks=pytest.mark.slow),
+    pytest.param(
+        "gpt/pretrain.py",
+        ["--config", "tiny", "--dp", "2", "--sp", "2", "--seq-len", "64",
+         "--steps", "2"], "step 1",
+        marks=pytest.mark.slow),
     ("gpt/generate.py",
      ["--steps", "60", "--merges", "40", "--max-new", "8"], "generated:"),
     pytest.param(

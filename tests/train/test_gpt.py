@@ -91,6 +91,7 @@ def test_gpt_causal_ring_sp_parity():
     np.testing.assert_allclose(dense, ring, rtol=2e-4)
 
 
+@pytest.mark.slow  # ~12s training run; ci train stage runs it unfiltered
 def test_gpt_cyclic_sequence_gate():
     """Falsifiable convergence gate (SyntheticGratings pattern): on a
     deterministic cyclic token sequence next-token prediction is exact,
@@ -136,6 +137,7 @@ def test_gpt_cyclic_sequence_gate():
         m.generate(prompt, max_new_tokens=4, num_beams=4)  # no eos
 
 
+@pytest.mark.slow  # ~12s generate trace; ci train stage runs it unfiltered
 def test_gpt_generate_matches_full_forward():
     """KV-cache incremental decode parity: greedy generate() must equal
     growing-sequence full-forward argmax token for token (catches cache

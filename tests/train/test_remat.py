@@ -30,6 +30,7 @@ def _run(remat, steps=3):
     return [float(tr.step(data, labels).asscalar()) for _ in range(steps)]
 
 
+@pytest.mark.slow  # ~10s compile-heavy parity; ci train stage runs it unfiltered
 def test_remat_loss_parity():
     np.testing.assert_allclose(_run(False), _run(True), rtol=1e-5)
 

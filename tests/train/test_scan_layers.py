@@ -38,15 +38,18 @@ def _losses(scan_layers, remat=False, steps=3, dropout=0.0, seed=0,
     return [float(tr.step(data, labels).asscalar()) for _ in range(steps)]
 
 
+@pytest.mark.slow  # ~11s compile-heavy parity; ci train stage runs it unfiltered
 def test_scan_loss_parity():
     np.testing.assert_allclose(_losses(False), _losses(True), rtol=2e-5)
 
 
+@pytest.mark.slow  # ~10s compile-heavy parity; ci train stage runs it unfiltered
 def test_scan_remat_loss_parity():
     np.testing.assert_allclose(_losses(False, remat=False),
                                _losses(True, remat=True), rtol=2e-5)
 
 
+@pytest.mark.slow  # ~13s compile-heavy parity; ci train stage runs it unfiltered
 def test_scan_fsdp_parity():
     np.testing.assert_allclose(_losses(False, param_mode="fsdp"),
                                _losses(True, param_mode="fsdp"), rtol=2e-5)

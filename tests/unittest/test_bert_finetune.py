@@ -47,6 +47,7 @@ def test_qa_head_shapes_masks_and_grad():
     assert np.abs(g.asnumpy()).sum() > 0
 
 
+@pytest.mark.slow  # ~14s finetune loop; ci unittest stage runs it by name
 def test_qa_finetune_overfits_tiny():
     """The span head must overfit a fixed batch — the offline stand-in for
     the SQuAD-F1 quality gate."""

@@ -77,6 +77,7 @@ def test_interpret_bwd_parity(causal):
         config.set("pallas_bwd_min_len", old)
 
 
+@pytest.mark.slow  # ~11s interpret-mode kernel; ci unittest stage runs it by name
 def test_interpret_ring_pallas_inner():
     """Ring attention's Pallas inner (per-KV-block flash fwd + bwd with the
     globally merged LSE) against the dense reference — the TPU code path

@@ -66,6 +66,7 @@ def test_bert_valid_length_masks_attention():
     assert not np.allclose(seq_full.asnumpy(), seq_short.asnumpy())
 
 
+@pytest.mark.slow  # ~23s deep-resnet build+grad; ci unittest stage runs it by name
 def test_resnet50_shapes_and_grad():
     net = resnet_mod.resnet50_v1(classes=10)
     net.initialize()

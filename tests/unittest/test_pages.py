@@ -265,6 +265,7 @@ def test_cow_on_whole_prompt_match(model):
     assert st["prefix_tokens"] >= p.size - 1
 
 
+@pytest.mark.slow  # ~13s spec-decode drive; ci pages stage runs it by name
 def test_speculative_bit_identical_to_plain_greedy(model):
     prompts = [_prompt(n, seed=n) for n in (5, 9, 17)]
     ref = _dense_tokens(model, prompts, max_new=16)

@@ -333,6 +333,7 @@ def test_metrics_scrape_never_torn_under_mutation():
 # /profilez on-demand device capture
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~25s device capture; ci static stage runs it by name
 def test_profilez_capture_and_409_on_concurrent():
     scope.enable(port=0)
     tr = _trainer()

@@ -44,6 +44,8 @@ HIGHER_BETTER = (
     "pages_tokens_per_sec", "pages_requests_per_sec", "pages_completed",
     "prefix_hit_rate", "accepted_draft_rate", "pages_speedup",
     "speedup", "goodput_fraction",
+    "fleet_tokens_per_sec", "fleet_scaling_efficiency",
+    "single_tokens_per_sec", "fleet_completed",
 )
 #: numeric fields where a bigger number is a worse run
 LOWER_BETTER = (
@@ -54,6 +56,7 @@ LOWER_BETTER = (
     "degraded", "int8_ttft_p50_ms", "int8_ttft_p99_ms",
     "pages_ttft_p50_ms", "pages_ttft_p99_ms",
     "pallas_ms", "xla_ms",
+    "failover_dropped_requests",
 )
 #: provenance fields that must MATCH for two rows to be comparable
 PROVENANCE = ("platform", "smoke_mode")

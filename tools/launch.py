@@ -54,7 +54,18 @@ XLA collectives replace the parameter server. So this launcher:
     SIGKILLed so the relaunch machinery treats it as an ordinary slot
     loss; a worker that exits EXIT_PEER_LOST (86 — its mx.guard
     collective deadline named a dead peer) is relaunched like any other
-    failure.
+    failure,
+  * with `--serve-replicas N` runs a REPLICATED SERVING GANG instead of
+    a training job: N independent `mxnet_tpu.fleet` replica workers
+    (each one serve.Server with an HTTP endpoint on
+    `--fleet-port`+1+R) behind the fleet router's health-routed front
+    door on `--fleet-port`. A dead replica is relaunched ALONE
+    (restarts.jsonl records replica_exit / replica_relaunch) while the
+    router replays its in-flight requests on survivors bit-identically;
+    SIGTERM drains every replica before exit (zero-drop), POST /roll
+    rolls the fleet replica-by-replica onto new weights, and
+    MXNET_TPU_FLEET_AUTOSCALE=on resizes the fleet on sustained p99
+    queue wait between `--min-workers` and `--max-replicas`.
 
 `-s` (servers) is accepted and ignored with a warning: there are no
 parameter servers on TPU (SURVEY.md §2.5).
@@ -63,6 +74,7 @@ Usage:
   python tools/launch.py -n 4 --launcher local python train.py
   python tools/launch.py -n 2 --diagnostics-dir diag python train.py
   python tools/launch.py -n 2 -H hosts.txt --launcher ssh python train.py
+  python tools/launch.py --serve-replicas 2 --diagnostics-dir diag
 """
 from __future__ import annotations
 
@@ -913,6 +925,240 @@ def launch_local(num_workers, command, coordinator, diagnostics_dir=None,
             time.sleep(min(0.2, max(0.0, end - time.monotonic())))
 
 
+def _load_fleet():
+    """Load the stdlib-only router half of mxnet_tpu/fleet.py by path —
+    the launcher must stay import-light (no jax, no package import),
+    same pattern as _load_locklint."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "mxnet_tpu", "fleet.py")
+    spec = importlib.util.spec_from_file_location("mx_fleet", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def launch_fleet(num_replicas, command, coordinator, diagnostics_dir=None,
+                 max_restarts=0, restart_backoff=3.0, min_workers=1,
+                 max_replicas=0, fleet_port=8900, heartbeat_timeout=0.0,
+                 scope_port=0):
+    """The serving gang (--serve-replicas N): N replica worker processes
+    (each one `serve.Server` + fleet endpoint on fleet_port+1+R, plus
+    its mx.scope endpoints when armed) behind the fleet router's front
+    door on fleet_port. Unlike the training gang, replicas are
+    INDEPENDENT — a dead replica is relaunched alone while the router
+    fails its in-flight requests over to survivors; nothing tears the
+    gang down. SIGTERM to the launcher drains every replica (zero-drop:
+    each stops admitting, finishes or requeues in-flight work, exits
+    via the resilience preemption path) before the router stops.
+
+    `POST /roll {"version": v}` on the front door rolls the fleet
+    replica-by-replica onto new weights; queue-wait autoscale (the
+    fleet_autoscale knob) resizes the replica count between
+    --min-workers and --serve-replicas-max, clamped through the same
+    _plan_world step the elastic training gang uses."""
+    fleet = _load_fleet()
+    max_replicas = max_replicas or num_replicas
+    killed = {}
+    signal.signal(signal.SIGINT, lambda s, f: killed.setdefault("sig", s))
+    signal.signal(signal.SIGTERM, lambda s, f: killed.setdefault("sig", s))
+
+    version = os.environ.get("MXNET_TPU_FLEET_VERSION", "v0")
+    command = list(command) or [sys.executable, "-m", "mxnet_tpu.fleet"]
+    replicas = {}        # rid -> {"proc", "pump", "restarts", "ver"}
+
+    def _replica_url(rid):
+        return f"http://127.0.0.1:{fleet_port + 1 + rid}"
+
+    def _spawn_replica(rid, restart_count, ver):
+        env = build_env(rid, max_replicas, coordinator, diagnostics_dir,
+                        restart_count=restart_count,
+                        heartbeat_timeout=heartbeat_timeout,
+                        scope_port=scope_port)
+        env["MXNET_TPU_FLEET_REPLICA"] = str(rid)
+        env["MXNET_TPU_FLEET_PORT"] = str(fleet_port + 1 + rid)
+        env["MXNET_TPU_FLEET_VERSION"] = ver
+        proc, pump = _spawn(command, env, rid, diagnostics_dir,
+                            restart_count=restart_count)
+        replicas[rid] = {"proc": proc, "pump": pump,
+                         "restarts": restart_count, "ver": ver}
+        return proc
+
+    for rid in range(num_replicas):
+        _spawn_replica(rid, 0, version)
+
+    router = fleet.Router({rid: _replica_url(rid) for rid in replicas})
+    router.start()
+    front = fleet.RouterServer(router, fleet_port)
+    print(f"launch: fleet front door on {front.url} "
+          f"({num_replicas} replica(s), ports "
+          f"{fleet_port + 1}..{fleet_port + num_replicas})", flush=True)
+    # gang introspection over the REPLICA ids (replicas restart
+    # independently, so the merged view spans whatever incarnation each
+    # id is on — generation pins to 0)
+    aggregator = _start_scope_aggregator(scope_port, max_replicas, 0)
+
+    target = [num_replicas]
+    roll_req = []
+
+    def _on_scale(n):
+        # clamp the autoscaler's ask through the elastic world-size
+        # plumbing: one _plan_world step per direction, never a jump
+        cur = target[0]
+        while n != cur:
+            codes = [None] * max(cur, 1)
+            codes[-1] = EXIT_GROW if n > cur else EXIT_SHRINK
+            nxt, _, _ = _plan_world(max(cur, 1), codes, True,
+                                    min_workers, max_replicas)
+            if nxt == cur:
+                break
+            cur = nxt
+        if cur != target[0]:
+            print(f"launch: fleet scale {target[0]} -> {cur}", flush=True)
+            target[0] = cur
+
+    router.on_scale = _on_scale
+    front.on_scale = _on_scale
+    front.on_roll = lambda ver: roll_req.append(ver or version)
+
+    # one liveness monitor PER replica incarnation (not per gang): each
+    # replica restarts independently, so its heartbeat generation is its
+    # own restart count — a gang-wide monitor generation would match at
+    # most one replica. The procs list is padded with already-dead
+    # placeholders so the monitor's rank indexing (rank R reads
+    # <dir>/R/heartbeat.json) lines up with the replica id.
+    class _DeadProc:
+        def poll(self):
+            return 0
+
+    monitors = {}
+
+    def _remonitor(rid):
+        old = monitors.pop(rid, None)
+        if old is not None:
+            old.stop()
+        if heartbeat_timeout and diagnostics_dir and rid in replicas:
+            procs = [_DeadProc()] * rid + [replicas[rid]["proc"]]
+            monitors[rid] = _HeartbeatMonitor(
+                procs, diagnostics_dir, heartbeat_timeout,
+                replicas[rid]["restarts"])
+
+    for rid in sorted(replicas):
+        _remonitor(rid)
+    exit_code = 0
+    try:
+        while not killed.get("sig"):
+            time.sleep(0.2)
+            # -- reap & relaunch dead replicas (independently) ---------
+            for rid, st in sorted(replicas.items()):
+                code = st["proc"].poll()
+                if code is None:
+                    continue
+                _append_restart_event(diagnostics_dir, {
+                    "ts": time.time(), "kind": "replica_exit",
+                    "replica": rid, "exit_code": code,
+                    "preempted": code == EXIT_PREEMPTED,
+                    "restarts": st["restarts"]})
+                if rid >= target[0]:
+                    # retired by scale-down: drained, do not relaunch
+                    del replicas[rid]
+                    router.remove_replica(rid)
+                    _remonitor(rid)
+                    continue
+                if st["restarts"] >= max_restarts:
+                    print(f"launch: replica {rid} exited {code} with no "
+                          f"restart budget left — removing from fleet",
+                          file=sys.stderr, flush=True)
+                    del replicas[rid]
+                    router.remove_replica(rid)
+                    _remonitor(rid)
+                    if not replicas:
+                        exit_code = code if code else 1
+                        raise KeyboardInterrupt
+                    continue
+                backoff = restart_backoff * random.uniform(0.8, 1.2)
+                print(f"launch: replica {rid} exited {code} — relaunching "
+                      f"in {backoff:.1f}s (router fails its in-flight "
+                      "requests over to survivors)", flush=True)
+                end = time.monotonic() + backoff
+                while time.monotonic() < end and not killed.get("sig"):
+                    time.sleep(0.05)
+                _spawn_replica(rid, st["restarts"] + 1, st["ver"])
+                _append_restart_event(diagnostics_dir, {
+                    "ts": time.time(), "kind": "replica_relaunch",
+                    "replica": rid, "attempt": st["restarts"] + 1,
+                    "exit_code": code,
+                    "preempted": code == EXIT_PREEMPTED})
+                _remonitor(rid)
+            # -- reconcile autoscale target ----------------------------
+            live = sorted(replicas)
+            if len(live) < target[0]:
+                rid = next(i for i in range(max_replicas)
+                           if i not in replicas)
+                print(f"launch: fleet grow — spawning replica {rid}",
+                      flush=True)
+                _spawn_replica(rid, 0, version)
+                router.add_replica(rid, _replica_url(rid))
+                _remonitor(rid)
+            elif len(live) > target[0]:
+                rid = live[-1]
+                print(f"launch: fleet shrink — draining replica {rid}",
+                      flush=True)
+                router.drain(rid)
+                try:
+                    replicas[rid]["proc"].send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            # -- rolling update ----------------------------------------
+            if roll_req:
+                ver = roll_req.pop(0)
+                print(f"launch: rolling update -> {ver}", flush=True)
+                for rid in sorted(replicas):
+                    if killed.get("sig"):
+                        break
+                    router.drain(rid)
+                    router.wait_idle(rid, timeout_s=60.0)
+                    st = replicas[rid]
+                    try:
+                        st["proc"].send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+                    try:
+                        st["proc"].wait(timeout=60.0)
+                    except subprocess.TimeoutExpired:
+                        st["proc"].kill()
+                        st["proc"].wait()
+                    code = st["proc"].poll()
+                    _append_restart_event(diagnostics_dir, {
+                        "ts": time.time(), "kind": "replica_roll",
+                        "replica": rid, "exit_code": code,
+                        "version": ver})
+                    _spawn_replica(rid, st["restarts"] + 1, ver)
+                    router.undrain(rid, remote=False)
+                    router.wait_healthy(rid, timeout_s=120.0, version=ver)
+                    _remonitor(rid)
+                version = ver
+                print(f"launch: rolling update to {ver} complete",
+                      flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for mon in monitors.values():
+            mon.stop()
+        # zero-drop teardown: SIGTERM tells every replica to drain
+        # (finish or requeue in-flight work) before _terminate_gang's
+        # grace expires
+        procs = [st["proc"] for st in replicas.values()]
+        pumps = [st["pump"] for st in replicas.values()]
+        _terminate_gang(procs, pumps, grace=30.0)
+        if aggregator is not None:
+            aggregator.stop()
+        front.stop()
+        router.stop()
+    sig = killed.get("sig")
+    return exit_code if sig is None else 128 + sig
+
+
 def launch_ssh(hosts, num_workers, command, coordinator, username=None,
                diagnostics_dir=None, trace_dir=None):
     procs, pumps = [], []
@@ -942,7 +1188,7 @@ def launch_ssh(hosts, num_workers, command, coordinator, username=None,
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-n", "--num-workers", type=int, default=0)
     p.add_argument("-s", "--num-servers", type=int, default=0,
                    help="ignored: no parameter servers on TPU")
     p.add_argument("-H", "--hostfile", default=None,
@@ -1024,10 +1270,41 @@ def main(argv=None):
                         "to: a relaunch after slot losses is clamped to "
                         "this floor, never below it. Default from "
                         "MXNET_TPU_MIN_WORKERS.")
+    p.add_argument("--serve-replicas", type=int, default=0,
+                   help="fleet serving mode (local launcher): spawn N "
+                        "replica worker processes (default command: "
+                        "python -m mxnet_tpu.fleet), each one serve.Server "
+                        "with a fleet endpoint on --fleet-port+1+R, and "
+                        "run the health-routed front door on --fleet-port. "
+                        "Replicas are supervised INDEPENDENTLY: a dead "
+                        "replica is relaunched alone (restarts.jsonl "
+                        "records replica_exit/replica_relaunch) while the "
+                        "router fails its in-flight requests over to "
+                        "survivors with bit-identical replay. SIGTERM "
+                        "drains every replica (zero-drop) before exit; "
+                        "POST /roll on the front door rolls the fleet "
+                        "replica-by-replica onto new weights.")
+    p.add_argument("--fleet-port", type=int,
+                   default=int(os.environ.get("MXNET_TPU_FLEET_PORT_BASE",
+                                              "8900")),
+                   help="front-door port for --serve-replicas; replica R "
+                        "listens on this port +1+R (same layout as "
+                        "--scope-port)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="ceiling for fleet queue-wait autoscale "
+                        "(MXNET_TPU_FLEET_AUTOSCALE=on): sustained p99 "
+                        "queue wait grows the fleet one replica at a time "
+                        "up to this cap, quiet periods shrink it back "
+                        "toward --min-workers — each resize clamped "
+                        "through the same elastic world-size step the "
+                        "training gang uses. Default: --serve-replicas "
+                        "(autoscale can only shrink).")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
-    if not args.command:
+    if not args.serve_replicas and args.num_workers <= 0:
+        p.error("one of -n/--num-workers or --serve-replicas is required")
+    if not args.command and not args.serve_replicas:
         p.error("no command given")
     if args.num_servers:
         print("warning: -s/--num-servers ignored — TPU SPMD has no "
@@ -1037,6 +1314,19 @@ def main(argv=None):
     if args.heartbeat_timeout and not args.diagnostics_dir:
         p.error("--heartbeat-timeout needs --diagnostics-dir (the "
                 "heartbeat files live under it)")
+
+    if args.serve_replicas:
+        if args.launcher != "local":
+            p.error("--serve-replicas is local-launcher only")
+        return launch_fleet(args.serve_replicas, args.command,
+                            args.coordinator, args.diagnostics_dir,
+                            max_restarts=args.max_restarts,
+                            restart_backoff=args.restart_backoff,
+                            min_workers=args.min_workers,
+                            max_replicas=args.max_replicas,
+                            fleet_port=args.fleet_port,
+                            heartbeat_timeout=args.heartbeat_timeout,
+                            scope_port=args.scope_port)
 
     if args.launcher == "ssh":
         if not args.hostfile:
